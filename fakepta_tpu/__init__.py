@@ -1,0 +1,11 @@
+"""fakepta_tpu — a TPU-native (JAX/XLA) pulsar-timing-array simulation framework.
+
+Public API mirrors the reference package layout (``fakepta.__init__:1-2`` exposes
+``fake_pta`` and ``correlated_noises``): the same module names hold the stateful
+user-facing API, while the functional TPU engine lives in ``ops/``, ``models/`` and
+``utils/``.
+"""
+
+__version__ = "0.1.0"
+
+from . import constants, fake_pta, spectrum  # noqa: F401

@@ -1,0 +1,879 @@
+"""Stateful `Pulsar` facade over the functional JAX core.
+
+API parity with the reference's ``fakepta/fake_pta.py`` ``Pulsar`` class
+(``fake_pta.py:24-567``): same constructor signature, same attribute set (the
+ENTERPRISE data contract that ``copy_array`` round-trips, SURVEY.md §2.4), same
+injector methods and ``signal_model`` provenance dict. The differences are
+architectural, not behavioral:
+
+- every stochastic draw goes through explicit PRNG keys (``seed=`` kwarg; the
+  reference uses the global ``np.random`` state with no seed control);
+- all numerical work happens in jitted JAX kernels (``ops/``), with phases
+  precomputed in float64 on host (absolute TOAs in seconds do not fit float32);
+- device shapes are bucketed (TOA count to multiples of 128, Fourier bins to
+  multiples of 8) so the jit cache stays small across a heterogeneous array;
+- reference bugs are fixed, not replicated (SURVEY.md §7 list): the ECORR block
+  sampler works and keeps the final epoch group; ``spectrum='custom'`` red noise is
+  actually injected; system-noise kwargs are splatted; multi-CGW reconstruction
+  iterates correctly; chromatic scaling uses the masked radio frequencies.
+
+Host state stays numpy (ENTERPRISE pickle compatibility); device arrays are
+ephemeral inside kernel calls.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import constants as const
+from . import spectrum as spectrum_lib
+from .models import cgw as cgw_model
+from .ops import fourier as fourier_ops
+from .ops import white as white_ops
+from .utils import rng as rng_utils
+from .utils.masks import bucket_size, pad_1d
+
+DAY_SECONDS = 86400.0
+
+
+# ---------------------------------------------------------------------------
+# Jitted device kernels shared by all Pulsar instances (shapes bucketed by caller).
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def _k_inject(phase, scale, psd, df, key):
+    """Draw GP coefficients and inject: returns (delta_residuals, raw_coeffs)."""
+    basis = fourier_ops.basis_from_phase(phase, scale)
+    coeffs = fourier_ops.draw_coeffs(key, psd)
+    return fourier_ops.inject_from_coeffs(basis, coeffs, df), coeffs
+
+
+@jax.jit
+def _k_reconstruct(phase, scale, fourier, df):
+    basis = fourier_ops.basis_from_phase(phase, scale)
+    return fourier_ops.reconstruct_from_fourier(basis, fourier, df)
+
+
+@jax.jit
+def _k_cov(phase, scale, psd, df):
+    basis = fourier_ops.basis_from_phase(phase, scale)
+    return fourier_ops.gp_covariance(basis, psd, df)
+
+
+@jax.jit
+def _k_white(key, sigma2):
+    return white_ops.draw_white(key, sigma2)
+
+
+@jax.jit
+def _k_mvn(key, cov, jitter):
+    """Sample N(0, cov) via Cholesky of the jittered covariance."""
+    n = cov.shape[0]
+    chol = jnp.linalg.cholesky(cov + jitter * jnp.eye(n, dtype=cov.dtype))
+    z = jax.random.normal(key, (n,), cov.dtype)
+    return chol @ z
+
+
+@jax.jit
+def _k_wiener(cov, red_cov, residuals):
+    """Conditional mean of the red process given residuals: red^T cov^{-1} r."""
+    return red_cov.T @ jnp.linalg.solve(cov, residuals)
+
+
+class Pulsar:
+    """A fabricated pulsar: TOAs, timing model, noise bookkeeping, injected signals.
+
+    Constructor parity: reference ``fake_pta.py:26-61``. ``toas`` are epoch times in
+    seconds; they are repeated once per backend. ``seed`` (new) makes every stochastic
+    method reproducible; omit it to draw from the package default seed stream.
+    """
+
+    def __init__(self, toas, toaerr, theta, phi, pdist=(1.0, 0.2), freqs=(1400,),
+                 custom_noisedict=None, custom_model=None, tm_params=None,
+                 backends=("backend",), ephem=None, seed=None):
+        backends = list(backends)
+        self._keys = rng_utils.KeyStream(seed)
+        host_rng = self._keys.host_rng("init")
+
+        self.nepochs = len(toas)
+        self.toas = np.repeat(np.asarray(toas, dtype=np.float64), len(backends))
+        self.toaerrs = float(toaerr) * np.ones(len(self.toas))
+        self.residuals = np.zeros(len(self.toas))
+        self.Tspan = float(self.toas.max() - self.toas.min())
+        self.custom_model = dict(custom_model) if custom_model is not None \
+            else {"RN": 30, "DM": 100, "Sv": None}
+        self.signal_model: Dict[str, dict] = {}
+        self._waveforms: Dict[str, callable] = {}
+        self.flags = {"pta": ["FAKE"] * len(self.toas)}
+        self.freqs, self.backend_flags = self.get_freqs_and_backends(
+            list(freqs), backends, host_rng)
+        self.backends = np.unique(self.backend_flags)
+        # observing-frequency jitter ~ N(0, 10 MHz), as the reference applies (:45)
+        self.freqs = np.abs(self.freqs + host_rng.normal(scale=10.0, size=len(self.freqs)))
+        self.theta = theta
+        self.phi = phi
+        self.pos = np.array([np.cos(phi) * np.sin(theta),
+                             np.sin(phi) * np.sin(theta),
+                             np.cos(theta)])
+        self.ephem = ephem
+        if ephem is not None:
+            self.planetssb = ephem.get_planet_ssb(self.toas)
+            self.pos_t = np.tile(self.pos, (len(self.toas), 1))
+        else:
+            self.planetssb = None
+            self.pos_t = None
+        self.pdist = pdist
+        self.name = self.get_psrname()
+        self.init_tm_pars(tm_params)
+        self.make_Mmat()
+        self.fitpars = list(self.tm_pars)
+        self.init_noisedict(custom_noisedict)
+
+    # ------------------------------------------------------------------
+    # construction helpers
+    # ------------------------------------------------------------------
+
+    def get_freqs_and_backends(self, freqs, backends, host_rng=None):
+        """Tile backend names across epochs and resolve observing frequencies.
+
+        A backend named ``'NAME.1440'`` pins its frequency from the suffix; otherwise
+        a random frequency from ``freqs`` is chosen and appended to the backend name.
+        Parity: reference ``fake_pta.py:63-74``.
+        """
+        host_rng = host_rng or self._keys.host_rng("freqs_backends")
+        flags = np.tile(np.asarray(backends, dtype=object), self.nepochs)
+        b_freqs = np.empty(len(flags))
+        for i, flag in enumerate(flags):
+            suffix = str(flag).rsplit(".", 1)[-1]
+            try:
+                b_freqs[i] = float(suffix)
+            except ValueError:
+                choice = host_rng.choice(freqs)
+                flags[i] = f"{flag}.{int(choice)}"
+                b_freqs[i] = choice
+        return b_freqs, flags.astype(str)
+
+    def init_noisedict(self, custom_noisedict=None):
+        """Resolve white-noise parameters into ``self.noisedict``.
+
+        Four-way resolution with the same precedence as the reference
+        (``fake_pta.py:76-147``): (a) no dict -> per-backend defaults; (b) keys
+        mentioning this pulsar's name -> filtered through; (c) per-backend keys
+        ``<backend>_efac`` -> prefixed with the pulsar name; (d) global keys
+        ``efac``/``log10_tnequad``/... applied to every backend. Red/DM/chromatic
+        hyper-parameters pass through, accepting pulsar-prefixed or bare keys.
+        """
+        nd = {}
+        src = custom_noisedict or {}
+        if custom_noisedict is None:
+            for backend in self.backends:
+                nd[f"{self.name}_{backend}_efac"] = 1.0
+                nd[f"{self.name}_{backend}_log10_tnequad"] = -8.0
+                nd[f"{self.name}_{backend}_log10_t2equad"] = -8.0
+                nd[f"{self.name}_{backend}_log10_ecorr"] = -8.0
+        elif any(self.name in key for key in src):
+            nd.update({key: val for key, val in src.items() if self.name in key})
+        elif all(f"{backend}_efac" in src for backend in self.backends):
+            for backend in self.backends:
+                nd[f"{self.name}_{backend}_efac"] = src[f"{backend}_efac"]
+                nd[f"{self.name}_{backend}_log10_tnequad"] = src[f"{backend}_log10_tnequad"]
+                for opt in ("log10_t2equad", "log10_ecorr"):
+                    if f"{backend}_{opt}" in src:
+                        nd[f"{self.name}_{backend}_{opt}"] = src[f"{backend}_{opt}"]
+        else:
+            for backend in self.backends:
+                nd[f"{self.name}_{backend}_efac"] = src["efac"]
+                nd[f"{self.name}_{backend}_log10_tnequad"] = src["log10_tnequad"]
+                for opt in ("log10_t2equad", "log10_ecorr"):
+                    if opt in src:
+                        nd[f"{self.name}_{backend}_{opt}"] = src[opt]
+        for gp in ("red_noise", "dm_gp", "chrom_gp"):
+            if any(gp in key for key in src):
+                for par in ("log10_A", "gamma"):
+                    prefixed = f"{self.name}_{gp}_{par}"
+                    bare = f"{gp}_{par}"
+                    if prefixed in src:
+                        nd[prefixed] = src[prefixed]
+                    elif bare in src:
+                        nd[prefixed] = src[bare]
+        self.noisedict = nd
+
+    def init_tm_pars(self, timing_model=None):
+        """Default timing-model ``(value, uncertainty)`` pairs (ref ``fake_pta.py:149-160``)."""
+        self.tm_pars = {
+            "F0": (200, 1e-13),
+            "F1": (0.0, 1e-20),
+            "DM": (0.0, 5e-4),
+            "DM1": (0.0, 1e-4),
+            "DM2": (0.0, 1e-5),
+            "ELONG": (0.0, 1e-5),
+            "ELAT": (0.0, 1e-5),
+        }
+        if timing_model is not None:
+            self.tm_pars.update(timing_model)
+
+    def make_Mmat(self, t0=0.0):
+        """Timing-model design matrix (ref ``fake_pta.py:162-173``).
+
+        Eight populated columns: offset; spin phase/frequency-derivative terms scaled
+        by 1/F0; DM, DM1, DM2 chromatic columns in 1/nu^2; annual cos/sin. As in the
+        reference, ``npar = len(tm_pars)+1`` so extra user timing parameters produce
+        zero columns (documented quirk kept for shape compatibility).
+        """
+        t = self.toas - t0
+        f0 = self.tm_pars["F0"][0]
+        npar = len(self.tm_pars) + 1
+        m = np.zeros((len(self.toas), npar))
+        m[:, 0] = 1.0
+        m[:, 1] = -t / f0
+        m[:, 2] = -0.5 * t**2 / f0
+        m[:, 3] = 1.0 / self.freqs**2
+        m[:, 4] = t / self.freqs**2 / f0
+        m[:, 5] = 0.5 * t**2 / self.freqs**2 / f0
+        omega_yr = 2.0 * np.pi / const.yr
+        m[:, 6] = np.cos(omega_yr * t)
+        m[:, 7] = np.sin(omega_yr * t)
+        self.Mmat = m
+
+    # ------------------------------------------------------------------
+    # state management
+    # ------------------------------------------------------------------
+
+    def update_position(self, theta, phi, update_name=False):
+        """Recompute the sky unit vector (ref ``fake_pta.py:175-181``)."""
+        self.theta = theta
+        self.phi = phi
+        self.pos = np.array([np.cos(phi) * np.sin(theta),
+                             np.sin(phi) * np.sin(theta),
+                             np.cos(theta)])
+        if update_name:
+            self.name = self.get_psrname()
+
+    def update_noisedict(self, prefix, dict_vals):
+        """Prefix-merge hyper-parameters (ref ``fake_pta.py:183-188``)."""
+        self.noisedict.update({f"{prefix}_{key}": val for key, val in dict_vals.items()})
+
+    @staticmethod
+    def _noisedict_fragment(signal):
+        """Substring that identifies a signal's hyper-parameters in the noisedict.
+
+        Stored system-noise keys are ``'<backend>_system_noise_<backend>'`` while the
+        noisedict uses ``'<name>_system_noise_<backend>_...'``, so the backend prefix
+        must be stripped before matching.
+        """
+        if "system_noise" in signal:
+            return "system_noise_" + signal.split("system_noise_")[1]
+        return signal
+
+    def make_ideal(self):
+        """Zero residuals and forget every injected signal (ref ``fake_pta.py:190-199``)."""
+        self.residuals = np.zeros(len(self.toas))
+        for signal in list(self.signal_model):
+            self.signal_model.pop(signal)
+            frag = self._noisedict_fragment(signal)
+            for key in list(self.noisedict):
+                if frag in key:
+                    self.noisedict.pop(key)
+        self._waveforms.clear()
+
+    # ------------------------------------------------------------------
+    # device-kernel plumbing
+    # ------------------------------------------------------------------
+
+    def _padded_phase_scale(self, f_psd, idx, freqf=1400.0, mask=None):
+        """Host-side float64 phase table, bucket-padded for the jit cache.
+
+        Returns (phase (T,B), scale (T,), psd_pad_fn, df (B,), ntoa, nbin) where
+        T/B are bucketed sizes. Padded TOAs get zero scale; padded frequency bins get
+        zero PSD (callers pad) and df=1 so no NaN leaks through sqrt/division.
+        """
+        toas = self.toas if mask is None else self.toas[mask]
+        nu = self.freqs if mask is None else self.freqs[mask]
+        ntoa, nbin = len(toas), len(f_psd)
+        t_pad, b_pad = bucket_size(ntoa), bucket_size(nbin, 8)
+        # float64 host trig argument reduction: fractional cycles, exact at 1e9 s TOAs
+        cycles = np.outer(toas, f_psd) % 1.0
+        phase = np.zeros((t_pad, b_pad))
+        phase[:ntoa, :nbin] = 2.0 * np.pi * cycles
+        scale = np.zeros(t_pad)
+        scale[:ntoa] = (freqf / nu) ** idx
+        df = np.ones(b_pad)
+        df[:nbin] = np.diff(np.concatenate([[0.0], f_psd]))
+        return phase, scale, df, ntoa, nbin
+
+    @staticmethod
+    def _pad_bins(arr, b_pad, fill=0.0):
+        return pad_1d(np.asarray(arr, dtype=np.float64), b_pad, fill)
+
+    # ------------------------------------------------------------------
+    # stochastic injectors
+    # ------------------------------------------------------------------
+
+    def add_white_noise(self, add_ecorr=False, randomize=False, seed=None):
+        """Inject EFAC/EQUAD (and optional epoch-correlated ECORR) white noise.
+
+        Parity: reference ``fake_pta.py:201-230``, with its two ECORR crashes fixed
+        (SURVEY.md §7) and the ENTERPRISE squared-amplitude convention
+        ``10^(2 log10_ecorr)`` for the block variance. ``randomize`` redraws the
+        white-noise dictionary entries uniformly as the reference does (:203-210).
+        """
+        key = self._keys.next("white") if seed is None else rng_utils.as_key(seed)
+        if randomize:
+            host = self._keys.host_rng("white_randomize")
+            for k in self.noisedict:
+                if "efac" in k:
+                    self.noisedict[k] = host.uniform(0.5, 2.5)
+                if "equad" in k:
+                    self.noisedict[k] = host.uniform(-8.0, -5.0)
+                if add_ecorr and "ecorr" in k:
+                    self.noisedict[k] = host.uniform(-10.0, -7.0)
+
+        efac = np.empty(len(self.toas))
+        equad = np.empty(len(self.toas))
+        ecorr = np.full(len(self.toas), -np.inf)
+        for backend in self.backends:
+            sel = self.backend_flags == backend
+            efac[sel] = self.noisedict[f"{self.name}_{backend}_efac"]
+            equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
+            if add_ecorr:
+                ecorr[sel] = self.noisedict[f"{self.name}_{backend}_log10_ecorr"]
+        sigma2 = np.asarray(white_ops.white_sigma2(self.toaerrs, efac, equad))
+
+        if add_ecorr:
+            epoch_idx, n_epochs, counts = self._epoch_segments()
+            weight = (counts >= 2).astype(np.float64)
+            draw = white_ops.draw_white_ecorr(
+                key, sigma2, 10.0 ** (2.0 * ecorr), epoch_idx, n_epochs, weight)
+        else:
+            draw = _k_white(key, sigma2)
+        self.residuals = self.residuals + np.asarray(draw)
+
+    def _epoch_segments(self, dt=1.0, backends=None):
+        """Integer epoch id per TOA — what the vectorized ECORR sampler consumes.
+
+        Fixes the reference's dropped-final-group bug (``fake_pta.py:245-251``).
+        """
+        if backends is None:
+            codes = self.backend_flags
+        else:
+            sel = np.isin(self.backend_flags, backends)
+            codes = np.where(sel, self.backend_flags, "__excluded__")
+        epoch_idx, n_epochs, counts = white_ops.quantise_epochs(
+            self.toas - self.toas[0], codes, dt=dt * DAY_SECONDS)
+        return epoch_idx, n_epochs, counts
+
+    def quantise_ecorr(self, dt=1.0, backends=None):
+        """Per-backend epoch index groups, reference return shape (list of arrays).
+
+        Parity: ``fake_pta.py:232-253`` — but every epoch is returned, including the
+        final group of each backend that the reference silently drops. When
+        ``backends`` is given, only those backends' TOAs are grouped.
+        """
+        epoch_idx, n_epochs, _ = self._epoch_segments(dt=dt, backends=backends)
+        keep = np.ones(len(self.toas), dtype=bool) if backends is None \
+            else np.isin(self.backend_flags, backends)
+        groups = []
+        for ep in range(n_epochs):
+            sel = np.flatnonzero((epoch_idx == ep) & keep)
+            if len(sel):
+                groups.append(sel)
+        return groups
+
+    def _resolve_psd(self, signal, spectrum, f_psd, kwargs):
+        """Shared PSD resolution for the GP injectors (ref ``fake_pta.py:269-279``)."""
+        if spectrum == "custom":
+            return np.asarray(kwargs["custom_psd"], dtype=np.float64), {}
+        if spectrum not in spectrum_lib.SPECTRA:
+            raise KeyError(f"unknown spectrum {spectrum!r}")
+        if not kwargs:
+            try:
+                kwargs = {p: self.noisedict[f"{self.name}_{signal}_{p}"]
+                          for p in spectrum_lib.spec_params[spectrum]}
+            except KeyError as exc:
+                raise ValueError(
+                    f"PSD parameters for {signal} must be in the noisedict or passed "
+                    f"as keyword arguments (missing {exc})") from exc
+        psd = np.asarray(spectrum_lib.evaluate(spectrum, f_psd, **kwargs), dtype=np.float64)
+        return psd, kwargs
+
+    def add_red_noise(self, spectrum="powerlaw", f_psd=None, seed=None, **kwargs):
+        """Achromatic red noise with ``custom_model['RN']`` Fourier bins.
+
+        Parity: reference ``fake_pta.py:258-281``; re-injection subtracts the prior
+        realization first. The reference's indentation bug that silently skips
+        injection for ``spectrum='custom'`` (:281) is fixed.
+        """
+        self._add_gp_signal("red_noise", "RN", spectrum, f_psd, 0.0, seed, kwargs)
+
+    def add_dm_noise(self, spectrum="powerlaw", f_psd=None, seed=None, **kwargs):
+        """Dispersion-measure noise (chromatic index 2); ref ``fake_pta.py:283-306``."""
+        self._add_gp_signal("dm_gp", "DM", spectrum, f_psd, 2.0, seed, kwargs)
+
+    def add_chromatic_noise(self, spectrum="powerlaw", f_psd=None, seed=None, **kwargs):
+        """Scattering-variation noise (chromatic index 4); ref ``fake_pta.py:308-331``."""
+        self._add_gp_signal("chrom_gp", "Sv", spectrum, f_psd, 4.0, seed, kwargs)
+
+    def _add_gp_signal(self, signal, model_key, spectrum, f_psd, idx, seed, kwargs):
+        components = self.custom_model.get(model_key)
+        if components is None:
+            return
+        if f_psd is None:
+            f_psd = np.arange(1, components + 1) / self.Tspan
+        f_psd = np.asarray(f_psd, dtype=np.float64)
+        # resolve and validate BEFORE mutating state, so a failed call cannot leave
+        # the old realization half-subtracted
+        psd, resolved = self._resolve_psd(signal, spectrum, f_psd, kwargs)
+        if len(psd) != len(f_psd):
+            raise ValueError('"psd" and "f_psd" must have the same length')
+        if signal in self.signal_model:
+            self.residuals = self.residuals - self.reconstruct_signal([signal])
+        if resolved:
+            self.update_noisedict(f"{self.name}_{signal}", resolved)
+        self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
+                                       f_psd=f_psd, idx=idx, seed=seed)
+
+    def add_system_noise(self, backend=None, components=30, spectrum="powerlaw",
+                         f_psd=None, seed=None, **kwargs):
+        """Per-backend system noise (ref ``fake_pta.py:333-355``).
+
+        The stored signal key is ``'<backend>_system_noise_<backend>'`` — the
+        reference's composite produced by prepending the backend inside the core
+        injector (:362) — because downstream consumers split on ``'system_noise_'``
+        to recover the backend name.
+        """
+        assert backend is not None, 'system noise requires a "backend" name'
+        signal = f"system_noise_{backend}"
+        if f_psd is None:
+            f_psd = np.arange(1, components + 1) / self.Tspan
+        f_psd = np.asarray(f_psd, dtype=np.float64)
+        stored = f"{backend}_{signal}"
+        psd, resolved = self._resolve_psd(signal, spectrum, f_psd, kwargs)
+        if len(psd) != len(f_psd):
+            raise ValueError('"psd" and "f_psd" must have the same length')
+        if stored in self.signal_model:
+            self.residuals = self.residuals - self.reconstruct_signal([stored])
+        if resolved:
+            self.update_noisedict(f"{self.name}_{signal}", resolved)
+        self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
+                                       f_psd=f_psd, idx=0.0, backend=backend, seed=seed)
+
+    def add_time_correlated_noise(self, signal="", spectrum="powerlaw", psd=None,
+                                  f_psd=None, idx=0, freqf=1400, backend=None,
+                                  seed=None):
+        """Core Fourier-basis GP injector (ref ``fake_pta.py:357-387``).
+
+        Draws coefficients ``c ~ N(0, sqrt(psd))``, accumulates
+        ``(freqf/nu)^idx sqrt(df) (c_cos cos + c_sin sin)`` into the residuals and
+        records the ``signal_model`` provenance entry (stored Fourier coefficients
+        are ``c/sqrt(df)``). Chromatic scaling uses the masked radio frequencies —
+        the reference broadcasts the full-length frequency array against masked
+        residuals, which fails for a proper backend subset (:386).
+        """
+        key = self._keys.next(signal or "gp") if seed is None else rng_utils.as_key(seed)
+        if backend is not None:
+            signal = f"{backend}_{signal}"
+            mask = self.backend_flags == backend
+            if not mask.any():
+                raise ValueError(f"{backend!r} not found in backend_flags")
+        else:
+            mask = None
+
+        f_psd = np.asarray(f_psd, dtype=np.float64)
+        psd = np.asarray(psd, dtype=np.float64)
+        if len(psd) != len(f_psd):
+            raise ValueError('"psd" and "f_psd" must have the same length')
+
+        phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
+            f_psd, idx, freqf, mask)
+        psd_pad = self._pad_bins(psd, len(df_pad))
+        delta_pad, coeffs_pad = _k_inject(phase, scale, psd_pad, df_pad, key)
+        delta = np.asarray(delta_pad)[:ntoa]
+        coeffs = np.asarray(coeffs_pad)[:, :nbin]
+
+        df = df_pad[:nbin]
+        self.signal_model[signal] = {
+            "spectrum": spectrum,
+            "f": f_psd,
+            "psd": psd,
+            "fourier": coeffs / np.sqrt(df)[None, :],
+            "nbin": nbin,
+            "idx": idx,
+        }
+        if mask is None:
+            self.residuals = self.residuals + delta
+        else:
+            out = self.residuals.copy()
+            out[mask] += delta
+            self.residuals = out
+
+    # ------------------------------------------------------------------
+    # deterministic injectors
+    # ------------------------------------------------------------------
+
+    def add_cgw(self, costheta, phi, cosinc, log10_mc, log10_fgw, log10_h, phase0,
+                psi, psrterm=False):
+        """Inject a circular-SMBHB continuous wave (ref ``fake_pta.py:422-442``).
+
+        The waveform is the in-package :func:`fakepta_tpu.models.cgw.cw_delay`
+        (native replacement for the reference's external enterprise_extensions
+        dependency), evaluated with full frequency evolution.
+        """
+        record = {"costheta": costheta, "phi": phi, "cosinc": cosinc,
+                  "log10_mc": log10_mc, "log10_fgw": log10_fgw, "log10_h": log10_h,
+                  "phase0": phase0, "psi": psi, "psrterm": psrterm}
+        slot = self.signal_model.setdefault("cgw", {})
+        slot[str(len(slot))] = record
+        delay = cgw_model.cw_delay(
+            self.toas, self.pos, self.pdist, cos_gwtheta=costheta, gwphi=phi,
+            cos_inc=cosinc, log10_mc=log10_mc, log10_fgw=log10_fgw, evolve=True,
+            log10_h=log10_h, phase0=phase0, psi=psi, psrTerm=psrterm)
+        self.residuals = self.residuals + np.asarray(delay)
+
+    def add_deterministic(self, waveform, **kwargs):
+        """Inject any user waveform ``waveform(toas=..., **kwargs)`` (ref :444-455).
+
+        The callable is remembered so the signal can be reconstructed/removed —
+        the reference records only the kwargs and silently cannot reconstruct.
+        """
+        fname = waveform.__name__
+        slot = self.signal_model.setdefault(fname, {})
+        slot[str(len(slot))] = dict(kwargs)
+        self._waveforms[fname] = waveform
+        self.residuals = self.residuals + np.asarray(waveform(toas=self.toas, **kwargs))
+
+    # ------------------------------------------------------------------
+    # coordinates and naming
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def radec_to_thetaphi(ra, dec):
+        """(RA [h, m], dec [deg, arcmin]) -> (theta, phi) (ref ``fake_pta.py:458-465``)."""
+        theta = np.pi / 2 - np.pi / 180 * (dec[0] + dec[1] / 60)
+        phi = 2 * np.pi * (ra[0] + ra[1] / 60) / 24
+        return theta, phi
+
+    @staticmethod
+    def thetaphi_to_radec(theta, phi):
+        """(theta, phi) -> (RA [h, m], dec [deg, arcmin]) (ref ``fake_pta.py:467-475``).
+
+        The reference computes declination as ``(theta - pi/2)`` which negates it and
+        breaks the round trip with :meth:`radec_to_thetaphi`; the sign is fixed here.
+        """
+        dec_deg = (np.pi / 2 - theta) * 180 / np.pi
+        dec = [int(np.floor(dec_deg)), int((dec_deg - np.floor(dec_deg)) * 60)]
+        ra_h = phi * 24 / (2 * np.pi)
+        ra = [int(np.floor(ra_h)), int((ra_h - np.floor(ra_h)) * 60)]
+        return ra, dec
+
+    def get_psrname(self):
+        """J-name from sky position, e.g. ``J1234+0456`` (ref ``fake_pta.py:477-491``).
+
+        Reproduces the reference's formatting exactly — including its left-padding of
+        the fractional declination (0.5 deg renders as '05') — because generated
+        names key the noisedict and must match across the package.
+        """
+        ra_hours = 24 * self.phi / (2 * np.pi)
+        h = int(ra_hours)
+        m = int((ra_hours - h) * 60)
+        dec = round(180 * (np.pi / 2 - self.theta) / np.pi, 2)
+        sign = "+" if dec >= 0 else "-"
+        decl, _, decr = f"{abs(dec)}".partition(".")
+        return f"J{h:02d}{m:02d}{sign}{int(decl):02d}{int(decr or 0):02d}"
+
+    # ------------------------------------------------------------------
+    # covariances, sampling, reconstruction
+    # ------------------------------------------------------------------
+
+    def make_time_correlated_noise_cov(self, signal="", freqf=1400):
+        """Dense covariance of one stored GP signal (ref ``fake_pta.py:389-420``)."""
+        if "system_noise" in signal:
+            backend = signal.split("system_noise_")[1]
+            stored = f"{backend}_system_noise_{backend}" \
+                if not signal.startswith(f"{backend}_") else signal
+            mask = self.backend_flags == backend
+            if not mask.any():
+                raise ValueError(f"{backend!r} not found in backend_flags")
+        else:
+            stored, mask = signal, None
+        entry = self.signal_model[stored]
+        f_psd = np.asarray(entry["f"], dtype=np.float64)
+        phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
+            f_psd, entry["idx"], freqf, mask)
+        psd_pad = self._pad_bins(np.asarray(entry["psd"], dtype=np.float64), len(df_pad))
+        cov = np.asarray(_k_cov(phase, scale, psd_pad, df_pad))
+        return cov[:ntoa, :ntoa]
+
+    def make_noise_covariance_matrix(self):
+        """(white variance vector, dense red covariance) (ref ``fake_pta.py:493-513``).
+
+        Sums RN/DM/Sv covariances for the signals that are both enabled in
+        ``custom_model`` and actually injected (the reference KeyErrors on
+        not-yet-injected signals).
+        """
+        efac = np.empty(len(self.toas))
+        equad = np.empty(len(self.toas))
+        for backend in self.backends:
+            sel = self.backend_flags == backend
+            efac[sel] = self.noisedict[f"{self.name}_{backend}_efac"]
+            equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
+        white_cov = np.asarray(white_ops.white_sigma2(self.toaerrs, efac, equad))
+
+        red_cov = np.zeros((len(self.toas), len(self.toas)))
+        for model_key, signal in (("RN", "red_noise"), ("DM", "dm_gp"), ("Sv", "chrom_gp")):
+            if self.custom_model.get(model_key) is not None and signal in self.signal_model:
+                red_cov += self.make_time_correlated_noise_cov(signal)
+        return white_cov, red_cov
+
+    def draw_noise_model(self, residuals=None, seed=None):
+        """Sample from the total noise covariance, or Wiener-filter given residuals.
+
+        Parity: reference ``fake_pta.py:515-524``; the dense ``np.linalg.inv`` is
+        replaced by a device Cholesky sample / linear solve.
+        """
+        white_cov, red_cov = self.make_noise_covariance_matrix()
+        cov = np.diag(white_cov) + red_cov
+        if residuals is None:
+            key = self._keys.next("noise_model") if seed is None else rng_utils.as_key(seed)
+            return np.asarray(_k_mvn(key, cov, 1e-24))
+        return np.asarray(_k_wiener(cov, red_cov, np.asarray(residuals)))
+
+    def reconstruct_signal(self, signals=None, freqf=1400):
+        """Rebuild the time-domain realization of stored signals (ref :526-555).
+
+        Handles GP signals (red/dm/chrom/common), backend-masked system noise,
+        multi-CGW entries (the reference's ``for ncgw in len(...)`` TypeError is
+        fixed), and any recorded deterministic waveforms.
+        """
+        if signals is None:
+            signals = list(self.signal_model)
+        sig = np.zeros(len(self.toas))
+        for signal in signals:
+            if signal == "cgw":
+                for record in self.signal_model["cgw"].values():
+                    sig += np.asarray(cgw_model.cw_delay(
+                        self.toas, self.pos, self.pdist,
+                        cos_gwtheta=record["costheta"], gwphi=record["phi"],
+                        cos_inc=record["cosinc"], log10_mc=record["log10_mc"],
+                        log10_fgw=record["log10_fgw"], evolve=True,
+                        log10_h=record["log10_h"], phase0=record["phase0"],
+                        psi=record["psi"], psrTerm=record["psrterm"]))
+            elif signal in self._waveforms:
+                for record in self.signal_model[signal].values():
+                    sig += np.asarray(self._waveforms[signal](toas=self.toas, **record))
+            elif "system_noise" in signal:
+                backend = signal.split("system_noise_")[1]
+                mask = self.backend_flags == backend
+                entry = self.signal_model[signal]
+                sig[mask] += self._reconstruct_gp(entry, freqf, mask)
+            elif signal in self.signal_model and "fourier" in self.signal_model[signal]:
+                entry = self.signal_model[signal]
+                sig += self._reconstruct_gp(entry, freqf, None)
+        return sig
+
+    def _reconstruct_gp(self, entry, freqf, mask):
+        f_psd = np.asarray(entry["f"], dtype=np.float64)
+        phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
+            f_psd, entry["idx"], freqf, mask)
+        four = np.zeros((2, len(df_pad)))
+        four[:, :nbin] = np.asarray(entry["fourier"])
+        out = np.asarray(_k_reconstruct(phase, scale, four, df_pad))
+        return out[:ntoa]
+
+    def remove_signal(self, signals=None, freqf=1400):
+        """Subtract a signal's realization and forget it (ref ``fake_pta.py:557-567``)."""
+        if signals is None:
+            signals = list(self.signal_model)
+        self.residuals = self.residuals - self.reconstruct_signal(signals, freqf=freqf)
+        for signal in signals:
+            self.signal_model.pop(signal, None)
+            self._waveforms.pop(signal, None)
+            frag = self._noisedict_fragment(signal)
+            for key in list(self.noisedict):
+                if frag in key:
+                    self.noisedict.pop(key)
+
+    # pickling: drop the non-serializable key stream / waveform callables gracefully
+    def __getstate__(self):
+        state = dict(self.__dict__)
+        state["_keys"] = None
+        state["_waveforms"] = {}
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        if self.__dict__.get("_keys") is None:
+            self._keys = rng_utils.KeyStream(None)
+
+
+# ---------------------------------------------------------------------------
+# Array-level factory functions (ref ``fake_pta.py:570-712``)
+# ---------------------------------------------------------------------------
+
+def make_fake_array(npsrs=25, Tobs=None, ntoas=None, gaps=True, toaerr=None,
+                    pdist=None, freqs=(1400,), isotropic=False, backends=None,
+                    noisedict=None, custom_model=None, custom_models=None,
+                    ephem=None, seed=None):
+    """Fabricate a pulsar array with randomized observing configurations.
+
+    Parity: reference ``fake_pta.py:570-670``. Sky positions are Fibonacci-sphere
+    when ``isotropic`` else uniform; per-pulsar observation spans, cadences
+    (phase-locked to an integer pulse count of a drawn F0), TOA gaps (keep
+    probability 3/4), TOA errors (log-uniform 1e-7..1e-5 s), distances and 1-2
+    random backends follow the reference's distributions. Red/DM/chromatic power
+    laws are injected from the noisedict when present, else with random
+    (log10_A ~ U(-17,-13), gamma ~ U(1,5)) hyper-parameters.
+
+    ``seed`` drives every draw (the reference is unseeded global-RNG);
+    ``custom_models`` may map pulsar-name -> custom_model dict as in the example
+    JSON contract (SURVEY.md §2.4).
+    """
+    stream = rng_utils.KeyStream(seed, "make_fake_array")
+    host = stream.host_rng("config")
+
+    if isotropic:
+        i = np.arange(npsrs, dtype=float) + 0.5
+        golden = (1 + 5**0.5) / 2
+        costhetas = 1 - 2 * i / npsrs
+        phis = np.mod(2 * np.pi * i / golden, 2 * np.pi)
+    else:
+        costhetas = host.uniform(-1.0, 1.0, size=npsrs)
+        phis = host.uniform(0.0, 2 * np.pi, size=npsrs)
+
+    if Tobs is None:
+        Tobs = host.uniform(10, 20, size=npsrs)
+    elif np.isscalar(Tobs):
+        Tobs = float(Tobs) * np.ones(npsrs)
+
+    Tobs = np.asarray(Tobs, dtype=np.float64)
+    if ntoas is None:
+        base_cadence = 7 * DAY_SECONDS
+        F0 = host.uniform(200, 300, size=npsrs)
+        # phase-lock the cadence to an integer number of pulses of each pulsar
+        cadence = base_cadence - (F0 * base_cadence - np.floor(F0 * base_cadence)) / F0
+        ntoas = np.int32(Tobs * const.yr / cadence)
+    else:
+        F0 = 200 * np.ones(npsrs)
+        if np.isscalar(ntoas):
+            ntoas = np.int32(int(ntoas) * np.ones(npsrs))
+        else:
+            ntoas = np.asarray(ntoas, dtype=np.int32)
+        cadence = Tobs * const.yr / (ntoas - 1)
+
+    Tmax = np.max(Tobs)
+    toas = []
+    for i in range(npsrs):
+        t = (Tmax - Tobs[i]) * const.yr + np.arange(1, ntoas[i] + 1) * cadence[i]
+        if gaps:
+            keep = host.random(size=ntoas[i]) < 0.75
+            t = t[keep]
+        toas.append(t)
+
+    if toaerr is None:
+        toaerr = 10.0 ** host.uniform(-7.0, -5.0, size=npsrs)
+    elif np.isscalar(toaerr):
+        toaerr = float(toaerr) * np.ones(npsrs)
+
+    if pdist is None:
+        dists = host.uniform(0.5, 1.5, size=npsrs)
+        pdist = [[d, 0.2 * d] for d in dists]
+    elif np.isscalar(pdist):
+        pdist = [[float(pdist), 0.2 * float(pdist)]] * npsrs
+
+    if backends is None:
+        backends = [[f"backend_{k}" for k in range(host.integers(1, 3))]
+                    for _ in range(npsrs)]
+    elif isinstance(backends, str):
+        backends = [[backends]] * npsrs
+    elif isinstance(backends, list) and not isinstance(backends[0], list):
+        backends = [backends] * npsrs
+
+    for nm, arr in (("Tobs", Tobs), ("ntoas", ntoas), ("toaerr", toaerr),
+                    ("pdist", pdist), ("backends", backends)):
+        assert len(arr) == npsrs, f'"{nm}" must be same size as "npsrs"'
+
+    psrs = []
+    for i in range(npsrs):
+        psr = Pulsar(toas[i], toaerr[i], np.arccos(costhetas[i]), phis[i], pdist[i],
+                     freqs=freqs, backends=backends[i], custom_noisedict=noisedict,
+                     custom_model=custom_model,
+                     tm_params={"F0": (F0[i], host.uniform(1e-13, 1e-12))},
+                     ephem=ephem, seed=int(stream.host_rng("psr", i).integers(2**31)))
+        if custom_models is not None and psr.name in custom_models:
+            cm = custom_models[psr.name]
+            if cm is not None:
+                psr.custom_model = dict(cm)
+        psr.add_white_noise()
+        for adder, gp in ((psr.add_red_noise, "red_noise"),
+                          (psr.add_dm_noise, "dm_gp"),
+                          (psr.add_chromatic_noise, "chrom_gp")):
+            amp_key = f"{psr.name}_{gp}_log10_A"
+            gam_key = f"{psr.name}_{gp}_gamma"
+            if amp_key in psr.noisedict and gam_key in psr.noisedict:
+                adder(spectrum="powerlaw", log10_A=psr.noisedict[amp_key],
+                      gamma=psr.noisedict[gam_key])
+            else:
+                adder(spectrum="powerlaw",
+                      log10_A=host.uniform(-17.0, -13.0), gamma=host.uniform(1.0, 5.0))
+        psrs.append(psr)
+    return psrs
+
+
+def plot_pta(psrs, plot_name=True, show=True):
+    """Mollweide sky map of the array, marker size ~ 1/mean(toaerr) (ref :673-684)."""
+    import matplotlib.pyplot as plt
+
+    ax = plt.axes(projection="mollweide")
+    ax.grid(True, alpha=0.25)
+    plt.xticks(np.pi - np.linspace(0.0, 2 * np.pi, 5),
+               ["0h", "6h", "12h", "18h", "24h"], fontsize=14)
+    plt.yticks(fontsize=14)
+    for psr in psrs:
+        size = 50 * (1e-6 / np.mean(psr.toaerrs))
+        plt.scatter(np.pi - np.array(psr.phi), np.pi / 2 - np.array(psr.theta),
+                    marker=(5, 1), s=size, color="r")
+        if plot_name:
+            plt.annotate(psr.name, (np.pi - psr.phi + 0.05, np.pi / 2 - psr.theta - 0.1),
+                         color="k", fontsize=10)
+    if show:
+        plt.show()
+    return ax
+
+
+def copy_array(psrs, custom_noisedict=None, custom_models=None, seed=None):
+    """Clone an existing (ENTERPRISE or fakepta-style) pulsar list (ref :687-712).
+
+    Builds fresh :class:`Pulsar` objects then overwrites the observed attributes
+    (toas/toaerrs/residuals/Mmat/fitpars/pdist/backend_flags/freqs/planetssb/pos_t)
+    from the source objects and re-resolves the noisedict — the bridge for replaying
+    real datasets (e.g. EPTA DR2).
+    """
+    if custom_models is None:
+        custom_models = {psr.name: None for psr in psrs}
+    stream = rng_utils.KeyStream(seed, "copy_array")
+    out = []
+    for psr in psrs:
+        fake = Pulsar(np.asarray(psr.toas), 1e-6, psr.theta, phi=psr.phi, pdist=1.0,
+                      backends=list(np.unique(psr.backend_flags)),
+                      custom_model=custom_models.get(psr.name),
+                      seed=int(stream.host_rng(psr.name).integers(2**31)))
+        fake.name = psr.name
+        fake.toas = np.asarray(psr.toas, dtype=np.float64)
+        fake.toaerrs = np.asarray(psr.toaerrs, dtype=np.float64)
+        fake.residuals = np.asarray(psr.residuals, dtype=np.float64)
+        fake.Tspan = float(fake.toas.max() - fake.toas.min())
+        fake.nepochs = len(fake.toas)
+        fake.Mmat = np.asarray(psr.Mmat)
+        fake.fitpars = list(psr.fitpars)
+        fake.pdist = psr.pdist
+        fake.backend_flags = np.asarray(psr.backend_flags).astype(str)
+        fake.backends = np.unique(fake.backend_flags)
+        fake.freqs = np.asarray(psr.freqs, dtype=np.float64)
+        fake.planetssb = getattr(psr, "planetssb", None)
+        fake.pos_t = getattr(psr, "pos_t", None)
+        fake.init_noisedict(custom_noisedict)
+        out.append(fake)
+    return out

@@ -1,0 +1,1 @@
+from . import fourier, white  # noqa: F401

@@ -1,0 +1,107 @@
+"""Fourier-basis Gaussian-process kernels — the framework's hot path.
+
+The reference injects every time-correlated noise (red / DM / chromatic / system / GWB)
+through a per-component Python loop over cos/sin outer products
+(``fake_pta.py:385-387``, ``correlated_noises.py:153-160``). Here the same math is a
+single einsum over a precomputed basis, jitted and vmappable over pulsars and
+Monte-Carlo realizations.
+
+Conventions (identical to the reference so the ``signal_model`` provenance dict stays
+an exact contract, SURVEY.md §2.4):
+
+- frequency grid ``f_n = (1..N)/Tspan`` unless given; ``df = diff([0, f])``
+- raw coefficients ``c ~ N(0, sqrt(psd_n))`` independently for cos and sin
+- residual contribution ``(freqf/nu)^idx * sum_n sqrt(df_n) (c_cos_n cos(2pi f_n t)
+  + c_sin_n sin(2pi f_n t))``
+- stored Fourier coefficients ``a = c / sqrt(df)`` with shape ``(2, N)`` (row 0 cos,
+  row 1 sin), so reconstruction is ``sum_n df_n (a_0n cos + a_1n sin)`` — matching
+  ``fake_pta.py:372-387`` and ``reconstruct_signal`` (``fake_pta.py:538-545``).
+
+Precision note: phases ``2 pi f t`` are computed by the *caller* (host in float64 for
+the stateful facade; normalized-time trick for the on-device batch engine) because
+absolute TOAs in seconds overflow float32 mantissas. Kernels are dtype-polymorphic.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def fourier_freqs(nbin: int, tspan):
+    """Default GP frequency grid ``(1..nbin)/Tspan`` (ref ``fake_pta.py:264``)."""
+    return jnp.arange(1, nbin + 1) / tspan
+
+
+def freq_weights(f_psd):
+    """``df = diff([0, f])`` — the bin widths used to scale PSD draws (ref :370)."""
+    f_psd = jnp.asarray(f_psd)
+    return jnp.diff(jnp.concatenate([jnp.zeros((1,), f_psd.dtype), f_psd]))
+
+
+def phases(toas, f_psd):
+    """``2 pi f_n t`` as an (ntoa, N) array. Use float64 inputs for absolute TOAs."""
+    toas = jnp.asarray(toas)
+    f_psd = jnp.asarray(f_psd)
+    return 2.0 * jnp.pi * toas[:, None] * f_psd[None, :]
+
+
+def chromatic_scale(radio_freqs, idx, freqf=1400.0):
+    """``(freqf / nu)^idx`` per-TOA chromatic scaling (ref ``fake_pta.py:386``)."""
+    return (freqf / jnp.asarray(radio_freqs)) ** idx
+
+
+def basis_from_phase(phase, scale=None):
+    """Stack the (ntoa, 2, N) cos/sin design tensor, optionally chromatic-scaled.
+
+    ``basis[t, 0, n] = scale_t cos(phase_tn)``, ``basis[t, 1, n] = scale_t sin(phase_tn)``.
+    """
+    b = jnp.stack([jnp.cos(phase), jnp.sin(phase)], axis=1)
+    if scale is not None:
+        b = b * jnp.asarray(scale)[:, None, None]
+    return b
+
+
+def draw_coeffs(key, psd):
+    """Raw Fourier coefficients ``c ~ N(0, sqrt(psd))``, shape (2, N).
+
+    The reference repeats the PSD over interleaved cos/sin pairs and draws
+    ``np.random.normal(scale=sqrt(psd))`` (ref ``fake_pta.py:372-374``), i.e. both the
+    cos and the sin coefficient of bin n have standard deviation ``sqrt(psd_n)``.
+    """
+    psd = jnp.asarray(psd)
+    z = jax.random.normal(key, (2, psd.shape[0]), dtype=psd.dtype)
+    return z * jnp.sqrt(psd)[None, :]
+
+
+def inject_from_coeffs(basis, coeffs, df, toa_mask=None):
+    """Residual contribution of raw coefficients ``c``: ``basis @ (sqrt(df) c)``.
+
+    basis: (ntoa, 2, N); coeffs: (2, N); df: (N,). Returns (ntoa,).
+    """
+    w = coeffs * jnp.sqrt(df)[None, :]
+    res = jnp.einsum("tkn,kn->t", basis, w)
+    if toa_mask is not None:
+        res = jnp.where(toa_mask, res, 0.0)
+    return res
+
+
+def reconstruct_from_fourier(basis, fourier, df, toa_mask=None):
+    """Time-domain realization from *stored* coefficients ``a = c/sqrt(df)``.
+
+    Implements ``sum_n df_n (a_0n cos + a_1n sin)`` (ref ``fake_pta.py:543-545``).
+    """
+    w = jnp.asarray(fourier) * jnp.asarray(df)[None, :]
+    res = jnp.einsum("tkn,kn->t", basis, w)
+    if toa_mask is not None:
+        res = jnp.where(toa_mask, res, 0.0)
+    return res
+
+
+def gp_covariance(basis, psd, df):
+    """Dense GP covariance ``F diag(repeat(psd*df, 2)) F^T`` (ref ``fake_pta.py:389-420``).
+
+    basis: (ntoa, 2, N) -> (ntoa, ntoa).
+    """
+    w = jnp.asarray(psd) * jnp.asarray(df)
+    return jnp.einsum("tkn,n,ukn->tu", basis, w, basis)

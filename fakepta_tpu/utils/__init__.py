@@ -1,0 +1,1 @@
+from . import masks, rng  # noqa: F401

@@ -1,0 +1,32 @@
+"""Test harness configuration.
+
+Runs the whole suite on a *virtual 8-device CPU mesh* with 64-bit mode enabled, so
+- numpy float64 oracles compare exactly against the jitted kernels, and
+- multi-chip sharding (`jax.sharding.Mesh` over 8 devices) is exercised without TPU
+  hardware — the same stand-in strategy SURVEY.md §4 prescribes.
+
+Environment must be set before jax is first imported, hence the top-of-conftest code.
+"""
+
+import os
+
+# The axon TPU plugin in this image registers itself regardless of JAX_PLATFORMS, so
+# the platform must be forced through jax.config (verified: env JAX_PLATFORMS=cpu is
+# ignored, config.update('jax_platforms', 'cpu') is honored).
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(12345)

@@ -1,0 +1,126 @@
+"""Fourier GP kernels vs a straight numpy transcription of the reference semantics."""
+
+import jax
+import numpy as np
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.ops import fourier as F
+from fakepta_tpu.ops import white as W
+
+
+def _numpy_inject(toas, nu, f_psd, df, coeffs, idx, freqf=1400.0):
+    """Oracle: literal per-component loop of ref fake_pta.py:385-387."""
+    res = np.zeros(len(toas))
+    for i in range(len(f_psd)):
+        res += (freqf / nu) ** idx * df[i] ** 0.5 * coeffs[0, i] * np.cos(2 * np.pi * f_psd[i] * toas)
+        res += (freqf / nu) ** idx * df[i] ** 0.5 * coeffs[1, i] * np.sin(2 * np.pi * f_psd[i] * toas)
+    return res
+
+
+def _setup(rng, ntoa=300, nbin=20):
+    tspan = 12 * const.yr
+    toas = np.sort(rng.uniform(0, tspan, ntoa)) + 3 * const.yr
+    nu = rng.uniform(600, 3000, ntoa)
+    f_psd = np.arange(1, nbin + 1) / tspan
+    df = np.diff(np.concatenate([[0.0], f_psd]))
+    return toas, nu, f_psd, df
+
+
+def test_inject_matches_reference_loop(rng):
+    toas, nu, f_psd, df = _setup(rng)
+    coeffs = rng.normal(size=(2, len(f_psd)))
+    idx = 2.0
+    phase = np.asarray(F.phases(toas, f_psd))
+    basis = F.basis_from_phase(phase, scale=F.chromatic_scale(nu, idx))
+    got = np.asarray(F.inject_from_coeffs(basis, coeffs, df))
+    want = _numpy_inject(toas, nu, f_psd, df, coeffs, idx)
+    np.testing.assert_allclose(got, want, rtol=1e-10, atol=1e-18)
+
+
+def test_reconstruct_inverts_injection(rng):
+    """Golden test: stored fourier (= c/sqrt(df)) expansion reproduces the injection
+    exactly (ref reconstruct_signal semantics, fake_pta.py:538-545)."""
+    toas, nu, f_psd, df = _setup(rng)
+    coeffs = rng.normal(size=(2, len(f_psd)))
+    phase = np.asarray(F.phases(toas, f_psd))
+    basis = F.basis_from_phase(phase, scale=F.chromatic_scale(nu, 4.0))
+    injected = np.asarray(F.inject_from_coeffs(basis, coeffs, df))
+    stored = coeffs / np.sqrt(df)[None, :]
+    recon = np.asarray(F.reconstruct_from_fourier(basis, stored, df))
+    np.testing.assert_allclose(recon, injected, rtol=1e-10, atol=1e-18)
+
+
+def test_gp_covariance_matches_dense_oracle(rng):
+    toas, nu, f_psd, df = _setup(rng, ntoa=120, nbin=10)
+    psd = np.abs(rng.normal(size=len(f_psd))) * 1e-12
+    phase = np.asarray(F.phases(toas, f_psd))
+    basis = F.basis_from_phase(phase, scale=F.chromatic_scale(nu, 2.0))
+    got = np.asarray(F.gp_covariance(basis, psd, df))
+    # oracle: F diag(repeat(psd*df,2)) F^T with interleaved columns (ref :413-419)
+    Fd = np.zeros((len(toas), 2 * len(f_psd)))
+    for i in range(len(f_psd)):
+        Fd[:, 2 * i] = (1400.0 / nu) ** 2.0 * np.cos(2 * np.pi * f_psd[i] * toas)
+        Fd[:, 2 * i + 1] = (1400.0 / nu) ** 2.0 * np.sin(2 * np.pi * f_psd[i] * toas)
+    want = Fd @ np.diag(np.repeat(psd * df, 2)) @ Fd.T
+    np.testing.assert_allclose(got, want, rtol=1e-9, atol=1e-24)
+
+
+def test_draw_coeffs_statistics():
+    psd = np.array([4.0, 9.0, 16.0])
+    keys = jax.random.split(jax.random.key(7), 4000)
+    draws = np.asarray(jax.vmap(lambda k: F.draw_coeffs(k, psd))(keys))
+    std = draws.std(axis=0)
+    np.testing.assert_allclose(std, np.sqrt(psd)[None, :].repeat(2, axis=0), rtol=0.1)
+
+
+def test_injected_gp_variance_matches_covariance(rng):
+    """Statistical: ensemble variance of injected GP equals diag of gp_covariance."""
+    toas, nu, f_psd, df = _setup(rng, ntoa=64, nbin=8)
+    psd = np.full(len(f_psd), 1e-12)
+    phase = np.asarray(F.phases(toas, f_psd))
+    basis = F.basis_from_phase(phase)
+    cov = np.asarray(F.gp_covariance(basis, psd, df))
+    keys = jax.random.split(jax.random.key(3), 3000)
+    sims = np.asarray(
+        jax.vmap(lambda k: F.inject_from_coeffs(basis, F.draw_coeffs(k, psd), df))(keys)
+    )
+    np.testing.assert_allclose(sims.var(axis=0), np.diag(cov), rtol=0.2)
+
+
+def test_white_sigma2_and_ecorr_cov(rng):
+    ntoa = 50
+    toaerrs = rng.uniform(1e-7, 1e-6, ntoa)
+    efac = np.full(ntoa, 1.3)
+    q = np.full(ntoa, -6.5)
+    s2 = np.asarray(W.white_sigma2(toaerrs, efac, q))
+    np.testing.assert_allclose(s2, 1.3**2 * toaerrs**2 + 10 ** (2 * -6.5), rtol=1e-12)
+
+    times = np.sort(rng.uniform(0, 30 * 86400, ntoa))
+    codes = rng.integers(0, 2, ntoa)
+    eidx, nep, counts = W.quantise_epochs(times, codes, dt=86400.0)
+    assert eidx.min() >= 0 and eidx.max() == nep - 1
+    assert counts.sum() == ntoa
+    # every TOA within an epoch is within dt of the epoch's first TOA, same backend
+    for ep in range(nep):
+        sel = eidx == ep
+        assert len(np.unique(codes[sel])) == 1
+        assert times[sel].max() - times[sel].min() < 86400.0
+
+    evar = np.full(ntoa, 1e-13)
+    w = (counts >= 2).astype(float)
+    cov = np.asarray(W.white_ecorr_covariance(s2, evar, eidx, w))
+    # sampler covariance check by ensemble
+    keys = jax.random.split(jax.random.key(11), 8000)
+    sims = np.asarray(jax.vmap(lambda k: W.draw_white_ecorr(k, s2, evar, eidx, nep, w))(keys))
+    emp = np.cov(sims.T)
+    scale = np.sqrt(np.outer(np.diag(cov), np.diag(cov)))
+    np.testing.assert_allclose(emp / scale, np.asarray(cov) / scale, atol=0.08)
+
+
+def test_quantise_epochs_keeps_last_group():
+    """The reference drops the final epoch of each backend (fake_pta.py:245-251); we keep it."""
+    times = np.array([0.0, 1000.0, 2e5, 2e5 + 500.0])
+    codes = np.zeros(4, dtype=int)
+    eidx, nep, counts = W.quantise_epochs(times, codes, dt=86400.0)
+    assert nep == 2
+    np.testing.assert_array_equal(eidx, [0, 0, 1, 1])

@@ -1,0 +1,354 @@
+"""Pulsar facade tests: construction, noisedict resolution, injectors, golden
+reconstruction, covariances, pickling (SURVEY.md §4 pyramid: unit + golden)."""
+
+import json
+import pickle
+
+import numpy as np
+import pytest
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.fake_pta import Pulsar, copy_array, make_fake_array
+
+EPTA_NOISEDICT = "/root/reference/examples/simulated_data/noisedict_dr2_newsys_trim.json"
+
+
+def _toas(nyears=10.0, n=200):
+    return np.linspace(0, nyears * const.yr, n) + 3 * const.yr
+
+
+@pytest.fixture
+def psr():
+    return Pulsar(_toas(), 1e-6, theta=1.1, phi=2.2, seed=42)
+
+
+def test_constructor_state(psr):
+    n = len(psr.toas)
+    assert psr.nepochs == 200 and n == 200
+    assert psr.Tspan == pytest.approx(10 * const.yr)
+    assert psr.residuals.shape == (n,) and np.all(psr.residuals == 0)
+    assert psr.custom_model == {"RN": 30, "DM": 100, "Sv": None}
+    assert psr.flags["pta"] == ["FAKE"] * n
+    np.testing.assert_allclose(np.linalg.norm(psr.pos), 1.0, rtol=1e-12)
+    assert psr.name.startswith("J") and ("+" in psr.name or "-" in psr.name)
+    assert psr.fitpars == ["F0", "F1", "DM", "DM1", "DM2", "ELONG", "ELAT"]
+    # backend got its frequency suffix and the noisedict has the default 4 entries
+    backend = psr.backends[0]
+    assert "." in backend
+    for suffix in ("efac", "log10_tnequad", "log10_t2equad", "log10_ecorr"):
+        assert f"{psr.name}_{backend}_{suffix}" in psr.noisedict
+
+
+def test_multiple_backends_repeat_toas():
+    psr = Pulsar(_toas(n=50), 1e-6, 0.5, 0.5, backends=["A.1400", "B.600"], seed=1)
+    assert len(psr.toas) == 100
+    assert set(psr.backends) == {"A.1400", "B.600"}
+    sel = psr.backend_flags == "A.1400"
+    assert sel.sum() == 50
+    # pinned frequencies from suffix, +- jitter of 10 MHz scale
+    assert abs(psr.freqs[sel].mean() - 1400) < 10
+
+
+def test_mmat_columns(psr):
+    m = psr.Mmat
+    assert m.shape == (200, 8)
+    t = psr.toas
+    f0 = psr.tm_pars["F0"][0]
+    np.testing.assert_allclose(m[:, 0], 1.0)
+    np.testing.assert_allclose(m[:, 1], -t / f0, rtol=1e-12)
+    np.testing.assert_allclose(m[:, 3], 1 / psr.freqs**2, rtol=1e-12)
+    np.testing.assert_allclose(m[:, 6], np.cos(2 * np.pi / const.yr * t), rtol=1e-9)
+
+
+def test_extra_tm_params_zero_columns():
+    psr = Pulsar(_toas(n=50), 1e-6, 0.5, 0.5, tm_params={"PX": (0.0, 1e-3)}, seed=3)
+    assert psr.Mmat.shape == (50, 9)
+    assert np.all(psr.Mmat[:, 8] == 0)
+
+
+def test_noisedict_per_pulsar_name_keys():
+    p0 = Pulsar(_toas(n=30), 1e-6, 0.7, 1.0, seed=5)
+    custom = {f"{p0.name}_{p0.backends[0]}_efac": 1.7,
+              f"{p0.name}_{p0.backends[0]}_log10_tnequad": -7.0,
+              "J9999+9999_backend_efac": 9.9,
+              f"{p0.name}_red_noise_log10_A": -14.0,
+              f"{p0.name}_red_noise_gamma": 3.3}
+    p1 = Pulsar(_toas(n=30), 1e-6, 0.7, 1.0, custom_noisedict=custom, seed=5)
+    assert p1.name == p0.name
+    assert p1.noisedict[f"{p1.name}_{p1.backends[0]}_efac"] == 1.7
+    assert "J9999+9999_backend_efac" not in p1.noisedict
+    assert p1.noisedict[f"{p1.name}_red_noise_log10_A"] == -14.0
+
+
+def test_noisedict_per_backend_and_global_keys():
+    nd_backend = {"NUPPI.1400_efac": 1.2, "NUPPI.1400_log10_tnequad": -7.5}
+    p = Pulsar(_toas(n=30), 1e-6, 0.7, 1.0, backends=["NUPPI.1400"],
+               custom_noisedict=nd_backend, seed=6)
+    assert p.noisedict[f"{p.name}_NUPPI.1400_efac"] == 1.2
+
+    nd_global = {"efac": 1.5, "log10_tnequad": -6.5, "red_noise_log10_A": -13.5,
+                 "red_noise_gamma": 2.5}
+    p = Pulsar(_toas(n=30), 1e-6, 0.7, 1.0, backends=["NUPPI.1400"],
+               custom_noisedict=nd_global, seed=6)
+    assert p.noisedict[f"{p.name}_NUPPI.1400_efac"] == 1.5
+    assert p.noisedict[f"{p.name}_red_noise_log10_A"] == -13.5
+
+
+def test_white_noise_statistics():
+    psr = Pulsar(_toas(n=2000), 1e-6, 1.0, 1.0, seed=7)
+    psr.add_white_noise()
+    # efac=1, tnequad=-8 -> sigma ~= 1.005e-6
+    assert abs(psr.residuals.std() / 1.005e-6 - 1) < 0.05
+
+
+def test_white_noise_ecorr_runs_and_adds_variance():
+    # 4 TOAs clustered within ~2 hours per observing epoch, epochs a week apart
+    epochs = np.arange(125) * 7 * 86400.0
+    toas = np.sort((epochs[:, None] + np.linspace(0, 7200, 4)[None, :]).ravel())
+    psr = Pulsar(toas, 1e-6, 1.0, 1.0, seed=8)
+    psr.noisedict[f"{psr.name}_{psr.backends[0]}_log10_ecorr"] = -6.0
+    psr.add_white_noise(add_ecorr=True)
+    # total var ~ toaerr^2 + ecorr^2 = (1e-6)^2 + (1e-6)^2 -> std ~ 1.42e-6
+    assert psr.residuals.std() > 1.2e-6
+    # within-epoch correlation: epoch means should carry the common offset
+    res = psr.residuals.reshape(125, 4)
+    between_var = res.mean(axis=1).var()
+    # iid case would give toaerr^2/4 + small; ECORR keeps the full 1e-12 block
+    assert between_var > 0.5e-12
+
+
+def test_red_noise_golden_reconstruction(psr):
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    assert "red_noise" in psr.signal_model
+    entry = psr.signal_model["red_noise"]
+    assert entry["nbin"] == 30 and entry["fourier"].shape == (2, 30)
+    recon = psr.reconstruct_signal(["red_noise"])
+    np.testing.assert_allclose(recon, psr.residuals, rtol=1e-9, atol=1e-18)
+    # noisedict picked up the injected hyper-parameters
+    assert psr.noisedict[f"{psr.name}_red_noise_log10_A"] == -13.5
+
+
+def test_reinjection_replaces_realization(psr):
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=4.0)
+    recon = psr.reconstruct_signal(["red_noise"])
+    np.testing.assert_allclose(recon, psr.residuals, rtol=1e-9, atol=1e-18)
+
+
+def test_custom_psd_injection_works(psr):
+    """The reference silently skips spectrum='custom' red noise (fake_pta.py:281)."""
+    f_psd = np.arange(1, 31) / psr.Tspan
+    psd = 1e-12 * (f_psd / f_psd[0]) ** -3
+    psr.add_red_noise(spectrum="custom", custom_psd=psd)
+    assert "red_noise" in psr.signal_model
+    assert np.any(psr.residuals != 0)
+    np.testing.assert_allclose(psr.reconstruct_signal(["red_noise"]), psr.residuals,
+                               rtol=1e-9, atol=1e-18)
+
+
+def test_dm_noise_chromatic_scaling(psr):
+    psr.add_dm_noise(spectrum="powerlaw", log10_A=-13.0, gamma=3.0)
+    entry = psr.signal_model["dm_gp"]
+    assert entry["idx"] == 2.0 and entry["nbin"] == 100
+
+
+def test_system_noise_masked():
+    psr = Pulsar(_toas(n=100), 1e-6, 1.0, 1.0, backends=["A.1400", "B.600"], seed=9)
+    psr.add_system_noise(backend="A.1400", components=10, log10_A=-13.0, gamma=3.0)
+    stored = "A.1400_system_noise_A.1400"
+    assert stored in psr.signal_model
+    outside = psr.backend_flags != "A.1400"
+    assert np.all(psr.residuals[outside] == 0)
+    assert np.any(psr.residuals[~outside] != 0)
+    recon = psr.reconstruct_signal([stored])
+    np.testing.assert_allclose(recon, psr.residuals, rtol=1e-9, atol=1e-18)
+
+
+def test_make_ideal_clears_everything(psr):
+    psr.add_white_noise()
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    psr.make_ideal()
+    assert np.all(psr.residuals == 0)
+    assert psr.signal_model == {}
+    assert not any("red_noise" in key for key in psr.noisedict)
+
+
+def test_remove_signal(psr):
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    psr.add_dm_noise(spectrum="powerlaw", log10_A=-13.0, gamma=3.0)
+    dm = psr.reconstruct_signal(["dm_gp"])
+    psr.remove_signal(["red_noise"])
+    assert "red_noise" not in psr.signal_model
+    np.testing.assert_allclose(psr.residuals, dm, rtol=1e-8, atol=1e-18)
+
+
+def test_gp_covariance_oracle(psr):
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    cov = psr.make_time_correlated_noise_cov("red_noise")
+    entry = psr.signal_model["red_noise"]
+    f, psd = entry["f"], entry["psd"]
+    df = np.diff(np.concatenate([[0.0], f]))
+    basis = np.zeros((len(psr.toas), 2 * len(f)))
+    for i in range(len(f)):
+        basis[:, 2 * i] = np.cos(2 * np.pi * f[i] * psr.toas)
+        basis[:, 2 * i + 1] = np.sin(2 * np.pi * f[i] * psr.toas)
+    want = basis @ np.diag(np.repeat(psd * df, 2)) @ basis.T
+    np.testing.assert_allclose(cov, want, rtol=1e-7, atol=1e-22)
+
+
+def test_draw_noise_model_paths(psr):
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    sample = psr.draw_noise_model()
+    assert sample.shape == psr.residuals.shape and np.any(sample != 0)
+    smooth = psr.draw_noise_model(residuals=psr.residuals)
+    # Wiener smoother of a pure red-noise realization stays close to it
+    assert np.corrcoef(smooth, psr.residuals)[0, 1] > 0.9
+
+
+def test_cgw_injection_and_reconstruction(psr):
+    psr.add_cgw(costheta=0.12, phi=3.2, cosinc=0.3, log10_mc=9.2, log10_fgw=-8.3,
+                log10_h=-13.5, phase0=1.6, psi=1.2, psrterm=True)
+    assert "cgw" in psr.signal_model and "0" in psr.signal_model["cgw"]
+    assert np.any(psr.residuals != 0)
+    np.testing.assert_allclose(psr.reconstruct_signal(["cgw"]), psr.residuals,
+                               rtol=1e-10, atol=1e-20)
+    # second CGW appends
+    psr.add_cgw(costheta=-0.5, phi=1.0, cosinc=0.0, log10_mc=8.8, log10_fgw=-8.0,
+                log10_h=-14.0, phase0=0.3, psi=0.4, psrterm=False)
+    assert "1" in psr.signal_model["cgw"]
+
+
+def test_add_deterministic_and_reconstruct(psr):
+    def ramp(toas, slope=1e-15):
+        return slope * (toas - toas[0])
+
+    psr.add_deterministic(ramp, slope=2e-15)
+    assert "ramp" in psr.signal_model
+    np.testing.assert_allclose(psr.reconstruct_signal(["ramp"]), psr.residuals,
+                               rtol=1e-12)
+
+
+def test_coordinate_roundtrip():
+    theta, phi = Pulsar.radec_to_thetaphi([12, 30], [45, 30])
+    ra, dec = Pulsar.thetaphi_to_radec(theta, phi)
+    assert ra == [12, 30] and dec == [45, 30]
+
+
+def test_seed_reproducibility():
+    a = Pulsar(_toas(n=100), 1e-6, 1.0, 1.0, seed=77)
+    b = Pulsar(_toas(n=100), 1e-6, 1.0, 1.0, seed=77)
+    a.add_white_noise()
+    b.add_white_noise()
+    np.testing.assert_array_equal(a.residuals, b.residuals)
+    a.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=3.0)
+    b.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=3.0)
+    np.testing.assert_array_equal(a.residuals, b.residuals)
+
+
+def test_pickle_roundtrip_enterprise_contract(psr):
+    psr.add_white_noise()
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=3.0)
+    blob = pickle.dumps([psr])
+    loaded = pickle.loads(blob)[0]
+    for attr in ("name", "toas", "toaerrs", "residuals", "Mmat", "fitpars",
+                 "backend_flags", "freqs", "theta", "phi", "pos", "pdist"):
+        got, want = getattr(loaded, attr), getattr(psr, attr)
+        if isinstance(want, np.ndarray):
+            np.testing.assert_array_equal(got, want)
+        else:
+            assert np.all(got == want)
+    assert loaded.signal_model.keys() == psr.signal_model.keys()
+    # loaded object is still usable
+    loaded.add_white_noise()
+
+
+def test_make_fake_array_basic():
+    psrs = make_fake_array(npsrs=4, Tobs=10, ntoas=100, gaps=False, toaerr=1e-6,
+                           pdist=1.0, backends="NUPPI", seed=11)
+    assert len(psrs) == 4
+    for psr in psrs:
+        assert len(psr.toas) == 100
+        assert {"red_noise", "dm_gp"} <= set(psr.signal_model)
+        assert np.any(psr.residuals != 0)
+        assert psr.backends[0].startswith("NUPPI")
+
+
+def test_make_fake_array_reproducible():
+    a = make_fake_array(npsrs=3, Tobs=8, ntoas=50, seed=13)
+    b = make_fake_array(npsrs=3, Tobs=8, ntoas=50, seed=13)
+    for pa, pb in zip(a, b):
+        assert pa.name == pb.name
+        np.testing.assert_array_equal(pa.residuals, pb.residuals)
+
+
+def test_make_fake_array_gaps_and_random_config():
+    psrs = make_fake_array(npsrs=3, seed=17)
+    for psr in psrs:
+        assert 10 * const.yr <= psr.Tspan + 2e7
+        assert np.all(np.diff(psr.toas) >= 0)
+
+
+def test_copy_array_with_epta_noisedict():
+    noisedict = json.load(open(EPTA_NOISEDICT))
+    src = make_fake_array(npsrs=2, Tobs=10, ntoas=60, gaps=False, toaerr=1e-6,
+                          backends=["EFF.P200.1380", "EFF.P217.1380"], seed=19)
+    for psr, name in zip(src, ["J1738+0333", "J2322+2057"]):
+        psr.name = name
+    copies = copy_array(src, noisedict, seed=19)
+    for cp, psr in zip(copies, src):
+        assert cp.name == psr.name
+        np.testing.assert_array_equal(cp.toas, psr.toas)
+        np.testing.assert_array_equal(cp.residuals, psr.residuals)
+        np.testing.assert_array_equal(cp.Mmat, psr.Mmat)
+        # noisedict filtered down to this pulsar's keys from the EPTA file
+        assert cp.noisedict and all(cp.name in key for key in cp.noisedict)
+    assert copies[0].noisedict["J1738+0333_EFF.P200.1380_efac"] == \
+        noisedict["J1738+0333_EFF.P200.1380_efac"]
+
+
+def test_failed_reinjection_leaves_state_intact(psr):
+    """Regression: a rejected re-injection (bad custom_psd length) must not
+    half-subtract the previous realization from the residuals."""
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
+    before = psr.residuals.copy()
+    with pytest.raises(ValueError):
+        psr.add_red_noise(spectrum="custom", custom_psd=np.ones(5))
+    np.testing.assert_array_equal(psr.residuals, before)
+    np.testing.assert_allclose(psr.reconstruct_signal(["red_noise"]), before,
+                               rtol=1e-9, atol=1e-18)
+
+
+def test_unseeded_pulsars_get_distinct_noise():
+    """Regression: unseeded pulsars must not share identical RNG streams."""
+    a = Pulsar(_toas(n=100), 1e-6, 1.0, 2.0)
+    b = Pulsar(_toas(n=100), 1e-6, 0.5, 4.0)
+    a.add_white_noise()
+    b.add_white_noise()
+    assert not np.allclose(a.residuals, b.residuals)
+
+
+def test_make_fake_array_per_pulsar_arrays():
+    """Regression: Tobs/ntoas as per-pulsar arrays are a documented input shape."""
+    psrs = make_fake_array(npsrs=2, Tobs=[10.0, 12.0], ntoas=np.array([100, 120]),
+                           gaps=False, toaerr=1e-6, seed=23)
+    assert [p.nepochs for p in psrs] == [100, 120]
+
+
+def test_remove_system_noise_cleans_noisedict():
+    """Regression: system-noise hyper-parameters must leave the noisedict when the
+    signal is removed (composite stored key vs name-prefixed noisedict key)."""
+    psr = Pulsar(_toas(n=60), 1e-6, 1.0, 1.0, backends=["A.1400"], seed=29)
+    psr.add_system_noise(backend="A.1400", components=5, log10_A=-13.0, gamma=3.0)
+    assert any("system_noise" in key for key in psr.noisedict)
+    psr.remove_signal(["A.1400_system_noise_A.1400"])
+    assert not any("system_noise" in key for key in psr.noisedict)
+    psr.add_system_noise(backend="A.1400", components=5, log10_A=-13.0, gamma=3.0)
+    psr.make_ideal()
+    assert not any("system_noise" in key for key in psr.noisedict)
+
+
+def test_package_exposes_reference_layout():
+    import fakepta_tpu
+
+    assert hasattr(fakepta_tpu, "fake_pta")
+    assert fakepta_tpu.fake_pta.Pulsar is Pulsar

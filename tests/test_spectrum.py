@@ -1,0 +1,111 @@
+"""Unit tests: PSD models vs closed-form numpy oracles (SURVEY.md §4 test pyramid, unit)."""
+
+import numpy as np
+import pytest
+
+from fakepta_tpu import constants as const
+from fakepta_tpu import spectrum
+
+
+@pytest.fixture
+def f():
+    tspan = 15 * const.yr
+    return np.arange(1, 31) / tspan
+
+
+def test_powerlaw_closed_form(f):
+    log10_A, gamma = -14.5, 13 / 3
+    want = (10**log10_A) ** 2 / (12 * np.pi**2) * const.fyr ** (gamma - 3) * f ** (-gamma)
+    got = np.asarray(spectrum.powerlaw(f, log10_A=log10_A, gamma=gamma))
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_turnover_closed_form(f):
+    kw = dict(log10_A=-15.0, gamma=4.33, lf0=-8.5, kappa=10 / 3, beta=0.5)
+    hcf = 10 ** kw["log10_A"] * (f / const.fyr) ** ((3 - kw["gamma"]) / 2)
+    hcf /= (1 + (10 ** kw["lf0"] / f) ** kw["kappa"]) ** kw["beta"]
+    want = hcf**2 / 12 / np.pi**2 / f**3
+    np.testing.assert_allclose(np.asarray(spectrum.turnover(f, **kw)), want, rtol=1e-10)
+
+
+def test_t_process_scales_powerlaw(f):
+    alphas = np.linspace(0.5, 2.0, len(f))
+    got = np.asarray(spectrum.t_process(f, log10_A=-15, gamma=3, alphas=alphas))
+    base = np.asarray(spectrum.powerlaw(f, log10_A=-15, gamma=3))
+    np.testing.assert_allclose(got, base * alphas, rtol=1e-10)
+
+
+def test_t_process_adapt_single_bin(f):
+    got = np.asarray(spectrum.t_process_adapt(f, log10_A=-15, gamma=3, alphas_adapt=5.0, nfreq=7))
+    base = np.asarray(spectrum.powerlaw(f, log10_A=-15, gamma=3))
+    want = base.copy()
+    want[7] *= 5.0
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+
+def test_turnover_knee_closed_form(f):
+    kw = dict(log10_A=-15.0, gamma=13 / 3, lfb=-8.7, lfk=-8.0, kappa=10 / 3, delta=0.1)
+    hcf = (
+        10 ** kw["log10_A"]
+        * (f / const.fyr) ** ((3 - kw["gamma"]) / 2)
+        * (1 + f / 10 ** kw["lfk"]) ** kw["delta"]
+        / np.sqrt(1 + (10 ** kw["lfb"] / f) ** kw["kappa"])
+    )
+    want = hcf**2 / 12 / np.pi**2 / f**3
+    np.testing.assert_allclose(np.asarray(spectrum.turnover_knee(f, **kw)), want, rtol=1e-10)
+
+
+def test_broken_powerlaw_closed_form(f):
+    kw = dict(log10_A=-15.0, gamma=13 / 3, delta=0.1, log10_fb=-8.5, kappa=0.1)
+    hcf = (
+        10 ** kw["log10_A"]
+        * (f / const.fyr) ** ((3 - kw["gamma"]) / 2)
+        * (1 + (f / 10 ** kw["log10_fb"]) ** (1 / kw["kappa"])) ** (kw["kappa"] * (kw["gamma"] - kw["delta"]) / 2)
+    )
+    want = hcf**2 / 12 / np.pi**2 / f**3
+    np.testing.assert_allclose(np.asarray(spectrum.broken_powerlaw(f, **kw)), want, rtol=1e-10)
+
+
+def test_free_spectrum_bin_power(f):
+    tspan = 1.0 / f[0]
+    rho = np.linspace(-7, -6, len(f))
+    psd = np.asarray(spectrum.free_spectrum(f, log10_rho=rho))
+    df = np.diff(np.concatenate([[0.0], f]))
+    np.testing.assert_allclose(psd * df, 10 ** (2 * rho), rtol=1e-10)
+    assert tspan > 0
+
+
+def test_registry_contents_and_params():
+    for name in ["powerlaw", "turnover", "t_process", "t_process_adapt", "turnover_knee", "broken_powerlaw"]:
+        assert name in spectrum.SPECTRA
+        assert name in spectrum.spec
+    assert spectrum.spec_params["powerlaw"] == ["log10_A", "gamma"]
+    assert spectrum.spec_params["turnover"] == ["log10_A", "gamma", "lf0", "kappa", "beta"]
+    assert spectrum.spec_params["broken_powerlaw"] == ["log10_A", "gamma", "delta", "log10_fb", "kappa"]
+
+
+def test_register_spectrum_extension():
+    @spectrum.register_spectrum
+    def flat_psd(f, level=-30.0):
+        import jax.numpy as jnp
+
+        return 10.0**level * jnp.ones_like(jnp.asarray(f))
+
+    assert "flat_psd" in spectrum.spec
+    assert spectrum.spec_params["flat_psd"] == ["level"]
+    del spectrum.SPECTRA["flat_psd"], spectrum.spec["flat_psd"], spectrum.spec_params["flat_psd"]
+
+
+def test_evaluate_unknown_raises(f):
+    with pytest.raises(KeyError):
+        spectrum.evaluate("nope", f)
+
+
+def test_psds_survive_float32():
+    """TPU regression: naive evaluation underflows float32 (1e-42 intermediates);
+    the log-space forms must stay finite and positive in float32."""
+    f32 = (np.arange(1, 31) / (15 * const.yr)).astype(np.float32)
+    for name in ["powerlaw", "turnover", "turnover_knee", "broken_powerlaw"]:
+        psd = np.asarray(spectrum.evaluate(name, f32, log10_A=-14.5, gamma=13 / 3))
+        assert psd.dtype == np.float32
+        assert np.all(np.isfinite(psd)) and np.all(psd > 0), name
