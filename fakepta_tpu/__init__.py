@@ -8,4 +8,4 @@ user-facing API, while the functional TPU engine lives in ``ops/``, ``models/`` 
 
 __version__ = "0.1.0"
 
-from . import constants, fake_pta, spectrum  # noqa: F401
+from . import constants, correlated_noises, ephemeris, fake_pta, spectrum  # noqa: F401

@@ -1,0 +1,286 @@
+"""Cross-pulsar correlated signals: ORFs, the GWB injector, correlation diagnostics.
+
+Public-API parity with the reference's ``correlated_noises.py`` (functions
+``get_correlation``/``get_correlations``/``bin_curve``/``create_gw_antenna_pattern``/
+``hd``/``anisotropic``/``monopole``/``dipole``/``curn``/``add_common_correlated_noise``/
+``add_roemer_delay``, ``correlated_noises.py:14-172``), re-architected TPU-first:
+
+- ORF matrices are closed-form expressions on the (npsr, 3) position block
+  (:mod:`fakepta_tpu.ops.gwb`), not O(npsr^2) Python double loops;
+- the GWB draw factorizes the ORF **once** and draws every (cos/sin, component)
+  amplitude in a single correlated block — the reference re-Choleskys the ORF
+  inside ``np.random.multivariate_normal`` twice per frequency component
+  (``correlated_noises.py:153-160``); the sampling law is identical;
+- the dead "joint dense covariance" draft the reference ships commented out
+  (``correlated_noises.py:175-213``) is implemented for real here as
+  :func:`add_common_correlated_noise_gp`, exactly (GP evaluated at the true TOAs,
+  no interpolation grid).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import spectrum as spectrum_lib
+from .ops import gwb as gwb_ops
+from .utils import rng as rng_utils
+
+__all__ = [
+    "get_correlation", "get_correlations", "bin_curve", "create_gw_antenna_pattern",
+    "hd", "anisotropic", "monopole", "dipole", "curn",
+    "add_common_correlated_noise", "add_common_correlated_noise_gp",
+    "add_roemer_delay",
+]
+
+
+# ---------------------------------------------------------------------------
+# diagnostics (ref correlated_noises.py:14-47)
+# ---------------------------------------------------------------------------
+
+def get_correlation(psr_a, psr_b, res_a, res_b):
+    """Pair statistic ``<r_a . r_b>/n`` and angular separation (ref :14-19)."""
+    angle = np.arccos(np.clip(np.dot(psr_a.pos, psr_b.pos), -1.0, 1.0))
+    corr = np.dot(res_a, res_b) / len(res_a)
+    return corr, angle
+
+
+def get_correlations(psrs, res):
+    """All-pair cross-correlations, separations and autocorrelations (ref :21-34).
+
+    ``res`` is a per-pulsar sequence of residual vectors; pairs need equal lengths
+    (as in the reference, where the statistic is only meaningful on a common grid).
+    """
+    npsr = len(psrs)
+    corrs, angles, autocorrs = [], [], []
+    for i in range(npsr):
+        for j in range(i + 1):
+            if len(res[i]) != len(res[j]):
+                raise ValueError(
+                    "get_correlations needs equal-length residual vectors per pair "
+                    f"(pulsars {i} and {j} have {len(res[i])} vs {len(res[j])}); "
+                    "use parallel.montecarlo ensemble statistics for ragged arrays")
+            c, a = get_correlation(psrs[i], psrs[j], res[i], res[j])
+            if i == j:
+                autocorrs.append(c)
+            else:
+                corrs.append(c)
+                angles.append(a)
+    return np.array(corrs), np.array(angles), np.array(autocorrs)
+
+
+def bin_curve(corrs, angles, bins):
+    """Angular-binned mean/std of pair correlations (ref :36-47)."""
+    edges = np.linspace(0.0, np.pi, bins + 1)
+    centers = edges[:-1] + 0.5 * (edges[1] - edges[0])
+    mean, std = [], []
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        sel = (angles > lo) & (angles < hi)
+        mean.append(np.mean(corrs[sel]) if sel.any() else np.nan)
+        std.append(np.std(corrs[sel]) if sel.any() else np.nan)
+    return np.array(mean), np.array(std), np.array(centers)
+
+
+# ---------------------------------------------------------------------------
+# ORFs — reference-parity wrappers over the vectorized ops (ref :50-108)
+# ---------------------------------------------------------------------------
+
+def _positions(psrs):
+    if isinstance(psrs, np.ndarray) and psrs.ndim == 2:
+        return psrs
+    return np.stack([psr.pos for psr in psrs])
+
+
+def create_gw_antenna_pattern(pos, gwtheta, gwphi):
+    """F+, Fx, cosMu of one pulsar against a grid of GW directions (ref :50-60)."""
+    fplus, fcross, cosmu = gwb_ops.antenna_patterns(
+        np.asarray(pos)[None, :], gwtheta, gwphi)
+    return np.asarray(fplus)[0], np.asarray(fcross)[0], np.asarray(cosmu)[0]
+
+
+def hd(psrs):
+    """Hellings-Downs ORF matrix (ref :62-71)."""
+    return np.asarray(gwb_ops.hd_orf(_positions(psrs)))
+
+
+def anisotropic(psrs, h_map):
+    """ORF from a HEALPix intensity map (ref :73-89)."""
+    return np.asarray(gwb_ops.anisotropic_orf(_positions(psrs), np.asarray(h_map)))
+
+
+def monopole(psrs):
+    return np.asarray(gwb_ops.monopole_orf(_positions(psrs)))
+
+
+def dipole(psrs):
+    return np.asarray(gwb_ops.dipole_orf(_positions(psrs)))
+
+
+def curn(psrs):
+    return np.asarray(gwb_ops.curn_orf(_positions(psrs)))
+
+
+# ---------------------------------------------------------------------------
+# the GWB injector (ref :111-160)
+# ---------------------------------------------------------------------------
+
+def _array_tspan(psrs):
+    return (max(psr.toas.max() for psr in psrs)
+            - min(psr.toas.min() for psr in psrs))
+
+
+def _resolve_common_psd(spectrum, f_psd, custom_psd, kwargs):
+    if spectrum == "custom":
+        if custom_psd is None or len(custom_psd) != len(f_psd):
+            raise ValueError('"custom_psd" and "f_psd" must be given with equal length')
+        return np.asarray(custom_psd, dtype=np.float64), {}
+    if spectrum not in spectrum_lib.SPECTRA:
+        raise KeyError(f"unknown spectrum {spectrum!r}")
+    psd = np.asarray(spectrum_lib.evaluate(spectrum, f_psd, **kwargs), dtype=np.float64)
+    return psd, kwargs
+
+
+def add_common_correlated_noise(psrs, orf="hd", spectrum="powerlaw", name="gw",
+                                idx=0, components=30, freqf=1400, custom_psd=None,
+                                f_psd=None, h_map=None, seed=None, **kwargs):
+    """Inject a cross-pulsar-correlated common signal (the GWB path, ref :111-160).
+
+    One shared frequency grid over the array Tspan; per-pulsar ``signal_model``
+    entries under ``'<name>_common'`` (orf/spectrum/hmap/f/psd/fourier/nbin/idx —
+    the exact provenance contract, SURVEY.md §2.4); re-injection subtracts the
+    previous realization. Correlation across pulsars is exact: amplitudes are drawn
+    with covariance ORF via a single Cholesky + matmul instead of the reference's
+    two dense MVN draws per component.
+    """
+    signal_name = f"{name}_common" if name is not None else "common"
+    tspan = _array_tspan(psrs)
+    if f_psd is None:
+        f_psd = np.arange(1, components + 1) / tspan
+    f_psd = np.asarray(f_psd, dtype=np.float64)
+    components = len(f_psd)
+    df = np.diff(np.concatenate([[0.0], f_psd]))
+
+    psd_gwb, resolved = _resolve_common_psd(spectrum, f_psd, custom_psd, kwargs)
+    if resolved:
+        for psr in psrs:
+            psr.update_noisedict(signal_name, resolved)
+
+    # one Cholesky for the whole injection; (2, ncomp, npsr) correlated block
+    pos = _positions(psrs)
+    orfs = gwb_ops.build_orf(orf, pos, h_map)
+    chol = gwb_ops.orf_cholesky(orfs)
+    key = rng_utils.as_key(seed) if seed is not None else \
+        rng_utils.KeyStream(None, "gwb").next()
+    coeffs = np.asarray(gwb_ops.draw_correlated_coeffs(key, chol, psd_gwb))
+
+    for n, psr in enumerate(psrs):
+        if signal_name in psr.signal_model:
+            # reconstruct_signal uses the OLD entry's stored freqf/idx scaling
+            psr.residuals = psr.residuals - psr.reconstruct_signal([signal_name])
+        entry = {
+            "orf": orf,
+            "spectrum": spectrum,
+            "hmap": h_map,
+            "f": f_psd,
+            "psd": psd_gwb,
+            "fourier": coeffs[:, :, n] / np.sqrt(df)[None, :],
+            "nbin": components,
+            "idx": idx,
+            "freqf": freqf,
+        }
+        psr.signal_model[signal_name] = entry
+        psr.residuals = psr.residuals + psr._reconstruct_gp(entry, None, None)
+    return np.asarray(orfs)
+
+
+def add_common_correlated_noise_gp(psrs, orf="hd", spectrum="powerlaw", name="gw",
+                                   components=30, freqf=1400, custom_psd=None,
+                                   f_psd=None, h_map=None, seed=None, **kwargs):
+    """Joint dense-covariance GWB draw — the reference's dead draft made real.
+
+    Builds the full cross-pulsar covariance ``C[(a,t),(b,u)] = orf_ab *
+    sum_k psd_k df_k [cos cos + sin sin]`` **at the true TOAs** (the commented-out
+    reference draft used a 100-point grid + cubic interpolation,
+    ``correlated_noises.py:175-213``), Cholesky-samples the whole PTA in one shot
+    on device and scatters the realization into the residuals. Exact but
+    O((sum n_toa)^3): intended for moderate arrays and for validating the
+    factorized injector; records ``{'realization': ...}`` per pulsar so
+    reconstruct/remove still work.
+    """
+    signal_name = f"{name}_common" if name is not None else "common"
+    tspan = _array_tspan(psrs)
+    if f_psd is None:
+        f_psd = np.arange(1, components + 1) / tspan
+    f_psd = np.asarray(f_psd, dtype=np.float64)
+    df = np.diff(np.concatenate([[0.0], f_psd]))
+    psd_gwb, resolved = _resolve_common_psd(spectrum, f_psd, custom_psd, kwargs)
+    if resolved:
+        for psr in psrs:
+            psr.update_noisedict(signal_name, resolved)
+
+    pos = _positions(psrs)
+    orfs = np.asarray(gwb_ops.build_orf(orf, pos, h_map))
+    sizes = [len(psr.toas) for psr in psrs]
+    total = sum(sizes)
+    if total > 20000:
+        raise ValueError(
+            f"joint covariance would be {total}x{total}; use "
+            "add_common_correlated_noise (factorized, exact) at this scale")
+
+    # per-pulsar basis F_a sqrt(S df) so C_ab = orf_ab B_a B_b^T
+    weights = np.sqrt(psd_gwb * df)
+    bases = []
+    for psr in psrs:
+        cyc = np.outer(psr.toas, f_psd) % 1.0
+        phase = 2.0 * np.pi * cyc
+        bases.append(np.concatenate([np.cos(phase) * weights, np.sin(phase) * weights],
+                                    axis=1))
+    cov = np.empty((total, total))
+    offsets = np.concatenate([[0], np.cumsum(sizes)])
+    for a in range(len(psrs)):
+        for b in range(len(psrs)):
+            cov[offsets[a]:offsets[a + 1], offsets[b]:offsets[b + 1]] = \
+                orfs[a, b] * (bases[a] @ bases[b].T)
+
+    key = rng_utils.as_key(seed) if seed is not None else \
+        rng_utils.KeyStream(None, "gwb_gp").next()
+    # the joint covariance has rank 2*ncomp*npsr < N by construction; regularize
+    # relative to its own scale before factorizing
+    jitter = 1e-10 * np.mean(np.diag(cov))
+    chol = np.linalg.cholesky(cov + jitter * np.eye(total))
+    z = np.asarray(jax.random.normal(key, (total,), dtype=jnp.float64)) \
+        if jax.config.jax_enable_x64 else np.asarray(
+            jax.random.normal(key, (total,)), dtype=np.float64)
+    draw = chol @ z
+
+    for a, psr in enumerate(psrs):
+        if signal_name in psr.signal_model:
+            # realization- and fourier-aware: a prior factorized injection under the
+            # same name is subtracted with its own stored scaling
+            psr.residuals = psr.residuals - psr.reconstruct_signal([signal_name])
+        realization = draw[offsets[a]:offsets[a + 1]]
+        psr.signal_model[signal_name] = {
+            "orf": orf, "spectrum": spectrum, "hmap": h_map, "f": f_psd,
+            "psd": psd_gwb, "nbin": len(f_psd), "idx": 0,
+            "realization": realization,
+        }
+        psr.residuals = psr.residuals + realization
+    return orfs
+
+
+# ---------------------------------------------------------------------------
+# array-level Roemer delay (ref :163-172)
+# ---------------------------------------------------------------------------
+
+def add_roemer_delay(psrs, planet, d_mass=0.0, d_Om=0.0, d_omega=0.0, d_inc=0.0,
+                     d_a=0.0, d_e=0.0, d_l0=0.0):
+    """Accumulate a perturbed-ephemeris Roemer delay into every pulsar (ref :163-172)."""
+    for psr in psrs:
+        if getattr(psr, "ephem", None) is None:
+            raise ValueError(f'"ephem" not found in pulsar {psr.name}')
+    for psr in psrs:
+        psr.residuals = psr.residuals + psr.ephem.roemer_delay(
+            psr.toas, psr.pos, planet, d_mass, d_Om, d_omega, d_inc, d_a, d_e, d_l0)

@@ -502,6 +502,7 @@ class Pulsar:
             "fourier": coeffs / np.sqrt(df)[None, :],
             "nbin": nbin,
             "idx": idx,
+            "freqf": freqf,
         }
         if mask is None:
             self.residuals = self.residuals + delta
@@ -588,8 +589,11 @@ class Pulsar:
     # covariances, sampling, reconstruction
     # ------------------------------------------------------------------
 
-    def make_time_correlated_noise_cov(self, signal="", freqf=1400):
-        """Dense covariance of one stored GP signal (ref ``fake_pta.py:389-420``)."""
+    def make_time_correlated_noise_cov(self, signal="", freqf=None):
+        """Dense covariance of one stored GP signal (ref ``fake_pta.py:389-420``).
+
+        ``freqf=None`` uses the signal's stored reference frequency.
+        """
         if "system_noise" in signal:
             backend = signal.split("system_noise_")[1]
             stored = f"{backend}_system_noise_{backend}" \
@@ -600,6 +604,8 @@ class Pulsar:
         else:
             stored, mask = signal, None
         entry = self.signal_model[stored]
+        if freqf is None:
+            freqf = entry.get("freqf", 1400.0)
         f_psd = np.asarray(entry["f"], dtype=np.float64)
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, entry["idx"], freqf, mask)
@@ -641,12 +647,15 @@ class Pulsar:
             return np.asarray(_k_mvn(key, cov, 1e-24))
         return np.asarray(_k_wiener(cov, red_cov, np.asarray(residuals)))
 
-    def reconstruct_signal(self, signals=None, freqf=1400):
+    def reconstruct_signal(self, signals=None, freqf=None):
         """Rebuild the time-domain realization of stored signals (ref :526-555).
 
         Handles GP signals (red/dm/chrom/common), backend-masked system noise,
         multi-CGW entries (the reference's ``for ncgw in len(...)`` TypeError is
-        fixed), and any recorded deterministic waveforms.
+        fixed), and any recorded deterministic waveforms. ``freqf=None`` (default)
+        uses each signal's *stored* reference frequency — signals injected with a
+        non-default ``freqf`` reconstruct with the scale they were injected at; an
+        explicit value overrides for every signal (reference semantics).
         """
         if signals is None:
             signals = list(self.signal_model)
@@ -672,9 +681,15 @@ class Pulsar:
             elif signal in self.signal_model and "fourier" in self.signal_model[signal]:
                 entry = self.signal_model[signal]
                 sig += self._reconstruct_gp(entry, freqf, None)
+            elif signal in self.signal_model and \
+                    "realization" in self.signal_model[signal]:
+                # joint-covariance common signals store the time-domain draw itself
+                sig += self.signal_model[signal]["realization"]
         return sig
 
     def _reconstruct_gp(self, entry, freqf, mask):
+        if freqf is None:
+            freqf = entry.get("freqf", 1400.0)
         f_psd = np.asarray(entry["f"], dtype=np.float64)
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, entry["idx"], freqf, mask)
@@ -683,7 +698,7 @@ class Pulsar:
         out = np.asarray(_k_reconstruct(phase, scale, four, df_pad))
         return out[:ntoa]
 
-    def remove_signal(self, signals=None, freqf=1400):
+    def remove_signal(self, signals=None, freqf=None):
         """Subtract a signal's realization and forget it (ref ``fake_pta.py:557-567``)."""
         if signals is None:
             signals = list(self.signal_model)

@@ -1,0 +1,137 @@
+"""Cross-pulsar correlated signal kernels: ORF matrices and the GWB draw.
+
+The reference builds ORF matrices with O(npsr^2) Python double loops
+(``correlated_noises.py:62-108``) and draws the correlated Fourier amplitudes with
+*two dense multivariate_normal calls per frequency component*, each re-factorizing
+the ORF (``correlated_noises.py:153-160``). Here the ORF is a closed-form matrix
+expression on the (npsr, 3) position block, the Cholesky happens **once**, and all
+components/realizations are drawn as one matmul:
+
+    coeffs[r, k, c, :] = sqrt(psd_c) * L z[r, k, c, :]     (L = chol(ORF))
+
+which is exactly the reference's sampling law (cov of the pulsar axis = ORF,
+independent across cos/sin k, components c, realizations r) with the per-component
+Cholesky hoisted out. This is the north-star kernel of BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .healpix import npix2nside, pix2ang_ring
+
+
+def hd_orf(pos):
+    """Hellings-Downs ORF matrix from unit positions (npsr, 3).
+
+    Off-diagonal ``1.5 x ln x - 0.25 x + 0.5`` with ``x = (1 - cos theta)/2``;
+    diagonal 1 (ref ``correlated_noises.py:62-71``).
+    """
+    pos = jnp.asarray(pos)
+    cosang = jnp.clip(pos @ pos.T, -1.0, 1.0)
+    x = (1.0 - cosang) / 2.0
+    x_safe = jnp.where(x > 0.0, x, 1.0)  # ln(1)=0 on/near the diagonal
+    off = 1.5 * x_safe * jnp.log(x_safe) - 0.25 * x_safe + 0.5
+    return jnp.where(jnp.eye(pos.shape[0], dtype=bool), 1.0, off)
+
+
+def dipole_orf(pos):
+    """cos(theta_ab) off-diagonal, 1 on the diagonal (ref :95-104)."""
+    pos = jnp.asarray(pos)
+    cosang = jnp.clip(pos @ pos.T, -1.0, 1.0)
+    return jnp.where(jnp.eye(pos.shape[0], dtype=bool), 1.0, cosang)
+
+
+def monopole_orf(pos):
+    """All-ones matrix (ref :91-93)."""
+    n = jnp.asarray(pos).shape[0]
+    return jnp.ones((n, n))
+
+
+def curn_orf(pos):
+    """Common uncorrelated red noise: identity (ref :106-108)."""
+    return jnp.eye(jnp.asarray(pos).shape[0])
+
+
+def antenna_patterns(pos, gwtheta, gwphi):
+    """F+, Fx, cosMu for a batch of pulsars against a batch of GW directions.
+
+    pos: (npsr, 3); gwtheta/gwphi: (nsrc,). Returns (npsr, nsrc) each.
+    Geometry identical to the reference's ``create_gw_antenna_pattern``
+    (``correlated_noises.py:50-60``), vectorized over both axes.
+    """
+    pos = jnp.asarray(pos)
+    gwtheta = jnp.asarray(gwtheta)
+    gwphi = jnp.asarray(gwphi)
+    sin_t, cos_t = jnp.sin(gwtheta), jnp.cos(gwtheta)
+    sin_p, cos_p = jnp.sin(gwphi), jnp.cos(gwphi)
+    m = jnp.stack([sin_p, -cos_p, jnp.zeros_like(gwphi)], axis=-1)       # (nsrc, 3)
+    n = jnp.stack([-cos_t * cos_p, -cos_t * sin_p, sin_t], axis=-1)
+    omhat = jnp.stack([-sin_t * cos_p, -sin_t * sin_p, -cos_t], axis=-1)
+    mdp = pos @ m.T                                                      # (npsr, nsrc)
+    ndp = pos @ n.T
+    odp = pos @ omhat.T
+    fplus = 0.5 * (mdp**2 - ndp**2) / (1.0 + odp)
+    fcross = mdp * ndp / (1.0 + odp)
+    return fplus, fcross, -odp
+
+
+def anisotropic_orf(pos, h_map):
+    """ORF from a HEALPix (RING) intensity map (ref ``correlated_noises.py:73-89``).
+
+    ``orf_ab = 1.5 k_ab sum_pix (F+_a F+_b + Fx_a Fx_b) h_pix / npix`` with
+    ``k_ab = 2`` on the diagonal — one masked einsum instead of the reference's
+    double loop re-deriving the patterns npsr^2 times.
+    """
+    h_map = jnp.asarray(h_map)
+    npix = h_map.shape[0]
+    theta, phi = pix2ang_ring(npix2nside(npix), np.arange(npix))
+    fplus, fcross, _ = antenna_patterns(pos, jnp.asarray(theta), jnp.asarray(phi))
+    weighted = (fplus * h_map[None, :]) @ fplus.T + (fcross * h_map[None, :]) @ fcross.T
+    orf = 1.5 * weighted / npix
+    return jnp.where(jnp.eye(jnp.asarray(pos).shape[0], dtype=bool), 2.0 * orf, orf)
+
+
+ORF_BUILDERS = {
+    "hd": hd_orf,
+    "monopole": monopole_orf,
+    "dipole": dipole_orf,
+    "curn": curn_orf,
+}
+
+
+def build_orf(orf, pos, h_map=None):
+    """Dispatch an ORF by name (``'hd' | 'monopole' | 'dipole' | 'curn' |
+    'anisotropic'``), mirroring the reference's dispatch (:148-152)."""
+    if orf in ORF_BUILDERS:
+        return ORF_BUILDERS[orf](pos)
+    if orf == "anisotropic":
+        if h_map is None:
+            raise ValueError("anisotropic ORF requires h_map")
+        return anisotropic_orf(pos, h_map)
+    raise KeyError(f"unknown ORF {orf!r}; known: {sorted(ORF_BUILDERS) + ['anisotropic']}")
+
+
+def orf_cholesky(orf, jitter=1e-10):
+    """Cholesky factor of the (jittered) ORF — computed once per injection."""
+    orf = jnp.asarray(orf)
+    n = orf.shape[0]
+    return jnp.linalg.cholesky(orf + jitter * jnp.eye(n, dtype=orf.dtype))
+
+
+def draw_correlated_coeffs(key, chol, psd, shape_prefix=()):
+    """Raw GWB Fourier coefficients with exact cross-pulsar correlation.
+
+    Returns ``coeffs`` of shape ``(*shape_prefix, 2, ncomp, npsr)`` where the pulsar
+    axis has covariance ORF and each (cos/sin, component) slice is scaled by
+    ``sqrt(psd_c)`` — the one-shot equivalent of the reference's per-component MVN
+    loop (``correlated_noises.py:153-160``).
+    """
+    psd = jnp.asarray(psd)
+    ncomp = psd.shape[0]
+    npsr = chol.shape[0]
+    z = jax.random.normal(key, (*shape_prefix, 2, ncomp, npsr), dtype=chol.dtype)
+    corr = z @ chol.T
+    return corr * jnp.sqrt(psd)[None, :, None]

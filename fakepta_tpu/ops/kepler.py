@@ -1,0 +1,41 @@
+"""Vectorized fixed-iteration Kepler solvers.
+
+The reference solves Kepler's equation with a *sequential* ``scipy.optimize.newton``
+Python loop over TOAs, warm-started from the previous solution
+(``ephemeris.py:49-56``) — a hot serial path. Newton's iteration for
+``E - e sin E = M`` converges quadratically from ``E0 = M + e sin M`` for any
+planetary eccentricity (max |e| ~ 0.21 for Mercury), so a fixed small iteration
+count vectorizes over all TOAs at once with no data-dependent control flow —
+the shape XLA wants.
+
+Two implementations of the same math: a numpy one (float64 host path used by the
+ephemeris module, where orbit *differences* demand f64) and a jnp one (jittable,
+for on-device batch use).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+_DEFAULT_ITERS = 10
+
+
+def kepler_newton_np(M, e, iters: int = _DEFAULT_ITERS):
+    """Eccentric anomaly E solving E - e sin E = M (numpy, vectorized, float64)."""
+    M = np.asarray(M, dtype=np.float64)
+    e = np.broadcast_to(np.asarray(e, dtype=np.float64), M.shape)
+    E = M + e * np.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * np.sin(E) - M) / (1.0 - e * np.cos(E))
+    return E
+
+
+def kepler_newton(M, e, iters: int = _DEFAULT_ITERS):
+    """Eccentric anomaly (jnp, jittable; fixed iteration count, no while_loop)."""
+    M = jnp.asarray(M)
+    e = jnp.asarray(e)
+    E = M + e * jnp.sin(M)
+    for _ in range(iters):
+        E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
+    return E

@@ -1,0 +1,129 @@
+"""Ephemeris tests: Kepler solver vs oracle, orbit geometry, Roemer-delay purity."""
+
+import numpy as np
+import pytest
+
+import fakepta_tpu.correlated_noises as cn
+from fakepta_tpu import constants as const
+from fakepta_tpu.ephemeris import Ephemeris
+from fakepta_tpu.fake_pta import Pulsar
+from fakepta_tpu.ops.kepler import kepler_newton, kepler_newton_np
+
+
+def test_kepler_solver_exact():
+    rng = np.random.default_rng(0)
+    E_true = rng.uniform(0, 2 * np.pi, 500)
+    e = rng.uniform(0, 0.25, 500)
+    M = E_true - e * np.sin(E_true)
+    E_np = kepler_newton_np(M, e)
+    np.testing.assert_allclose(np.mod(E_np, 2 * np.pi), np.mod(E_true, 2 * np.pi),
+                               rtol=1e-12, atol=1e-12)
+    E_j = np.asarray(kepler_newton(M, e))
+    np.testing.assert_allclose(E_j, E_np, rtol=1e-12, atol=1e-12)
+
+
+@pytest.fixture(scope="module")
+def eph():
+    return Ephemeris()
+
+
+def test_planet_table(eph):
+    assert eph.planet_names == ["mercury", "venus", "earth", "mars", "jupiter",
+                                "saturn", "uranus", "neptune"]
+    assert eph.mass_ss > const.Msun
+    # Jupiter dominates the planetary mass
+    assert eph.planets["jupiter"]["mass"] / (eph.mass_ss - const.Msun) > 0.7
+
+
+def test_earth_orbit_geometry(eph):
+    # sample one year of TOAs around J2000 (MJD 51544.5 in seconds)
+    t0 = 51544.5 * const.day
+    times = t0 + np.linspace(0, const.yr, 365)
+    orbit = eph.get_orbit_planet(times, "earth")
+    r = np.linalg.norm(orbit, axis=1)
+    au_ls = const.AU / const.c  # ~499.005 light-seconds
+    # distance stays within Earth's perihelion/aphelion range
+    assert np.all(r > 0.97 * au_ls) and np.all(r < 1.03 * au_ls)
+    # orbit closes over one year
+    assert np.linalg.norm(orbit[0] - orbit[-1]) < 0.05 * au_ls
+    # obliquity: z-amplitude ~ sin(23.4 deg) of the orbital radius
+    assert abs(np.abs(orbit[:, 2]).max() / au_ls - np.sin(const.OBLIQUITY)) < 0.02
+
+
+def test_orbit_period(eph):
+    t0 = 51544.5 * const.day
+    times = t0 + np.linspace(0, 2 * 87.9691 * const.day, 400)
+    orbit = eph.get_orbit_planet(times, "mercury")
+    x = orbit[:, 0]
+    # two full periods -> x returns near its start twice
+    crossings = np.sum(np.diff(np.sign(x - x[0])) != 0)
+    assert crossings >= 3
+
+
+def test_planetssb_layout_and_velocities(eph):
+    t0 = 51544.5 * const.day
+    times = t0 + np.linspace(0, 30 * const.day, 10)
+    ssb = eph.get_planet_ssb(times)
+    assert ssb.shape == (10, 8, 6)
+    # velocities are filled (reference leaves np.empty garbage) and consistent
+    # with finite differences of the positions
+    earth = ssb[:, 2, :]
+    v_fd = np.gradient(earth[:, 0], times)
+    np.testing.assert_allclose(earth[:, 3], v_fd, rtol=0.05, atol=1e-9)
+    # Earth orbital speed ~ 1e-4 c
+    speed = np.linalg.norm(earth[:, 3:], axis=1)
+    np.testing.assert_allclose(speed, 1e-4, rtol=0.15)
+
+
+def test_sunssb_reflex_scale(eph):
+    t0 = 51544.5 * const.day
+    times = t0 + np.linspace(0, 12 * const.yr, 50)
+    sun = eph.get_sunssb(times)
+    r = np.linalg.norm(sun, axis=1)
+    # dominated by Jupiter: ~ (m_J/Msun) * 5.2 AU ~ 2.5 light-seconds
+    assert 0.5 < r.max() < 5.0
+
+
+def test_add_planet(eph):
+    e2 = Ephemeris()
+    e2.add_planet("planet9", 1e25, 200000.0, [0.1, 0.0], [10.0, 0.0], [20.0, 0.0],
+                  [60.0, 0.0], [0.1, 0.0], [0.0, 0.0])
+    assert "planet9" in e2.planet_names
+    assert e2.mass_ss > eph.mass_ss
+
+
+def test_roemer_delay_pure_and_scaled(eph):
+    t0 = 51544.5 * const.day
+    toas = t0 + np.linspace(0, 5 * const.yr, 200)
+    pos = np.array([0.3, 0.5, np.sqrt(1 - 0.34)])
+    elements_before = {k: [list(v) if isinstance(v, list) else v for v in el.values()]
+                       for k, el in eph.planets.items()}
+    d1 = eph.roemer_delay(toas, pos, "jupiter", d_a=1e-4)
+    d2 = eph.roemer_delay(toas, pos, "jupiter", d_a=1e-4)
+    # purity: same answer twice, stored elements untouched (reference mutates)
+    np.testing.assert_array_equal(d1, d2)
+    elements_after = {k: [list(v) if isinstance(v, list) else v for v in el.values()]
+                      for k, el in eph.planets.items()}
+    assert str(elements_before) == str(elements_after)
+    # zero perturbation -> exactly zero delay
+    np.testing.assert_allclose(eph.roemer_delay(toas, pos, "jupiter"), 0.0, atol=1e-25)
+    # mass perturbation scales linearly
+    dm = eph.roemer_delay(toas, pos, "jupiter", d_mass=1e24)
+    dm2 = eph.roemer_delay(toas, pos, "jupiter", d_mass=2e24)
+    np.testing.assert_allclose(dm2, 2 * dm, rtol=1e-9)
+    # magnitude sanity: delta_a of 1e-4 AU on jupiter -> sub-microsecond delay
+    assert 0 < np.abs(d1).max() < 1e-4
+
+
+def test_pulsar_with_ephem_and_array_roemer(eph):
+    t0 = 51544.5 * const.day
+    toas = t0 + np.linspace(0, 3 * const.yr, 50)
+    psrs = [Pulsar(toas, 1e-6, 1.0, 1.0, ephem=eph, seed=1),
+            Pulsar(toas, 1e-6, 2.0, 4.0, ephem=eph, seed=2)]
+    assert psrs[0].planetssb.shape == (50, 8, 6)
+    cn.add_roemer_delay(psrs, "saturn", d_Om=1e-3)
+    assert all(np.any(p.residuals != 0) for p in psrs)
+
+    bare = Pulsar(toas, 1e-6, 0.5, 0.5, seed=3)
+    with pytest.raises(ValueError):
+        cn.add_roemer_delay([bare], "saturn", d_Om=1e-3)
