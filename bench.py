@@ -1,0 +1,54 @@
+"""North-star benchmark (BASELINE.md): HD-correlated GWB Monte Carlo throughput.
+
+Config: 100-pulsar, 15-year array, weekly cadence (780 TOAs), white + power-law
+red + DM noise per pulsar, HD-correlated GWB (A=2e-15, gamma=13/3, 30 components).
+Metric: PTA realizations/sec/chip. The baseline target is BASELINE.json's
+"10k realizations in < 60 s on a v5e-8", i.e. 10000/(60*8) ~= 20.8 real/s/chip;
+``vs_baseline`` is the measured multiple of that target.
+
+Prints exactly one JSON line.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    n_devices = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    tspan = float(batch.tspan_common)
+    f = np.arange(1, 31) / tspan
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15), gamma=13 / 3))
+    sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                            mesh=make_mesh(jax.devices()))
+
+    nreal = 10_000
+    chunk = 10_000  # fits v5e HBM (~7 GB peak); per-chunk dispatch otherwise dominates
+    sim.run(chunk, seed=99, chunk=chunk)         # compile + warm up
+    t0 = time.perf_counter()
+    out = sim.run(nreal, seed=1, chunk=chunk)
+    elapsed = time.perf_counter() - t0
+    assert out["curves"].shape[0] == nreal and np.all(np.isfinite(out["curves"]))
+
+    per_chip = nreal / elapsed / n_devices
+    baseline = 10_000 / (60.0 * 8)               # the v5e-8 target, per chip
+    print(json.dumps({
+        "metric": "PTA realizations/sec/chip (100 psr, 15 yr, HD-correlated GWB)",
+        "value": round(per_chip, 2),
+        "unit": "realizations/s/chip",
+        "vs_baseline": round(per_chip / baseline, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,172 @@
+"""PulsarBatch: the padded, masked, device-resident representation of a PTA.
+
+The reference iterates Python lists of ``Pulsar`` objects everywhere; the scale
+axes (npsr x n_toa x n_realizations) are all Python loops (SURVEY.md §5). The batch
+engine flips the layout: every per-pulsar quantity becomes one padded ``(npsr,
+max_toa)`` array plus a validity mask, hyper-parameters become dense arrays, and
+the whole structure is a pytree that moves through jit/vmap/shard_map untouched.
+
+Precision design: absolute TOAs (1e8-1e9 s) cannot live in float32, so the batch
+stores *normalized* times — ``t/Tspan_pulsar`` for per-pulsar noises and
+``t/Tspan_array`` (common origin) for cross-pulsar signals. Fourier phases are then
+``2 pi n t_norm`` with ``n <= ~100``: float32-exact to ~1e-5 rad. The standard GP
+grid ``f_n = n/Tspan`` makes every bin width ``df = 1/Tspan``, a scalar per pulsar.
+
+Cited reference behavior being batched: per-pulsar Fourier injection
+(``fake_pta.py:357-387``), white noise (``fake_pta.py:201-230``), the GWB draw
+(``correlated_noises.py:111-160``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .utils.masks import stack_ragged
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class PulsarBatch:
+    """Device-ready PTA state. All arrays padded to (npsr, max_toa)."""
+
+    # data fields (pytree leaves)
+    t_own: jax.Array        # (P, T) toas normalized by each pulsar's Tspan
+    t_common: jax.Array     # (P, T) toas normalized by the array Tspan, common origin
+    mask: jax.Array         # (P, T) bool validity
+    freqs: jax.Array        # (P, T) observing frequency [MHz]
+    sigma2: jax.Array       # (P, T) white-noise variance per TOA [s^2]
+    pos: jax.Array          # (P, 3) sky unit vectors
+    red_psd: jax.Array      # (P, NR) red-noise PSD on the per-pulsar grid (0 = off)
+    dm_psd: jax.Array       # (P, ND) DM-noise PSD (0 = off)
+    df_own: jax.Array       # (P,) per-pulsar bin width 1/Tspan_p [Hz]
+    tspan_common: jax.Array # () array Tspan [s]
+
+    @property
+    def npsr(self) -> int:
+        return self.t_own.shape[0]
+
+    @property
+    def max_toa(self) -> int:
+        return self.t_own.shape[1]
+
+    @classmethod
+    def from_pulsars(cls, psrs: Sequence, n_red: int = 30, n_dm: int = 100,
+                     dtype=jnp.float32) -> "PulsarBatch":
+        """Pack a list of (facade or ENTERPRISE-style) pulsars into one batch.
+
+        PSDs are taken from each pulsar's injected ``signal_model`` when present
+        (padded with zeros up to the batch bin counts), else zero (signal off).
+        White-noise variances resolve from the noisedict per backend, exactly as
+        ``add_white_noise`` does (``fake_pta.py:214-217``).
+        """
+        toas_list = [np.asarray(p.toas, dtype=np.float64) for p in psrs]
+        tmin = min(t.min() for t in toas_list)
+        tmax = max(t.max() for t in toas_list)
+        tspan_common = tmax - tmin
+
+        toas_pad, mask = stack_ragged(toas_list)
+        npsr, T = toas_pad.shape
+
+        t_own = np.zeros((npsr, T))
+        freqs = np.zeros((npsr, T))
+        sigma2 = np.zeros((npsr, T))
+        red_psd = np.zeros((npsr, n_red))
+        dm_psd = np.zeros((npsr, n_dm))
+        df_own = np.zeros(npsr)
+        pos = np.stack([np.asarray(p.pos, dtype=np.float64) for p in psrs])
+
+        for i, p in enumerate(psrs):
+            n = len(toas_list[i])
+            tspan = toas_list[i].max() - toas_list[i].min()
+            df_own[i] = 1.0 / tspan
+            t_own[i, :n] = (toas_list[i] - toas_list[i].min()) / tspan
+            freqs[i, :n] = np.asarray(p.freqs, dtype=np.float64)[:n]
+            freqs[i, n:] = 1400.0
+            # white-noise variance from the noisedict, per backend
+            efac = np.ones(n)
+            equad = np.full(n, -np.inf)
+            for backend in np.unique(np.asarray(p.backend_flags)):
+                sel = np.asarray(p.backend_flags) == backend
+                efac[sel] = p.noisedict.get(f"{p.name}_{backend}_efac", 1.0)
+                equad[sel] = p.noisedict.get(f"{p.name}_{backend}_log10_tnequad", -8.0)
+            sigma2[i, :n] = (efac**2 * np.asarray(p.toaerrs[:n]) ** 2
+                             + 10.0 ** (2.0 * equad))
+            for signal, target in (("red_noise", red_psd), ("dm_gp", dm_psd)):
+                entry = getattr(p, "signal_model", {}).get(signal)
+                if entry is not None:
+                    k = min(len(entry["psd"]), target.shape[1])
+                    target[i, :k] = entry["psd"][:k]
+
+        t_common = (toas_pad - tmin) / tspan_common * mask
+
+        return cls(
+            t_own=jnp.asarray(t_own, dtype),
+            t_common=jnp.asarray(t_common, dtype),
+            mask=jnp.asarray(mask),
+            freqs=jnp.asarray(freqs, dtype),
+            sigma2=jnp.asarray(sigma2, dtype),
+            pos=jnp.asarray(pos, dtype),
+            red_psd=jnp.asarray(red_psd, dtype),
+            dm_psd=jnp.asarray(dm_psd, dtype),
+            df_own=jnp.asarray(df_own, dtype),
+            tspan_common=jnp.asarray(tspan_common, dtype),
+        )
+
+    @classmethod
+    def synthetic(cls, npsr: int = 100, ntoa: int = 780, tspan_years: float = 15.0,
+                  toaerr: float = 1e-7, n_red: int = 30, n_dm: int = 100,
+                  red_log10_A: float = -14.0, red_gamma: float = 13 / 3,
+                  dm_log10_A: float = -13.8, dm_gamma: float = 3.0,
+                  seed: int = 0, dtype=jnp.float32) -> "PulsarBatch":
+        """Fabricate a synthetic uniform-cadence array directly as a batch —
+        the benchmark configuration generator (BASELINE.md configs 3-5)."""
+        from . import constants as const
+        from . import spectrum as spectrum_lib
+
+        rng = np.random.default_rng(seed)
+        tspan = tspan_years * const.yr
+        toas = np.linspace(0.0, tspan, ntoa)
+        costh = rng.uniform(-1, 1, npsr)
+        phi = rng.uniform(0, 2 * np.pi, npsr)
+        pos = np.stack([np.sqrt(1 - costh**2) * np.cos(phi),
+                        np.sqrt(1 - costh**2) * np.sin(phi), costh], axis=-1)
+
+        t_norm = np.tile(toas / tspan, (npsr, 1))
+        mask = np.ones((npsr, ntoa), dtype=bool)
+        freqs = np.full((npsr, ntoa), 1400.0)
+        sigma2 = np.full((npsr, ntoa), toaerr**2)
+        f_red = np.arange(1, n_red + 1) / tspan
+        f_dm = np.arange(1, n_dm + 1) / tspan
+        red = np.asarray(spectrum_lib.powerlaw(f_red, red_log10_A, red_gamma))
+        dm = np.asarray(spectrum_lib.powerlaw(f_dm, dm_log10_A, dm_gamma))
+
+        return cls(
+            t_own=jnp.asarray(t_norm, dtype),
+            t_common=jnp.asarray(t_norm, dtype),
+            mask=jnp.asarray(mask),
+            freqs=jnp.asarray(freqs, dtype),
+            sigma2=jnp.asarray(sigma2, dtype),
+            pos=jnp.asarray(pos, dtype),
+            red_psd=jnp.asarray(np.tile(red, (npsr, 1)), dtype),
+            dm_psd=jnp.asarray(np.tile(dm, (npsr, 1)), dtype),
+            df_own=jnp.asarray(np.full(npsr, 1.0 / tspan), dtype),
+            tspan_common=jnp.asarray(tspan, dtype),
+        )
+
+
+def fourier_basis_norm(t_norm, nbin: int, scale=None):
+    """(…, T, 2, N) cos/sin basis from normalized time: phase = 2 pi n t_norm.
+
+    float32-safe by construction (phase argument <= 2 pi nbin).
+    """
+    n = jnp.arange(1, nbin + 1, dtype=t_norm.dtype)
+    phase = 2.0 * jnp.pi * t_norm[..., :, None] * n
+    basis = jnp.stack([jnp.cos(phase), jnp.sin(phase)], axis=-2)
+    if scale is not None:
+        basis = basis * scale[..., :, None, None]
+    return basis

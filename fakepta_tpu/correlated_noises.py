@@ -197,7 +197,7 @@ def add_common_correlated_noise(psrs, orf="hd", spectrum="powerlaw", name="gw",
 
 
 def add_common_correlated_noise_gp(psrs, orf="hd", spectrum="powerlaw", name="gw",
-                                   components=30, freqf=1400, custom_psd=None,
+                                   idx=0, components=30, freqf=1400, custom_psd=None,
                                    f_psd=None, h_map=None, seed=None, **kwargs):
     """Joint dense-covariance GWB draw — the reference's dead draft made real.
 
@@ -230,14 +230,15 @@ def add_common_correlated_noise_gp(psrs, orf="hd", spectrum="powerlaw", name="gw
             f"joint covariance would be {total}x{total}; use "
             "add_common_correlated_noise (factorized, exact) at this scale")
 
-    # per-pulsar basis F_a sqrt(S df) so C_ab = orf_ab B_a B_b^T
+    # per-pulsar basis F_a sqrt(S df), chromatic-scaled, so C_ab = orf_ab B_a B_b^T
     weights = np.sqrt(psd_gwb * df)
     bases = []
     for psr in psrs:
         cyc = np.outer(psr.toas, f_psd) % 1.0
         phase = 2.0 * np.pi * cyc
-        bases.append(np.concatenate([np.cos(phase) * weights, np.sin(phase) * weights],
-                                    axis=1))
+        chrom = ((freqf / np.asarray(psr.freqs)) ** idx)[:, None]
+        bases.append(chrom * np.concatenate([np.cos(phase) * weights,
+                                             np.sin(phase) * weights], axis=1))
     cov = np.empty((total, total))
     offsets = np.concatenate([[0], np.cumsum(sizes)])
     for a in range(len(psrs)):
@@ -264,7 +265,7 @@ def add_common_correlated_noise_gp(psrs, orf="hd", spectrum="powerlaw", name="gw
         realization = draw[offsets[a]:offsets[a + 1]]
         psr.signal_model[signal_name] = {
             "orf": orf, "spectrum": spectrum, "hmap": h_map, "f": f_psd,
-            "psd": psd_gwb, "nbin": len(f_psd), "idx": 0,
+            "psd": psd_gwb, "nbin": len(f_psd), "idx": idx, "freqf": freqf,
             "realization": realization,
         }
         psr.residuals = psr.residuals + realization

@@ -185,7 +185,7 @@ class Ephemeris:
         """
         times = np.asarray(times, dtype=np.float64)
         out = np.zeros((len(times), len(self.planet_names), 6))
-        for i, planet in enumerate(_ORDER):
+        for i, planet in enumerate(self.planet_names):
             pos, vel = self._orbit_and_velocity(times, planet)
             out[:, i, :3] = pos
             out[:, i, 3:] = vel

@@ -115,10 +115,18 @@ def build_orf(orf, pos, h_map=None):
 
 
 def orf_cholesky(orf, jitter=1e-10):
-    """Cholesky factor of the (jittered) ORF — computed once per injection."""
-    orf = jnp.asarray(orf)
-    n = orf.shape[0]
-    return jnp.linalg.cholesky(orf + jitter * jnp.eye(n, dtype=orf.dtype))
+    """Cholesky factor of the (jittered) ORF — computed once per injection.
+
+    Factorized in host float64 regardless of the jax x64 setting: ORFs like the
+    monopole (all-ones, rank 1) are exactly singular, and a float32 factorization
+    returns silent NaNs (1 + 1e-10 rounds to 1 at float32). This is per-injection
+    setup on an (npsr x npsr) matrix — precision costs nothing here. Callers cast
+    the factor to their compute dtype.
+    """
+    orf64 = np.asarray(orf, dtype=np.float64)
+    n = orf64.shape[0]
+    scaled = jitter * max(float(np.mean(np.diag(orf64))), 1.0)
+    return jnp.asarray(np.linalg.cholesky(orf64 + scaled * np.eye(n)))
 
 
 def draw_correlated_coeffs(key, chol, psd, shape_prefix=()):

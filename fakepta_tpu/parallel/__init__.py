@@ -1,0 +1,1 @@
+from . import mesh, montecarlo  # noqa: F401

@@ -1,0 +1,50 @@
+"""Device-mesh helpers: the framework's distributed-communication layer.
+
+The reference has no parallelism or communication backend at all (SURVEY.md §5);
+scaling here is pure SPMD: a 2-D ``jax.sharding.Mesh`` with a ``'real'`` axis for
+Monte-Carlo realizations (embarrassingly parallel, the data-parallel analog) and a
+``'psr'`` axis for pulsars (the model-parallel analog — cross-pulsar statistics
+ride XLA collectives: ``all_gather`` over 'psr', ``psum`` reductions over 'real').
+Collectives are inserted by shard_map/GSPMD over ICI on real hardware; the same
+program runs unchanged on the virtual CPU mesh used in tests.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+REAL_AXIS = "real"
+PSR_AXIS = "psr"
+
+
+def make_mesh(devices: Optional[Sequence] = None, psr_shards: int = 1) -> Mesh:
+    """Build the (real, psr) mesh over the given (default: all) devices.
+
+    ``psr_shards`` must divide the device count; the remaining devices go to the
+    realization axis. One device -> a 1x1 mesh, so every code path is identical on
+    a laptop CPU, one TPU chip, or a pod slice.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if len(devices) % psr_shards != 0:
+        raise ValueError(f"psr_shards={psr_shards} must divide {len(devices)} devices")
+    grid = np.array(devices).reshape(len(devices) // psr_shards, psr_shards)
+    return Mesh(grid, (REAL_AXIS, PSR_AXIS))
+
+
+def pad_to_multiple(n: int, k: int) -> int:
+    return int(math.ceil(n / k) * k)
+
+
+def batch_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (npsr, ...) batch arrays: split pulsars over the psr axis."""
+    return NamedSharding(mesh, P(PSR_AXIS))
+
+
+def realization_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for (nreal, ...) outputs: split realizations over the real axis."""
+    return NamedSharding(mesh, P(REAL_AXIS))

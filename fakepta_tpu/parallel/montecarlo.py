@@ -1,0 +1,246 @@
+"""Sharded Monte-Carlo ensemble engine — the north-star workload (BASELINE.md).
+
+Simulates thousands of independent PTA realizations (white + red + DM noise +
+HD-correlated GWB) entirely on device and reduces them to cross-correlation
+statistics. The reference has no ensemble machinery at all — config 5 of
+BASELINE.md ("10k-realization Monte Carlo of 100-psr HD GWB") exists only here.
+
+SPMD layout (see :mod:`fakepta_tpu.parallel.mesh`):
+
+- realizations shard over the ``'real'`` mesh axis (independent streams, zero
+  communication — the data-parallel axis);
+- pulsars shard over the ``'psr'`` axis; the GWB's cross-pulsar coupling is the
+  tiny (npsr x npsr) Cholesky matmul, which every psr-shard recomputes redundantly
+  from an identical per-realization key ("replicate the small, shard the large"),
+  so the *only* collective in the program is one ``all_gather`` of residual blocks
+  over 'psr' to form cross-correlation rows;
+- per-shard independence of the local noises comes from folding the realization
+  key with ``lax.axis_index('psr')``.
+
+Everything is a single jitted program per chunk; chunking bounds device memory at
+a few hundred MB regardless of the total realization count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..batch import PulsarBatch, fourier_basis_norm
+from ..ops import gwb as gwb_ops
+from ..utils import rng as rng_utils
+from .mesh import PSR_AXIS, REAL_AXIS, make_mesh
+
+
+@dataclasses.dataclass(frozen=True)
+class GWBConfig:
+    """Common-signal configuration for the ensemble simulator."""
+
+    psd: np.ndarray                 # (C,) PSD on the common grid n/Tspan_array
+    orf: str = "hd"
+    h_map: Optional[np.ndarray] = None
+    idx: float = 0.0
+    freqf: float = 1400.0
+
+
+def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
+                    include_white, include_red, include_dm, include_gwb):
+    """Simulate residual blocks for a chunk of realizations (shard_map body).
+
+    keys: (R_local,) per-realization keys (identical across psr shards).
+    batch: the *local* pulsar shard. Returns (R_local, P_local, T).
+    """
+    p_local = batch.t_own.shape[0]
+    pidx = lax.axis_index(PSR_AXIS)
+    dtype = batch.t_own.dtype
+
+    n_red = batch.red_psd.shape[1]
+    n_dm = batch.dm_psd.shape[1]
+    n_gwb = gwb_w.shape[0]
+
+    red_basis = fourier_basis_norm(batch.t_own, n_red)                 # (P,T,2,NR)
+    dm_scale = (1400.0 / batch.freqs) ** 2
+    dm_basis = fourier_basis_norm(batch.t_own, n_dm, scale=dm_scale)   # (P,T,2,ND)
+    gwb_scale = None
+    if gwb_idx:
+        gwb_scale = (gwb_freqf / batch.freqs) ** gwb_idx
+    gwb_basis = fourier_basis_norm(batch.t_common, n_gwb, scale=gwb_scale)
+
+    red_w = jnp.sqrt(batch.red_psd * batch.df_own[:, None])            # (P,NR)
+    dm_w = jnp.sqrt(batch.dm_psd * batch.df_own[:, None])              # (P,ND)
+    p_total = chol.shape[0]
+
+    def one(key):
+        local_key = jax.random.fold_in(key, pidx)
+        kw, kr, kd = jax.random.split(jax.random.fold_in(local_key, 0x51), 3)
+        res = jnp.zeros((p_local, batch.t_own.shape[1]), dtype)
+        if include_white:
+            z = jax.random.normal(kw, batch.sigma2.shape, dtype)
+            res = res + jnp.sqrt(batch.sigma2) * z
+        if include_red:
+            c = jax.random.normal(kr, (p_local, 2, n_red), dtype) * red_w[:, None, :]
+            res = res + jnp.einsum("ptkn,pkn->pt", red_basis, c)
+        if include_dm:
+            c = jax.random.normal(kd, (p_local, 2, n_dm), dtype) * dm_w[:, None, :]
+            res = res + jnp.einsum("ptkn,pkn->pt", dm_basis, c)
+        if include_gwb:
+            # identical z on every psr shard (key NOT folded with pidx): the
+            # (npsr x npsr) correlation matmul is replicated, then sliced locally
+            kg = jax.random.fold_in(key, 0x6B)
+            z = jax.random.normal(kg, (2, n_gwb, p_total), dtype)
+            corr = z @ chol.T
+            corr_local = lax.dynamic_slice_in_dim(corr, pidx * p_local, p_local, axis=2)
+            c = corr_local * gwb_w[None, :, None]                      # (2,C,P_loc)
+            res = res + jnp.einsum("ptkc,kcp->pt", gwb_basis, c)
+        return jnp.where(batch.mask, res, 0.0)
+
+    return jax.vmap(one)(keys)
+
+
+def _correlation_rows(res_local, mask_local):
+    """Cross-correlation rows via the program's one collective.
+
+    all_gathers the residual blocks over 'psr' and contracts local rows against
+    the full array: returns (R_local, P_local, P_total) pair correlations
+    normalized by valid-pair TOA counts (ref ``correlated_noises.py:14-19``
+    divides by the full TOA count; identical on uniform grids, correct under
+    padding here).
+    """
+    res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
+    mask_full = lax.all_gather(mask_local, PSR_AXIS, axis=0, tiled=True)
+    counts = jnp.einsum("pt,qt->pq", mask_local.astype(res_local.dtype),
+                        mask_full.astype(res_local.dtype))
+    counts = jnp.maximum(counts, 1.0)
+    corr = jnp.einsum("rpt,rqt->rpq", res_local, res_full)
+    return corr / counts
+
+
+class EnsembleSimulator:
+    """Compiled Monte-Carlo engine over a (real, psr) device mesh.
+
+    Produces per-realization pair-correlation matrices and angular-binned
+    correlation curves (the Hellings-Downs statistic) fully on device.
+    """
+
+    def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
+                 mesh=None, include=("white", "red", "dm", "gwb"), nbins: int = 15):
+        self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
+        n_real_shards = self.mesh.shape[REAL_AXIS]
+        n_psr_shards = self.mesh.shape[PSR_AXIS]
+        if batch.npsr % n_psr_shards != 0:
+            raise ValueError(
+                f"npsr={batch.npsr} must be divisible by the psr mesh axis "
+                f"({n_psr_shards}); pad the batch")
+        self.batch = batch
+        self.nbins = nbins
+        self._n_real_shards = n_real_shards
+        dtype = batch.t_own.dtype
+
+        if gwb is not None and "gwb" in include:
+            orf = gwb_ops.build_orf(gwb.orf, batch.pos, gwb.h_map)
+            # orf_cholesky factorizes in host float64 (singular ORFs NaN at f32)
+            self._chol = gwb_ops.orf_cholesky(orf).astype(dtype)
+            # the common frequency grid n/Tspan is implicit in the normalized-time
+            # basis; only the bin width enters the weights
+            df_common = 1.0 / batch.tspan_common
+            self._gwb_w = jnp.sqrt(jnp.asarray(gwb.psd, dtype) * df_common)
+            self._gwb_idx = gwb.idx
+            self._gwb_freqf = gwb.freqf
+        else:
+            self._chol = jnp.eye(batch.npsr, dtype=dtype)
+            self._gwb_w = jnp.zeros((1,), dtype)
+            self._gwb_idx = 0.0
+            self._gwb_freqf = 1400.0
+        include = tuple(include)
+        self._include = (("white" in include), ("red" in include),
+                         ("dm" in include), ("gwb" in include and gwb is not None))
+
+        # angular bins for the correlation curve (static, from positions)
+        pos = np.asarray(batch.pos, dtype=np.float64)
+        ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
+        edges = np.linspace(0.0, np.pi, nbins + 1)
+        bin_idx = np.clip(np.digitize(ang, edges) - 1, 0, nbins - 1)
+        offdiag = ~np.eye(batch.npsr, dtype=bool)
+        onehot = np.zeros((batch.npsr, batch.npsr, nbins))
+        onehot[np.arange(batch.npsr)[:, None], np.arange(batch.npsr)[None, :],
+               bin_idx] = 1.0
+        onehot *= offdiag[:, :, None]
+        self._bin_onehot = jnp.asarray(onehot, dtype)
+        self._bin_counts = jnp.maximum(self._bin_onehot.sum((0, 1)), 1.0)
+        self.bin_centers = edges[:-1] + 0.5 * (edges[1] - edges[0])
+
+        self._step = self._build_step()
+
+    def _build_step(self):
+        mesh = self.mesh
+        batch_specs = PulsarBatch(
+            t_own=P(PSR_AXIS), t_common=P(PSR_AXIS), mask=P(PSR_AXIS),
+            freqs=P(PSR_AXIS), sigma2=P(PSR_AXIS), pos=P(PSR_AXIS),
+            red_psd=P(PSR_AXIS), dm_psd=P(PSR_AXIS), df_own=P(PSR_AXIS),
+            tspan_common=P(),
+        )
+        inc_w, inc_r, inc_d, inc_g = self._include
+
+        def sharded(keys, batch, chol, gwb_w):
+            res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
+                                  self._gwb_freqf, inc_w, inc_r, inc_d, inc_g)
+            return _correlation_rows(res, batch.mask)
+
+        shmapped = jax.shard_map(
+            sharded, mesh=mesh,
+            in_specs=(P(REAL_AXIS), batch_specs, P(), P()),
+            out_specs=P(REAL_AXIS, PSR_AXIS),
+        )
+
+        @partial(jax.jit, static_argnums=(2,))
+        def step(base_key, offset, nreal):
+            # per-realization keys derived on device: one tiny transfer per chunk
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                offset + jnp.arange(nreal))
+            corr = shmapped(keys, self.batch, self._chol, self._gwb_w)
+            curves = (jnp.einsum("rpq,pqn->rn", corr, self._bin_onehot)
+                      / self._bin_counts)
+            # normalize by the mean autocorrelation to a unitless HD statistic
+            autos = jnp.einsum("rpp->r", corr) / corr.shape[1]
+            return curves, autos, corr
+
+        return step
+
+    def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False):
+        """Run the ensemble in device-memory-bounded chunks.
+
+        Returns a dict with per-realization binned curves ``(nreal, nbins)``,
+        mean autocorrelations ``(nreal,)``, bin centers and (optionally) the raw
+        pair-correlation matrices.
+        """
+        base = rng_utils.as_key(seed)
+        chunk = int(min(chunk, nreal))
+        chunk -= chunk % self._n_real_shards or 0
+        chunk = max(chunk, self._n_real_shards)
+        curves_out, autos_out, corr_out = [], [], []
+        done = 0
+        while done < nreal:
+            todo = min(chunk, nreal - done)
+            todo = max(self._n_real_shards,
+                       todo - todo % self._n_real_shards)
+            curves, autos, corr = self._step(base, done, todo)
+            curves_out.append(np.asarray(curves))
+            autos_out.append(np.asarray(autos))
+            if keep_corr:
+                corr_out.append(np.asarray(corr))
+            done += todo
+        out = {
+            "curves": np.concatenate(curves_out)[:nreal],
+            "autos": np.concatenate(autos_out)[:nreal],
+            "bin_centers": np.asarray(self.bin_centers),
+        }
+        if keep_corr:
+            out["corr"] = np.concatenate(corr_out)[:nreal]
+        return out

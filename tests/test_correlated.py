@@ -267,3 +267,33 @@ def test_add_planet_with_derived_semimajor_axis():
     # a period of one year must derive a ~ 1 AU
     r = np.linalg.norm(orbit, axis=1).max()
     np.testing.assert_allclose(r, const_mod.AU / const_mod.c, rtol=0.15)
+
+
+def test_monopole_orf_float32_cholesky_no_nan():
+    """Regression: the all-ones monopole ORF is exactly singular; the Cholesky
+    must be float64-safe so float32 pipelines get finite correlated draws."""
+    import jax
+
+    psrs = _array(4, ntoa=30)
+    cn.add_common_correlated_noise(psrs, orf="monopole", spectrum="powerlaw",
+                                   log10_A=-14.0, gamma=3.0, components=5, seed=3)
+    for p in psrs:
+        assert np.all(np.isfinite(p.residuals))
+    # and directly in float32
+    pos32 = np.stack([p.pos for p in psrs]).astype(np.float32)
+    chol = np.asarray(gwb_ops.orf_cholesky(gwb_ops.monopole_orf(pos32)))
+    assert np.all(np.isfinite(chol))
+
+
+def test_gp_joint_chromatic_scaling():
+    """Regression: the joint-GP variant honors idx/freqf chromatic scaling."""
+    psrs_a = _array(3, ntoa=30, seed=400)
+    psrs_b = _array(3, ntoa=30, seed=400)
+    cn.add_common_correlated_noise_gp(psrs_a, spectrum="powerlaw", log10_A=-13.5,
+                                      gamma=3.0, components=5, idx=0, seed=5)
+    cn.add_common_correlated_noise_gp(psrs_b, spectrum="powerlaw", log10_A=-13.5,
+                                      gamma=3.0, components=5, idx=2, freqf=700,
+                                      seed=5)
+    for pa, pb in zip(psrs_a, psrs_b):
+        assert pb.signal_model["gw_common"]["idx"] == 2
+        assert not np.allclose(pa.residuals, pb.residuals)

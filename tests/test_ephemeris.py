@@ -127,3 +127,14 @@ def test_pulsar_with_ephem_and_array_roemer(eph):
     bare = Pulsar(toas, 1e-6, 0.5, 0.5, seed=3)
     with pytest.raises(ValueError):
         cn.add_roemer_delay([bare], "saturn", d_Om=1e-3)
+
+
+def test_planetssb_includes_custom_planets(eph):
+    """Regression: custom bodies get real rows in planetssb, not silent zeros."""
+    e2 = Ephemeris()
+    e2.add_planet("planet9", 1e25, 365.25636, [0.0, 0.0], [0.0, 0.0], [0.0, 0.0],
+                  None, [0.05, 0.0], [0.0, 0.0])
+    t0 = 51544.5 * const.day
+    ssb = e2.get_planet_ssb(t0 + np.linspace(0, 30 * const.day, 5))
+    assert ssb.shape == (5, 9, 6)
+    assert np.any(ssb[:, 8, :3] != 0) and np.any(ssb[:, 8, 3:] != 0)
