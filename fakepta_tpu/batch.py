@@ -43,6 +43,7 @@ class PulsarBatch:
     pos: jax.Array          # (P, 3) sky unit vectors
     red_psd: jax.Array      # (P, NR) red-noise PSD on the per-pulsar grid (0 = off)
     dm_psd: jax.Array       # (P, ND) DM-noise PSD (0 = off)
+    chrom_psd: jax.Array    # (P, NC) chromatic (scattering, idx=4) PSD (0 = off)
     df_own: jax.Array       # (P,) per-pulsar bin width 1/Tspan_p [Hz]
     tspan_common: jax.Array # () array Tspan [s]
 
@@ -56,13 +57,17 @@ class PulsarBatch:
 
     @classmethod
     def from_pulsars(cls, psrs: Sequence, n_red: int = 30, n_dm: int = 100,
-                     dtype=jnp.float32) -> "PulsarBatch":
+                     n_chrom: int = 30, dtype=jnp.float32) -> "PulsarBatch":
         """Pack a list of (facade or ENTERPRISE-style) pulsars into one batch.
 
-        PSDs are taken from each pulsar's injected ``signal_model`` when present
-        (padded with zeros up to the batch bin counts), else zero (signal off).
-        White-noise variances resolve from the noisedict per backend, exactly as
-        ``add_white_noise`` does (``fake_pta.py:214-217``).
+        PSDs (red / DM / chromatic) are taken from each pulsar's injected
+        ``signal_model`` when present (padded with zeros up to the batch bin
+        counts), else zero (signal off). White-noise variances resolve from the
+        noisedict per backend, exactly as ``add_white_noise`` does
+        (``fake_pta.py:214-217``). Limitations vs the stateful shell: white noise
+        is diagonal EFAC/EQUAD only (ECORR epoch blocks live in
+        ``Pulsar.add_white_noise``), and per-backend system noises are not
+        batched.
         """
         toas_list = [np.asarray(p.toas, dtype=np.float64) for p in psrs]
         tmin = min(t.min() for t in toas_list)
@@ -77,6 +82,7 @@ class PulsarBatch:
         sigma2 = np.zeros((npsr, T))
         red_psd = np.zeros((npsr, n_red))
         dm_psd = np.zeros((npsr, n_dm))
+        chrom_psd = np.zeros((npsr, n_chrom))
         df_own = np.zeros(npsr)
         pos = np.stack([np.asarray(p.pos, dtype=np.float64) for p in psrs])
 
@@ -96,11 +102,22 @@ class PulsarBatch:
                 equad[sel] = p.noisedict.get(f"{p.name}_{backend}_log10_tnequad", -8.0)
             sigma2[i, :n] = (efac**2 * np.asarray(p.toaerrs[:n]) ** 2
                              + 10.0 ** (2.0 * equad))
-            for signal, target in (("red_noise", red_psd), ("dm_gp", dm_psd)):
+            for signal, idx, target in (("red_noise", 0.0, red_psd),
+                                        ("dm_gp", 2.0, dm_psd),
+                                        ("chrom_gp", 4.0, chrom_psd)):
                 entry = getattr(p, "signal_model", {}).get(signal)
                 if entry is not None:
+                    if float(entry.get("idx", idx)) != idx:
+                        raise ValueError(
+                            f"{p.name}.{signal} has idx={entry['idx']}; the batch "
+                            f"engine assumes the canonical chromatic index {idx}")
+                    # the ensemble kernel scales by (1400/nu)^idx; a non-default
+                    # reference frequency is a constant factor absorbed into the
+                    # PSD: sqrt(S)(freqf/nu)^idx = sqrt(S (freqf/1400)^2idx)(1400/nu)^idx
+                    freqf = float(entry.get("freqf", 1400.0))
                     k = min(len(entry["psd"]), target.shape[1])
-                    target[i, :k] = entry["psd"][:k]
+                    target[i, :k] = (np.asarray(entry["psd"][:k])
+                                     * (freqf / 1400.0) ** (2.0 * idx))
 
         t_common = (toas_pad - tmin) / tspan_common * mask
 
@@ -113,6 +130,7 @@ class PulsarBatch:
             pos=jnp.asarray(pos, dtype),
             red_psd=jnp.asarray(red_psd, dtype),
             dm_psd=jnp.asarray(dm_psd, dtype),
+            chrom_psd=jnp.asarray(chrom_psd, dtype),
             df_own=jnp.asarray(df_own, dtype),
             tspan_common=jnp.asarray(tspan_common, dtype),
         )
@@ -120,8 +138,10 @@ class PulsarBatch:
     @classmethod
     def synthetic(cls, npsr: int = 100, ntoa: int = 780, tspan_years: float = 15.0,
                   toaerr: float = 1e-7, n_red: int = 30, n_dm: int = 100,
+                  n_chrom: int = 30,
                   red_log10_A: float = -14.0, red_gamma: float = 13 / 3,
                   dm_log10_A: float = -13.8, dm_gamma: float = 3.0,
+                  chrom_log10_A: Optional[float] = None, chrom_gamma: float = 3.0,
                   seed: int = 0, dtype=jnp.float32) -> "PulsarBatch":
         """Fabricate a synthetic uniform-cadence array directly as a batch —
         the benchmark configuration generator (BASELINE.md configs 3-5)."""
@@ -144,6 +164,12 @@ class PulsarBatch:
         f_dm = np.arange(1, n_dm + 1) / tspan
         red = np.asarray(spectrum_lib.powerlaw(f_red, red_log10_A, red_gamma))
         dm = np.asarray(spectrum_lib.powerlaw(f_dm, dm_log10_A, dm_gamma))
+        if chrom_log10_A is None:
+            chrom = np.zeros(n_chrom)                    # signal off (default)
+        else:
+            f_chrom = np.arange(1, n_chrom + 1) / tspan
+            chrom = np.asarray(spectrum_lib.powerlaw(f_chrom, chrom_log10_A,
+                                                     chrom_gamma))
 
         return cls(
             t_own=jnp.asarray(t_norm, dtype),
@@ -154,6 +180,7 @@ class PulsarBatch:
             pos=jnp.asarray(pos, dtype),
             red_psd=jnp.asarray(np.tile(red, (npsr, 1)), dtype),
             dm_psd=jnp.asarray(np.tile(dm, (npsr, 1)), dtype),
+            chrom_psd=jnp.asarray(np.tile(chrom, (npsr, 1)), dtype),
             df_own=jnp.asarray(np.full(npsr, 1.0 / tspan), dtype),
             tspan_common=jnp.asarray(tspan, dtype),
         )

@@ -28,31 +28,35 @@ def hd_orf(pos):
 
     Off-diagonal ``1.5 x ln x - 0.25 x + 0.5`` with ``x = (1 - cos theta)/2``;
     diagonal 1 (ref ``correlated_noises.py:62-71``).
+
+    ORF builders run in host numpy float64 on purpose: they are one-time
+    O(npsr^2) setup feeding a Cholesky, and on TPU the default-precision f32
+    matmul (bf16 passes) perturbs the rank-deficient ORFs by O(1e-3) — enough
+    to make the factorization fail or skew cross-correlations.
     """
-    pos = jnp.asarray(pos)
-    cosang = jnp.clip(pos @ pos.T, -1.0, 1.0)
+    pos = np.asarray(pos, dtype=np.float64)
+    cosang = np.clip(pos @ pos.T, -1.0, 1.0)
     x = (1.0 - cosang) / 2.0
-    x_safe = jnp.where(x > 0.0, x, 1.0)  # ln(1)=0 on/near the diagonal
-    off = 1.5 * x_safe * jnp.log(x_safe) - 0.25 * x_safe + 0.5
-    return jnp.where(jnp.eye(pos.shape[0], dtype=bool), 1.0, off)
+    x_safe = np.where(x > 0.0, x, 1.0)  # ln(1)=0 on/near the diagonal
+    off = 1.5 * x_safe * np.log(x_safe) - 0.25 * x_safe + 0.5
+    return np.where(np.eye(pos.shape[0], dtype=bool), 1.0, off)
 
 
 def dipole_orf(pos):
     """cos(theta_ab) off-diagonal, 1 on the diagonal (ref :95-104)."""
-    pos = jnp.asarray(pos)
-    cosang = jnp.clip(pos @ pos.T, -1.0, 1.0)
-    return jnp.where(jnp.eye(pos.shape[0], dtype=bool), 1.0, cosang)
+    pos = np.asarray(pos, dtype=np.float64)
+    cosang = np.clip(pos @ pos.T, -1.0, 1.0)
+    return np.where(np.eye(pos.shape[0], dtype=bool), 1.0, cosang)
 
 
 def monopole_orf(pos):
     """All-ones matrix (ref :91-93)."""
-    n = jnp.asarray(pos).shape[0]
-    return jnp.ones((n, n))
+    return np.ones((np.asarray(pos).shape[0],) * 2)
 
 
 def curn_orf(pos):
     """Common uncorrelated red noise: identity (ref :106-108)."""
-    return jnp.eye(jnp.asarray(pos).shape[0])
+    return np.eye(np.asarray(pos).shape[0])
 
 
 def antenna_patterns(pos, gwtheta, gwphi):
@@ -62,14 +66,14 @@ def antenna_patterns(pos, gwtheta, gwphi):
     Geometry identical to the reference's ``create_gw_antenna_pattern``
     (``correlated_noises.py:50-60``), vectorized over both axes.
     """
-    pos = jnp.asarray(pos)
-    gwtheta = jnp.asarray(gwtheta)
-    gwphi = jnp.asarray(gwphi)
-    sin_t, cos_t = jnp.sin(gwtheta), jnp.cos(gwtheta)
-    sin_p, cos_p = jnp.sin(gwphi), jnp.cos(gwphi)
-    m = jnp.stack([sin_p, -cos_p, jnp.zeros_like(gwphi)], axis=-1)       # (nsrc, 3)
-    n = jnp.stack([-cos_t * cos_p, -cos_t * sin_p, sin_t], axis=-1)
-    omhat = jnp.stack([-sin_t * cos_p, -sin_t * sin_p, -cos_t], axis=-1)
+    pos = np.asarray(pos, dtype=np.float64)
+    gwtheta = np.asarray(gwtheta, dtype=np.float64)
+    gwphi = np.asarray(gwphi, dtype=np.float64)
+    sin_t, cos_t = np.sin(gwtheta), np.cos(gwtheta)
+    sin_p, cos_p = np.sin(gwphi), np.cos(gwphi)
+    m = np.stack([sin_p, -cos_p, np.zeros_like(gwphi)], axis=-1)         # (nsrc, 3)
+    n = np.stack([-cos_t * cos_p, -cos_t * sin_p, sin_t], axis=-1)
+    omhat = np.stack([-sin_t * cos_p, -sin_t * sin_p, -cos_t], axis=-1)
     mdp = pos @ m.T                                                      # (npsr, nsrc)
     ndp = pos @ n.T
     odp = pos @ omhat.T
@@ -85,13 +89,13 @@ def anisotropic_orf(pos, h_map):
     ``k_ab = 2`` on the diagonal — one masked einsum instead of the reference's
     double loop re-deriving the patterns npsr^2 times.
     """
-    h_map = jnp.asarray(h_map)
+    h_map = np.asarray(h_map, dtype=np.float64)
     npix = h_map.shape[0]
     theta, phi = pix2ang_ring(npix2nside(npix), np.arange(npix))
-    fplus, fcross, _ = antenna_patterns(pos, jnp.asarray(theta), jnp.asarray(phi))
+    fplus, fcross, _ = antenna_patterns(pos, theta, phi)
     weighted = (fplus * h_map[None, :]) @ fplus.T + (fcross * h_map[None, :]) @ fcross.T
     orf = 1.5 * weighted / npix
-    return jnp.where(jnp.eye(jnp.asarray(pos).shape[0], dtype=bool), 2.0 * orf, orf)
+    return np.where(np.eye(np.asarray(pos).shape[0], dtype=bool), 2.0 * orf, orf)
 
 
 ORF_BUILDERS = {
@@ -117,11 +121,11 @@ def build_orf(orf, pos, h_map=None):
 def orf_cholesky(orf, jitter=1e-10):
     """Cholesky factor of the (jittered) ORF — computed once per injection.
 
-    Factorized in host float64 regardless of the jax x64 setting: ORFs like the
-    monopole (all-ones, rank 1) are exactly singular, and a float32 factorization
-    returns silent NaNs (1 + 1e-10 rounds to 1 at float32). This is per-injection
-    setup on an (npsr x npsr) matrix — precision costs nothing here. Callers cast
-    the factor to their compute dtype.
+    Factorized in host float64: ORFs like the monopole (all-ones, rank 1) and
+    dipole (rank 3) are exactly singular, so a float32 factorization returns
+    silent NaNs, and the builders above stay in float64 end-to-end for the same
+    reason. This is per-injection setup on an (npsr x npsr) matrix — precision
+    costs nothing here. Callers cast the factor to their compute dtype.
     """
     orf64 = np.asarray(orf, dtype=np.float64)
     n = orf64.shape[0]

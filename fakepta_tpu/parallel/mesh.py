@@ -11,12 +11,11 @@ program runs unchanged on the virtual CPU mesh used in tests.
 
 from __future__ import annotations
 
-import math
 from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh
 
 REAL_AXIS = "real"
 PSR_AXIS = "psr"
@@ -34,17 +33,3 @@ def make_mesh(devices: Optional[Sequence] = None, psr_shards: int = 1) -> Mesh:
         raise ValueError(f"psr_shards={psr_shards} must divide {len(devices)} devices")
     grid = np.array(devices).reshape(len(devices) // psr_shards, psr_shards)
     return Mesh(grid, (REAL_AXIS, PSR_AXIS))
-
-
-def pad_to_multiple(n: int, k: int) -> int:
-    return int(math.ceil(n / k) * k)
-
-
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for (npsr, ...) batch arrays: split pulsars over the psr axis."""
-    return NamedSharding(mesh, P(PSR_AXIS))
-
-
-def realization_sharding(mesh: Mesh) -> NamedSharding:
-    """Sharding for (nreal, ...) outputs: split realizations over the real axis."""
-    return NamedSharding(mesh, P(REAL_AXIS))

@@ -51,7 +51,8 @@ class GWBConfig:
 
 
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
-                    include_white, include_red, include_dm, include_gwb):
+                    include_white, include_red, include_dm, include_chrom,
+                    include_gwb):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -68,6 +69,11 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     red_basis = fourier_basis_norm(batch.t_own, n_red)                 # (P,T,2,NR)
     dm_scale = (1400.0 / batch.freqs) ** 2
     dm_basis = fourier_basis_norm(batch.t_own, n_dm, scale=dm_scale)   # (P,T,2,ND)
+    if include_chrom:
+        n_chrom = batch.chrom_psd.shape[1]
+        chrom_basis = fourier_basis_norm(batch.t_own, n_chrom,
+                                         scale=(1400.0 / batch.freqs) ** 4)
+        chrom_w = jnp.sqrt(batch.chrom_psd * batch.df_own[:, None])    # (P,NC)
     gwb_scale = None
     if gwb_idx:
         gwb_scale = (gwb_freqf / batch.freqs) ** gwb_idx
@@ -79,7 +85,7 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     def one(key):
         local_key = jax.random.fold_in(key, pidx)
-        kw, kr, kd = jax.random.split(jax.random.fold_in(local_key, 0x51), 3)
+        kw, kr, kd, kc = jax.random.split(jax.random.fold_in(local_key, 0x51), 4)
         res = jnp.zeros((p_local, batch.t_own.shape[1]), dtype)
         if include_white:
             z = jax.random.normal(kw, batch.sigma2.shape, dtype)
@@ -90,6 +96,10 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
         if include_dm:
             c = jax.random.normal(kd, (p_local, 2, n_dm), dtype) * dm_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", dm_basis, c)
+        if include_chrom:
+            c = jax.random.normal(kc, (p_local, 2, n_chrom), dtype) \
+                * chrom_w[:, None, :]
+            res = res + jnp.einsum("ptkn,pkn->pt", chrom_basis, c)
         if include_gwb:
             # identical z on every psr shard (key NOT folded with pidx): the
             # (npsr x npsr) correlation matmul is replicated, then sliced locally
@@ -130,7 +140,8 @@ class EnsembleSimulator:
     """
 
     def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
-                 mesh=None, include=("white", "red", "dm", "gwb"), nbins: int = 15):
+                 mesh=None, include=("white", "red", "dm", "chrom", "gwb"),
+                 nbins: int = 15):
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
         n_psr_shards = self.mesh.shape[PSR_AXIS]
@@ -159,8 +170,12 @@ class EnsembleSimulator:
             self._gwb_idx = 0.0
             self._gwb_freqf = 1400.0
         include = tuple(include)
+        # the chrom stage only enters the program if its PSD is anywhere nonzero —
+        # the default synthetic batch has it off, so nothing is traced for it
+        has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0))
         self._include = (("white" in include), ("red" in include),
-                         ("dm" in include), ("gwb" in include and gwb is not None))
+                         ("dm" in include), ("chrom" in include and has_chrom),
+                         ("gwb" in include and gwb is not None))
 
         # angular bins for the correlation curve (static, from positions)
         pos = np.asarray(batch.pos, dtype=np.float64)
@@ -183,14 +198,15 @@ class EnsembleSimulator:
         batch_specs = PulsarBatch(
             t_own=P(PSR_AXIS), t_common=P(PSR_AXIS), mask=P(PSR_AXIS),
             freqs=P(PSR_AXIS), sigma2=P(PSR_AXIS), pos=P(PSR_AXIS),
-            red_psd=P(PSR_AXIS), dm_psd=P(PSR_AXIS), df_own=P(PSR_AXIS),
-            tspan_common=P(),
+            red_psd=P(PSR_AXIS), dm_psd=P(PSR_AXIS), chrom_psd=P(PSR_AXIS),
+            df_own=P(PSR_AXIS), tspan_common=P(),
         )
-        inc_w, inc_r, inc_d, inc_g = self._include
+        inc_w, inc_r, inc_d, inc_c, inc_g = self._include
 
         def sharded(keys, batch, chol, gwb_w):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, inc_w, inc_r, inc_d, inc_g)
+                                  self._gwb_freqf, inc_w, inc_r, inc_d, inc_c,
+                                  inc_g)
             return _correlation_rows(res, batch.mask)
 
         shmapped = jax.shard_map(
@@ -227,15 +243,15 @@ class EnsembleSimulator:
         curves_out, autos_out, corr_out = [], [], []
         done = 0
         while done < nreal:
-            todo = min(chunk, nreal - done)
-            todo = max(self._n_real_shards,
-                       todo - todo % self._n_real_shards)
-            curves, autos, corr = self._step(base, done, todo)
+            # every step runs at the full chunk size (the final one overshoots and
+            # is truncated below): _step is jitted with a static realization count,
+            # so a smaller tail chunk would recompile the whole SPMD program
+            curves, autos, corr = self._step(base, done, chunk)
             curves_out.append(np.asarray(curves))
             autos_out.append(np.asarray(autos))
             if keep_corr:
                 corr_out.append(np.asarray(corr))
-            done += todo
+            done += chunk
         out = {
             "curves": np.concatenate(curves_out)[:nreal],
             "autos": np.concatenate(autos_out)[:nreal],

@@ -1,0 +1,59 @@
+"""The shipped examples must run as-is (the reference's example script cannot:
+it hardcodes the author's absolute paths, SURVEY.md §4)."""
+
+import json
+import pickle
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+EXAMPLES = REPO / "examples"
+
+
+def _run(args, tmp_path):
+    out = tmp_path / "out.pkl"
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / "make_fake_array.py"), *args,
+         "--platform", "cpu", "--out", str(out)],
+        capture_output=True, text=True, timeout=560, cwd=REPO)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    with open(out, "rb") as fh:
+        psrs = pickle.load(fh)
+    return psrs
+
+
+def test_example_script_fresh_path(tmp_path):
+    psrs = _run(["--npsrs", "3", "--ntoas", "40", "--Tobs", "4"], tmp_path)
+    assert len(psrs) == 3
+    for psr in psrs:
+        # white + red + DM + GWB + CGW all landed (default custom_model has
+        # Sv=None, so chromatic noise is skipped — reference parity)
+        assert {"red_noise", "dm_gp", "gw_common", "cgw"} <= set(psr.signal_model)
+        assert psr.residuals.std() > 0
+
+
+def test_example_script_replay_path(tmp_path):
+    psrs = _run(["--replay"], tmp_path)
+    noisedict = json.loads((EXAMPLES / "simulated_data" /
+                            "noisedict_example.json").read_text())
+    models = json.loads((EXAMPLES / "simulated_data" /
+                         "custom_models_example.json").read_text())
+    assert {p.name for p in psrs} == set(models)
+    for psr in psrs:
+        # GP hyper-parameters were resolved from the shipped noisedict
+        key = f"{psr.name}_red_noise_log10_A"
+        assert psr.noisedict[key] == noisedict[key]
+        nbins = models[psr.name]["RN"]
+        assert psr.signal_model["red_noise"]["nbin"] == nbins
+
+
+def test_example_data_schema():
+    noisedict = json.loads((EXAMPLES / "simulated_data" /
+                            "noisedict_example.json").read_text())
+    models = json.loads((EXAMPLES / "simulated_data" /
+                         "custom_models_example.json").read_text())
+    assert all(isinstance(v, float) for v in noisedict.values())
+    for entry in models.values():
+        assert set(entry) == {"RN", "DM", "Sv"}
+        assert all(v is None or isinstance(v, int) for v in entry.values())

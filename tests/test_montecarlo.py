@@ -130,3 +130,58 @@ def test_mesh_validation(small_batch):
     with pytest.raises(ValueError):
         EnsembleSimulator(small_batch, gwb=None, mesh=make_mesh(jax.devices(),
                                                                 psr_shards=3))
+
+
+def test_chrom_band_carried_and_injected():
+    """from_pulsars must carry chrom_gp PSDs (idx=4 scaling) into the ensemble;
+    regression for the band being silently dropped."""
+    toas = np.linspace(0, 10 * const.yr, 96)
+    psrs = [Pulsar(toas, 1e-7, 1.0 + 0.1 * k, 0.3 * k + 0.2, seed=k,
+                   custom_model={"RN": 4, "DM": 4, "Sv": 30})
+            for k in range(2)]
+    for p in psrs:
+        p.add_chromatic_noise(spectrum="powerlaw", log10_A=-13.0, gamma=3.0)
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4, n_chrom=30)
+    np.testing.assert_allclose(
+        np.asarray(batch.chrom_psd)[0],
+        psrs[0].signal_model["chrom_gp"]["psd"], rtol=1e-5)
+
+    mesh = make_mesh(jax.devices()[:1])
+    sim_off = EnsembleSimulator(batch, mesh=mesh, include=("white",))
+    sim_on = EnsembleSimulator(batch, mesh=mesh, include=("white", "chrom"))
+    var_off = sim_off.run(64, seed=0, chunk=64)["autos"].mean()
+    var_on = sim_on.run(64, seed=0, chunk=64)["autos"].mean()
+    # a -13 chromatic GP at 1400 MHz dwarfs 1e-7 s white noise
+    assert var_on > 10 * var_off
+
+
+def test_run_tail_chunk_no_recompile():
+    """run() must reuse the compiled chunk-size step for the final partial chunk."""
+    batch = PulsarBatch.synthetic(npsr=4, ntoa=32, tspan_years=10.0, toaerr=1e-7,
+                                  n_red=4, n_dm=4, seed=2)
+    sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]))
+    with jax.log_compiles(False):
+        out = sim.run(nreal=10, seed=0, chunk=8)   # 8 + tail of 2
+    assert out["curves"].shape[0] == 10
+    # both loop iterations must hit the same compiled executable
+    assert sim._step._cache_size() == 1
+
+
+def test_from_pulsars_folds_freqf_and_rejects_bad_idx():
+    toas = np.linspace(0, 10 * const.yr, 64)
+    p = Pulsar(toas, 1e-7, 1.0, 1.0, seed=0,
+               custom_model={"RN": 4, "DM": None, "Sv": None})
+    f = np.arange(1, 5) / p.Tspan
+    psd = np.ones(4) * 1e-12
+    p.add_time_correlated_noise(signal="chrom_gp", spectrum="custom", psd=psd,
+                                f_psd=f, idx=4.0, freqf=400.0, seed=1)
+    batch = PulsarBatch.from_pulsars([p], n_red=4, n_dm=4, n_chrom=4)
+    np.testing.assert_allclose(np.asarray(batch.chrom_psd)[0],
+                               psd * (400.0 / 1400.0) ** 8, rtol=1e-5)
+
+    q = Pulsar(toas, 1e-7, 1.0, 1.0, seed=0,
+               custom_model={"RN": 4, "DM": None, "Sv": None})
+    q.add_time_correlated_noise(signal="red_noise", spectrum="custom", psd=psd,
+                                f_psd=f, idx=1.5, seed=1)
+    with pytest.raises(ValueError, match="canonical chromatic index"):
+        PulsarBatch.from_pulsars([q], n_red=4, n_dm=4)
