@@ -229,12 +229,23 @@ class EnsembleSimulator:
 
         return step
 
-    def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False):
+    def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
+            checkpoint=None, progress=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
         mean autocorrelations ``(nreal,)``, bin centers and (optionally) the raw
         pair-correlation matrices.
+
+        ``checkpoint``: a path — the run saves its accumulated outputs after every
+        chunk and, if the file already exists for the same (seed, nreal, chunk),
+        resumes after the last completed chunk. Because per-realization keys are
+        ``fold_in(base_key, absolute_index)``, the resumed stream is identical to
+        an uninterrupted run. The file is removed on successful completion.
+
+        ``progress``: callable ``(done, nreal) -> None`` invoked after each chunk
+        (the reference's observability is print statements; this is the hook for
+        logging/metrics without baking a sink in).
         """
         base = rng_utils.as_key(seed)
         chunk = int(min(chunk, nreal))
@@ -242,6 +253,25 @@ class EnsembleSimulator:
         chunk = max(chunk, self._n_real_shards)
         curves_out, autos_out, corr_out = [], [], []
         done = 0
+
+        ckpt = None
+        if checkpoint is not None:
+            from ..utils.io import EnsembleCheckpoint
+            if not isinstance(seed, (int, np.integer)):
+                raise TypeError("checkpointing requires an integer seed (the "
+                                "checkpoint stores it to validate a resume)")
+            ckpt = EnsembleCheckpoint(checkpoint)
+            state = ckpt.load(seed, nreal, chunk)
+            if state is not None:
+                done = int(state["done"])
+                curves_out.append(state["curves"])
+                autos_out.append(state["autos"])
+                if keep_corr:
+                    if "corr" not in state:
+                        raise ValueError("checkpoint was written without "
+                                         "keep_corr; cannot resume with it")
+                    corr_out.append(state["corr"])
+
         while done < nreal:
             # every step runs at the full chunk size (the final one overshoots and
             # is truncated below): _step is jitted with a static realization count,
@@ -252,6 +282,12 @@ class EnsembleSimulator:
             if keep_corr:
                 corr_out.append(np.asarray(corr))
             done += chunk
+            if ckpt is not None:
+                ckpt.save(seed, nreal, chunk, done,
+                          np.concatenate(curves_out), np.concatenate(autos_out),
+                          np.concatenate(corr_out) if keep_corr else None)
+            if progress is not None:
+                progress(min(done, nreal), nreal)
         out = {
             "curves": np.concatenate(curves_out)[:nreal],
             "autos": np.concatenate(autos_out)[:nreal],
@@ -259,4 +295,6 @@ class EnsembleSimulator:
         }
         if keep_corr:
             out["corr"] = np.concatenate(corr_out)[:nreal]
+        if ckpt is not None:
+            ckpt.delete()
         return out

@@ -1,0 +1,96 @@
+"""Persistence: ENTERPRISE-layout pickles, config JSONs, ensemble checkpoints.
+
+The reference's entire persistence story is ``pickle.dump``/``load`` of the pulsar
+list plus two JSON config files (SURVEY.md §5, ``examples/make_fake_array.py:31,65``).
+These helpers make that contract explicit, and add what the reference lacks: a
+resumable checkpoint format for long Monte-Carlo runs (the closest thing the
+reference has is re-derivability of a realization from ``signal_model``).
+"""
+
+from __future__ import annotations
+
+import json
+import pickle
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+
+def save_array(psrs, path):
+    """Pickle a pulsar list in the ENTERPRISE-compatible layout (ref
+    ``examples/make_fake_array.py:65``)."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "wb") as fh:
+        pickle.dump(list(psrs), fh)
+    return path
+
+
+def load_array(path):
+    """Load a pulsar list pickle (fakepta_tpu or ENTERPRISE objects)."""
+    with open(path, "rb") as fh:
+        return pickle.load(fh)
+
+
+def load_noisedict(path) -> dict:
+    """Flat ``{parameter_name: float}`` JSON, ENTERPRISE naming (SURVEY.md §2.4)."""
+    nd = json.loads(Path(path).read_text())
+    bad = {k: v for k, v in nd.items() if not isinstance(v, (int, float))}
+    if bad:
+        raise ValueError(f"noisedict values must be numbers; offending keys: "
+                         f"{sorted(bad)[:5]}")
+    return nd
+
+
+def load_custom_models(path) -> dict:
+    """``{psrname: {'RN': n|None, 'DM': n|None, 'Sv': n|None}}`` JSON."""
+    models = json.loads(Path(path).read_text())
+    for name, entry in models.items():
+        missing = {"RN", "DM", "Sv"} - set(entry)
+        if missing:
+            raise ValueError(f"custom_models[{name!r}] missing {sorted(missing)}")
+    return models
+
+
+class EnsembleCheckpoint:
+    """Chunk-granular checkpoint/resume for :meth:`EnsembleSimulator.run`.
+
+    One ``.npz`` per run, rewritten atomically after every chunk: because each
+    chunk's RNG keys derive from ``fold_in(base_key, absolute_index)``, a resumed
+    run continues the *identical* realization stream — the result equals the
+    uninterrupted run, which the tests assert.
+    """
+
+    def __init__(self, path):
+        self.path = Path(path)
+
+    def load(self, seed, nreal: int, chunk: int) -> Optional[dict]:
+        """Return saved state if it matches this run's configuration."""
+        if not self.path.exists():
+            return None
+        with np.load(self.path, allow_pickle=False) as z:
+            state = {k: z[k] for k in z.files}
+        if (int(state["seed"]) != int(seed) or int(state["nreal"]) != nreal
+                or int(state["chunk"]) != chunk):
+            raise ValueError(
+                f"checkpoint {self.path} was written by a different run "
+                f"(seed/nreal/chunk = {int(state['seed'])}/{int(state['nreal'])}"
+                f"/{int(state['chunk'])}, requested {seed}/{nreal}/{chunk}); "
+                f"delete it or use a different path")
+        return state
+
+    def save(self, seed, nreal: int, chunk: int, done: int, curves, autos,
+             corr=None):
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        payload = dict(seed=np.int64(seed), nreal=np.int64(nreal),
+                       chunk=np.int64(chunk), done=np.int64(done),
+                       curves=curves, autos=autos)
+        if corr is not None:
+            payload["corr"] = corr
+        tmp = self.path.with_suffix(".tmp.npz")
+        np.savez(tmp, **payload)
+        tmp.replace(self.path)
+
+    def delete(self):
+        self.path.unlink(missing_ok=True)

@@ -1,0 +1,66 @@
+"""Tracing and timing: the TPU-idiomatic observability layer.
+
+The reference has no profiling at all (SURVEY.md §5). On TPU the idiomatic
+equivalents are ``jax.profiler`` device traces (viewable in TensorBoard /
+Perfetto) and wall-clock timing that accounts for async dispatch — a naive
+``time.time()`` around a jitted call measures dispatch, not execution, so
+:func:`timed` blocks on the returned arrays.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax
+
+
+@contextlib.contextmanager
+def trace(logdir: str, annotate: str = ""):
+    """Capture a device trace under ``logdir`` (open with TensorBoard/Perfetto).
+
+    >>> with trace("/tmp/pta_trace"):
+    ...     sim.run(1000, seed=0)
+    """
+    with jax.profiler.trace(str(logdir)):
+        if annotate:
+            with jax.profiler.TraceAnnotation(annotate):
+                yield
+        else:
+            yield
+
+
+annotation = jax.profiler.TraceAnnotation    # named spans inside a trace
+
+
+@dataclass
+class Timer:
+    """Accumulating wall-clock timer with device-sync semantics.
+
+    ``block_until_ready`` is applied to whatever the timed block returns through
+    ``set_result``, so the recorded time includes device execution, not just
+    Python dispatch.
+    """
+
+    times: Dict[str, List[float]] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        holder = {}
+
+        def set_result(x):
+            holder["out"] = x
+            return x
+
+        t0 = time.perf_counter()
+        yield set_result
+        if "out" in holder:
+            jax.block_until_ready(holder["out"])
+        self.times.setdefault(name, []).append(time.perf_counter() - t0)
+
+    def summary(self) -> Dict[str, dict]:
+        return {name: {"n": len(ts), "total_s": sum(ts),
+                       "mean_s": sum(ts) / len(ts)}
+                for name, ts in self.times.items() if ts}

@@ -1,0 +1,105 @@
+"""Aux-subsystem tests: persistence, checkpoint/resume, profiling (SURVEY.md §5)."""
+
+import json
+
+import jax
+import numpy as np
+import pytest
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.fake_pta import Pulsar, make_fake_array
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator
+from fakepta_tpu.utils import io as io_utils
+from fakepta_tpu.utils.profiling import Timer
+
+
+def test_save_load_array_roundtrip(tmp_path):
+    psrs = make_fake_array(npsrs=2, Tobs=5, ntoas=40, seed=1)
+    p = io_utils.save_array(psrs, tmp_path / "sub" / "arr.pkl")
+    back = io_utils.load_array(p)
+    assert [b.name for b in back] == [a.name for a in psrs]
+    np.testing.assert_array_equal(back[0].residuals, psrs[0].residuals)
+
+
+def test_json_loaders_validate(tmp_path):
+    good_nd = tmp_path / "nd.json"
+    good_nd.write_text(json.dumps({"J0000+0000_b_efac": 1.1}))
+    assert io_utils.load_noisedict(good_nd)["J0000+0000_b_efac"] == 1.1
+
+    bad_nd = tmp_path / "bad.json"
+    bad_nd.write_text(json.dumps({"J0000+0000_b_efac": "oops"}))
+    with pytest.raises(ValueError, match="must be numbers"):
+        io_utils.load_noisedict(bad_nd)
+
+    bad_cm = tmp_path / "cm.json"
+    bad_cm.write_text(json.dumps({"J0000+0000": {"RN": 30}}))
+    with pytest.raises(ValueError, match="missing"):
+        io_utils.load_custom_models(bad_cm)
+
+
+@pytest.fixture(scope="module")
+def sim():
+    batch = PulsarBatch.synthetic(npsr=4, ntoa=48, tspan_years=10.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=3)
+    return EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]))
+
+
+def test_checkpoint_resume_is_identical(sim, tmp_path):
+    """A run interrupted mid-way and resumed must equal the uninterrupted run."""
+    ck = tmp_path / "mc.npz"
+    full = sim.run(24, seed=5, chunk=8)
+
+    # simulate an interruption: run chunk-by-chunk, stop after 2 chunks
+    calls = []
+    class Stop(Exception):
+        pass
+    def boom(done, nreal):
+        calls.append(done)
+        if done >= 16:
+            raise Stop
+    with pytest.raises(Stop):
+        sim.run(24, seed=5, chunk=8, checkpoint=ck, progress=boom)
+    assert ck.exists()
+
+    resumed = sim.run(24, seed=5, chunk=8, checkpoint=ck)
+    np.testing.assert_array_equal(resumed["curves"], full["curves"])
+    np.testing.assert_array_equal(resumed["autos"], full["autos"])
+    assert not ck.exists()   # removed on success
+
+
+def test_checkpoint_mismatched_run_rejected(sim, tmp_path):
+    ck = tmp_path / "mc.npz"
+    class Stop(Exception):
+        pass
+    def boom(done, nreal):
+        raise Stop
+    with pytest.raises(Stop):
+        sim.run(24, seed=5, chunk=8, checkpoint=ck, progress=boom)
+    with pytest.raises(ValueError, match="different run"):
+        sim.run(24, seed=6, chunk=8, checkpoint=ck)
+    with pytest.raises(TypeError, match="integer seed"):
+        sim.run(24, seed=jax.random.key(0), chunk=8, checkpoint=ck)
+
+
+def test_progress_callback_reports_chunks(sim):
+    seen = []
+    sim.run(20, seed=1, chunk=8, progress=lambda d, n: seen.append((d, n)))
+    assert seen == [(8, 20), (16, 20), (20, 20)]
+
+
+def test_timer_blocks_on_device_work(sim):
+    t = Timer()
+    with t.section("run") as done:
+        done(sim.run(8, seed=0, chunk=8)["curves"])
+    s = t.summary()
+    assert s["run"]["n"] == 1 and s["run"]["total_s"] > 0
+
+
+def test_trace_writes_profile(tmp_path):
+    from fakepta_tpu.utils.profiling import trace
+    with trace(tmp_path / "tr"):
+        jax.block_until_ready(jax.numpy.ones(8) * 2)
+    files = list((tmp_path / "tr").rglob("*"))
+    assert files, "no trace output written"
