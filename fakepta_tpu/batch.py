@@ -44,6 +44,8 @@ class PulsarBatch:
     red_psd: jax.Array      # (P, NR) red-noise PSD on the per-pulsar grid (0 = off)
     dm_psd: jax.Array       # (P, ND) DM-noise PSD (0 = off)
     chrom_psd: jax.Array    # (P, NC) chromatic (scattering, idx=4) PSD (0 = off)
+    epoch_idx: jax.Array    # (P, T) int32 per-TOA epoch id (for ECORR)
+    ecorr_amp: jax.Array    # (P, T) per-TOA ECORR amplitude [s] (0 = off)
     df_own: jax.Array       # (P,) per-pulsar bin width 1/Tspan_p [Hz]
     tspan_common: jax.Array # () array Tspan [s]
 
@@ -57,18 +59,25 @@ class PulsarBatch:
 
     @classmethod
     def from_pulsars(cls, psrs: Sequence, n_red: int = 30, n_dm: int = 100,
-                     n_chrom: int = 30, dtype=jnp.float32) -> "PulsarBatch":
+                     n_chrom: int = 30, ecorr: bool = False, ecorr_dt: float = 1.0,
+                     dtype=jnp.float32) -> "PulsarBatch":
         """Pack a list of (facade or ENTERPRISE-style) pulsars into one batch.
 
         PSDs (red / DM / chromatic) are taken from each pulsar's injected
         ``signal_model`` when present (padded with zeros up to the batch bin
         counts), else zero (signal off). White-noise variances resolve from the
         noisedict per backend, exactly as ``add_white_noise`` does
-        (``fake_pta.py:214-217``). Limitations vs the stateful shell: white noise
-        is diagonal EFAC/EQUAD only (ECORR epoch blocks live in
-        ``Pulsar.add_white_noise``), and per-backend system noises are not
-        batched.
+        (``fake_pta.py:214-217``).
+
+        ``ecorr=True`` additionally resolves per-backend ``log10_ecorr`` values
+        and quantizes TOAs into epochs (``ecorr_dt`` days). The batch sampler
+        exploits the block structure sigma^2 I + c^2 11^T exactly: one shared
+        normal per epoch, no per-block Cholesky (vs the reference's dense MVN
+        per block, ``fake_pta.py:219-228``). Remaining limitation vs the
+        stateful shell: per-backend system noises are not batched.
         """
+        from .ops.white import quantise_epochs
+
         toas_list = [np.asarray(p.toas, dtype=np.float64) for p in psrs]
         tmin = min(t.min() for t in toas_list)
         tmax = max(t.max() for t in toas_list)
@@ -83,6 +92,8 @@ class PulsarBatch:
         red_psd = np.zeros((npsr, n_red))
         dm_psd = np.zeros((npsr, n_dm))
         chrom_psd = np.zeros((npsr, n_chrom))
+        epoch_idx = np.zeros((npsr, T), dtype=np.int32)
+        ecorr_amp = np.zeros((npsr, T))
         df_own = np.zeros(npsr)
         pos = np.stack([np.asarray(p.pos, dtype=np.float64) for p in psrs])
 
@@ -102,6 +113,19 @@ class PulsarBatch:
                 equad[sel] = p.noisedict.get(f"{p.name}_{backend}_log10_tnequad", -8.0)
             sigma2[i, :n] = (efac**2 * np.asarray(p.toaerrs[:n]) ** 2
                              + 10.0 ** (2.0 * equad))
+            if ecorr:
+                flags = np.asarray(p.backend_flags)[:n]
+                idx, _, ep_counts = quantise_epochs(
+                    toas_list[i] - toas_list[i].min(), flags,
+                    dt=ecorr_dt * 86400.0)
+                epoch_idx[i, :n] = idx
+                for backend in np.unique(flags):
+                    sel = flags == backend
+                    ecorr_amp[i, :n][sel] = 10.0 ** p.noisedict.get(
+                        f"{p.name}_{backend}_log10_ecorr", -np.inf)
+                # epochs with a single TOA get plain white noise, matching the
+                # facade and the reference (fake_pta.py:223-224)
+                ecorr_amp[i, :n][ep_counts[idx] < 2] = 0.0
             for signal, idx, target in (("red_noise", 0.0, red_psd),
                                         ("dm_gp", 2.0, dm_psd),
                                         ("chrom_gp", 4.0, chrom_psd)):
@@ -131,6 +155,8 @@ class PulsarBatch:
             red_psd=jnp.asarray(red_psd, dtype),
             dm_psd=jnp.asarray(dm_psd, dtype),
             chrom_psd=jnp.asarray(chrom_psd, dtype),
+            epoch_idx=jnp.asarray(epoch_idx),
+            ecorr_amp=jnp.asarray(ecorr_amp, dtype),
             df_own=jnp.asarray(df_own, dtype),
             tspan_common=jnp.asarray(tspan_common, dtype),
         )
@@ -181,6 +207,8 @@ class PulsarBatch:
             red_psd=jnp.asarray(np.tile(red, (npsr, 1)), dtype),
             dm_psd=jnp.asarray(np.tile(dm, (npsr, 1)), dtype),
             chrom_psd=jnp.asarray(np.tile(chrom, (npsr, 1)), dtype),
+            epoch_idx=jnp.tile(jnp.arange(ntoa, dtype=jnp.int32), (npsr, 1)),
+            ecorr_amp=jnp.zeros((npsr, ntoa), dtype),
             df_own=jnp.asarray(np.full(npsr, 1.0 / tspan), dtype),
             tspan_common=jnp.asarray(tspan, dtype),
         )

@@ -1,0 +1,105 @@
+"""Pallas TPU kernels for the Monte-Carlo hot path.
+
+The ensemble statistic is a two-stage contraction per realization r:
+
+    corr[r] = res[r] @ res[r].T / counts          (npsr x npsr, MXU)
+    curves[r, n] = sum_pq corr[r] * onehot[:, :, n]   (angular binning, VPU)
+
+XLA runs these as two kernels with the (R, P, P) correlation tensor
+materialized in HBM between them (400 MB each way at the benchmark size, plus a
+dense (R,P^2)x(P^2,N) matmul for the binning). The fused kernel here keeps each
+realization's correlation block in VMEM and reduces it to the (nbins+1) output
+lanes in place — HBM sees only the residual read and a tiny curves write. Layout
+notes follow /opt/skills/guides/pallas_guide.md (f32 tiles (8,128); zero-padding
+is free for dot products, so all padding is plain zeros).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+SUBLANES = 8
+
+
+def _pad_to(x, axis, mult):
+    n = x.shape[axis]
+    pad = (-n) % mult
+    if pad == 0:
+        return x
+    widths = [(0, 0)] * x.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(x, widths)
+
+
+def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins):
+    """One grid step: ``rt`` realizations; emit curves+autos into output lanes.
+
+    res_l_ref: (rt, PL, T)   local residual rows (zero-padded)
+    res_f_ref: (rt, PF, T)   full (gathered) residuals (zero-padded)
+    w_ref:     (nbins+1, PL, PF) binning weights; slot nbins is the auto weight
+    out_ref:   (rt, LANES)   lane n < nbins: curve bin n; lane nbins: autos
+    """
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    for r in range(rt):
+        # bf16 operands + f32 accumulation: matches XLA's default TPU matmul
+        # precision for f32 inputs, at 2x the MXU rate of full f32
+        a = res_l_ref[r].astype(jnp.bfloat16)
+        b = res_f_ref[r].astype(jnp.bfloat16)
+        corr = jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=jnp.float32)
+        acc = jnp.zeros((1, LANES), jnp.float32)
+        for n in range(nbins + 1):
+            s = jnp.sum(corr * w_ref[n])
+            acc = acc + jnp.where(lane == n, s, 0.0)
+        out_ref[r] = acc[0]
+
+
+@functools.partial(jax.jit, static_argnames=("nbins", "rt", "interpret"))
+def binned_correlation(res_local, res_full, weights, nbins: int, rt: int = 8,
+                       interpret: bool = False):
+    """Fused correlation + angular binning.
+
+    res_local: (R, PL, T) this shard's residual rows.
+    res_full:  (R, PF, T) all pulsars' residuals (identical time axis).
+    weights:   (nbins+1, PL, PF) — precomputed ``onehot/(counts*bin_counts)``
+               stack with the normalized auto-trace weight in slot ``nbins``
+               (already holding any 1/count normalization, so the kernel is a
+               plain weighted sum).
+    Returns (curves (R, nbins), autos (R,)) — the *local* partial sums; callers
+    inside shard_map psum over the pulsar axis.
+    """
+    R = res_local.shape[0]
+    if R % rt != 0:
+        raise ValueError(f"nreal per shard ({R}) must be divisible by rt={rt}")
+    res_local = _pad_to(_pad_to(res_local, 2, LANES), 1, SUBLANES)
+    res_full = _pad_to(_pad_to(res_full, 2, LANES), 1, LANES)
+    weights = _pad_to(_pad_to(weights, 2, LANES), 1, SUBLANES)
+    _, PL, T = res_local.shape
+    PF = res_full.shape[1]
+    if nbins + 1 > LANES:
+        raise ValueError(f"nbins={nbins} does not fit the {LANES}-lane output")
+
+    out = pl.pallas_call(
+        functools.partial(_binned_corr_kernel, rt=rt, nbins=nbins),
+        grid=(R // rt,),
+        in_specs=[
+            pl.BlockSpec((rt, PL, T), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((rt, PF, T), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((nbins + 1, PL, PF), lambda i: (0, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((rt, LANES), lambda i: (i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.float32),
+        interpret=interpret,
+    )(res_local, res_full, weights)
+    return out[:, :nbins], out[:, nbins]

@@ -51,8 +51,8 @@ class GWBConfig:
 
 
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
-                    include_white, include_red, include_dm, include_chrom,
-                    include_gwb):
+                    include_white, include_ecorr, include_red, include_dm,
+                    include_chrom, include_gwb):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -85,11 +85,19 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     def one(key):
         local_key = jax.random.fold_in(key, pidx)
-        kw, kr, kd, kc = jax.random.split(jax.random.fold_in(local_key, 0x51), 4)
+        kw, kr, kd, kc, ke = jax.random.split(
+            jax.random.fold_in(local_key, 0x51), 5)
         res = jnp.zeros((p_local, batch.t_own.shape[1]), dtype)
         if include_white:
             z = jax.random.normal(kw, batch.sigma2.shape, dtype)
             res = res + jnp.sqrt(batch.sigma2) * z
+        if include_ecorr:
+            # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
+            # ONE shared normal per epoch: no per-block Cholesky (the reference
+            # draws a dense MVN per block, fake_pta.py:219-228)
+            u = jax.random.normal(ke, batch.epoch_idx.shape, dtype)  # >= n_epochs
+            shared = jnp.take_along_axis(u, batch.epoch_idx, axis=1)
+            res = res + batch.ecorr_amp * shared
         if include_red:
             c = jax.random.normal(kr, (p_local, 2, n_red), dtype) * red_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", red_basis, c)
@@ -112,6 +120,15 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
         return jnp.where(batch.mask, res, 0.0)
 
     return jax.vmap(one)(keys)
+
+
+def _batch_specs():
+    """PartitionSpecs for a PulsarBatch: every (npsr, ...) leaf shards over the
+    psr axis, scalars replicate. Derived from the dataclass fields so adding a
+    field to PulsarBatch cannot silently miss a spec."""
+    specs = {f.name: P(PSR_AXIS) for f in dataclasses.fields(PulsarBatch)}
+    specs["tspan_common"] = P()
+    return PulsarBatch(**specs)
 
 
 def _correlation_rows(res_local, mask_local):
@@ -140,8 +157,9 @@ class EnsembleSimulator:
     """
 
     def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
-                 mesh=None, include=("white", "red", "dm", "chrom", "gwb"),
-                 nbins: int = 15):
+                 mesh=None, include=("white", "ecorr", "red", "dm", "chrom",
+                                     "gwb"),
+                 nbins: int = 15, use_pallas: Optional[bool] = None):
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
         n_psr_shards = self.mesh.shape[PSR_AXIS]
@@ -170,10 +188,14 @@ class EnsembleSimulator:
             self._gwb_idx = 0.0
             self._gwb_freqf = 1400.0
         include = tuple(include)
-        # the chrom stage only enters the program if its PSD is anywhere nonzero —
-        # the default synthetic batch has it off, so nothing is traced for it
+        # optional stages only enter the program if their parameters are anywhere
+        # nonzero — the default synthetic batch has chrom/ecorr off, so nothing
+        # is traced for them
         has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0))
-        self._include = (("white" in include), ("red" in include),
+        has_ecorr = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+        self._include = (("white" in include),
+                         ("ecorr" in include and has_ecorr),
+                         ("red" in include),
                          ("dm" in include), ("chrom" in include and has_chrom),
                          ("gwb" in include and gwb is not None))
 
@@ -191,22 +213,29 @@ class EnsembleSimulator:
         self._bin_counts = jnp.maximum(self._bin_onehot.sum((0, 1)), 1.0)
         self.bin_centers = edges[:-1] + 0.5 * (edges[1] - edges[0])
 
+        # fused pallas statistic path (curves+autos without materializing the
+        # (R, P, P) correlation tensor in HBM). Opt-in: the XLA path is already
+        # near MXU roofline; the fused kernel trades the (R,P,P) HBM round-trip
+        # for per-chunk Mosaic compiles, which pays off for repeated runs at a
+        # fixed chunk size. On non-TPU platforms it runs in interpret mode
+        # (tests); on TPU it is a real Mosaic kernel.
+        platform = self.mesh.devices.flat[0].platform
+        self._use_pallas = bool(use_pallas)
+        self._pallas_interpret = platform != "tpu"
+        self._onehot_np = onehot
+
         self._step = self._build_step()
+        self._step_fused = self._build_step_fused() if self._use_pallas else None
 
     def _build_step(self):
         mesh = self.mesh
-        batch_specs = PulsarBatch(
-            t_own=P(PSR_AXIS), t_common=P(PSR_AXIS), mask=P(PSR_AXIS),
-            freqs=P(PSR_AXIS), sigma2=P(PSR_AXIS), pos=P(PSR_AXIS),
-            red_psd=P(PSR_AXIS), dm_psd=P(PSR_AXIS), chrom_psd=P(PSR_AXIS),
-            df_own=P(PSR_AXIS), tspan_common=P(),
-        )
-        inc_w, inc_r, inc_d, inc_c, inc_g = self._include
+        batch_specs = _batch_specs()
+        inc_w, inc_e, inc_r, inc_d, inc_c, inc_g = self._include
 
         def sharded(keys, batch, chol, gwb_w):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, inc_w, inc_r, inc_d, inc_c,
-                                  inc_g)
+                                  self._gwb_freqf, inc_w, inc_e, inc_r, inc_d,
+                                  inc_c, inc_g)
             return _correlation_rows(res, batch.mask)
 
         shmapped = jax.shard_map(
@@ -226,6 +255,61 @@ class EnsembleSimulator:
             # normalize by the mean autocorrelation to a unitless HD statistic
             autos = jnp.einsum("rpp->r", corr) / corr.shape[1]
             return curves, autos, corr
+
+        return step
+
+    def _build_step_fused(self):
+        """Pallas statistic path: one kernel computes curves+autos from residuals
+        with the per-realization correlation block kept in VMEM (see
+        :mod:`fakepta_tpu.ops.pallas_kernels`)."""
+        from ..ops.pallas_kernels import binned_correlation
+
+        batch = self.batch
+        dtype = batch.t_own.dtype
+        # combined statistic weights, fused-path-only state: slot n < nbins is
+        # onehot/(pair counts * bin count); slot nbins is the normalized trace
+        mask_np = np.asarray(batch.mask, dtype=np.float64)
+        counts = np.maximum(mask_np @ mask_np.T, 1.0)          # (P, P) pair TOAs
+        bc = np.asarray(self._bin_counts, dtype=np.float64)
+        w_bins = self._onehot_np.transpose(2, 0, 1) / counts[None] \
+            / bc[:, None, None]
+        w_auto = (np.eye(batch.npsr) / counts / batch.npsr)[None]
+        self._stat_weights = jnp.asarray(
+            np.concatenate([w_bins, w_auto], axis=0), dtype)   # (nbins+1, P, P)
+
+        mesh = self.mesh
+        batch_specs = _batch_specs()
+        inc = self._include
+        nbins = self.nbins
+        interpret = self._pallas_interpret
+
+        def sharded(keys, batch, chol, gwb_w, weights):
+            res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
+                                  self._gwb_freqf, *inc)
+            res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
+            r_local = res.shape[0]
+            rt = next(k for k in (16, 8, 4, 2, 1) if r_local % k == 0)
+            curves_p, autos_p = binned_correlation(
+                res, res_full, weights, nbins=nbins, rt=rt, interpret=interpret)
+            # the only other collective: reduce partial bin sums over psr shards
+            return (lax.psum(curves_p, PSR_AXIS), lax.psum(autos_p, PSR_AXIS))
+
+        shmapped = jax.shard_map(
+            sharded, mesh=mesh,
+            in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
+                      P(None, PSR_AXIS, None)),
+            out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
+            # pallas_call does not annotate vma on its outputs; the psum above
+            # makes the outputs replicated over 'psr' by construction
+            check_vma=False,
+        )
+
+        @partial(jax.jit, static_argnums=(2,))
+        def step(base_key, offset, nreal):
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                offset + jnp.arange(nreal))
+            return shmapped(keys, self.batch, self._chol, self._gwb_w,
+                            self._stat_weights)
 
         return step
 
@@ -272,15 +356,19 @@ class EnsembleSimulator:
                                          "keep_corr; cannot resume with it")
                     corr_out.append(state["corr"])
 
+        fused = self._step_fused is not None and not keep_corr
         while done < nreal:
             # every step runs at the full chunk size (the final one overshoots and
-            # is truncated below): _step is jitted with a static realization count,
-            # so a smaller tail chunk would recompile the whole SPMD program
-            curves, autos, corr = self._step(base, done, chunk)
+            # is truncated below): the steps are jitted with a static realization
+            # count, so a smaller tail chunk would recompile the SPMD program
+            if fused:
+                curves, autos = self._step_fused(base, done, chunk)
+            else:
+                curves, autos, corr = self._step(base, done, chunk)
+                if keep_corr:
+                    corr_out.append(np.asarray(corr))
             curves_out.append(np.asarray(curves))
             autos_out.append(np.asarray(autos))
-            if keep_corr:
-                corr_out.append(np.asarray(corr))
             done += chunk
             if ckpt is not None:
                 ckpt.save(seed, nreal, chunk, done,
