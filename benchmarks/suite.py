@@ -1,0 +1,162 @@
+"""Benchmark suite for the five BASELINE.md configs.
+
+Prints one JSON line per config. The reference publishes no numbers
+(SURVEY.md §6), so these are the framework's own measured results; run with
+``--update-baseline`` to append a measured table to BASELINE.md.
+
+    python benchmarks/suite.py                 # all configs, default sizes
+    python benchmarks/suite.py --configs 1 2   # subset
+    python benchmarks/suite.py --platform cpu  # force a jax platform
+"""
+
+import argparse
+import json
+import time
+from datetime import date
+from pathlib import Path
+
+import numpy as np
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _timeit(fn, repeats=3):
+    fn()                                   # warm (compile)
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def config1():
+    """1 pulsar, 10 yr weekly TOAs, white noise only (ref fake_pta.py:201-230)."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.fake_pta import Pulsar
+
+    psr = Pulsar(np.linspace(0, 10 * const.yr, 520), 1e-6, 1.0, 1.0, seed=0)
+    t = _timeit(lambda: psr.add_white_noise(seed=1))
+    return {"config": 1, "metric": "white-noise injections/s (1 psr, 520 TOAs)",
+            "value": round(1 / t, 1), "unit": "inj/s"}
+
+
+def config2():
+    """10-pulsar array, per-pulsar power-law red noise (ref :258-281,357-387)."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.fake_pta import Pulsar
+
+    psrs = [Pulsar(np.linspace(0, 10 * const.yr, 520), 1e-6,
+                   1.0 + 0.1 * k, 0.3 * k, seed=k) for k in range(10)]
+
+    def inject():
+        for p in psrs:
+            p.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=13 / 3,
+                            seed=2)
+    t = _timeit(inject)
+    return {"config": 2, "metric": "red-noise injections/s (10 psr, 30 bins)",
+            "value": round(10 / t, 1), "unit": "inj/s"}
+
+
+def config3():
+    """45-pulsar HD-correlated GWB injection (ref correlated_noises.py:111-160)."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.correlated_noises import add_common_correlated_noise
+    from fakepta_tpu.fake_pta import Pulsar
+
+    psrs = [Pulsar(np.linspace(0, 15 * const.yr, 780), 1e-7,
+                   np.arccos(np.cos(0.07 * k * np.pi)), 0.41 * k % (2 * np.pi),
+                   seed=k) for k in range(45)]
+    t = _timeit(lambda: add_common_correlated_noise(
+        psrs, orf="hd", log10_A=np.log10(2e-15), gamma=13 / 3, seed=3))
+    return {"config": 3, "metric": "HD GWB array injections/s (45 psr)",
+            "value": round(1 / t, 2), "unit": "inj/s"}
+
+
+def config4():
+    """100-psr GWB + DM noise + BayesEphem Roemer perturbation (ref +
+    fake_pta.py:283-306, ephemeris.py:118-144)."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.correlated_noises import (add_common_correlated_noise,
+                                               add_roemer_delay)
+    from fakepta_tpu.ephemeris import Ephemeris
+    from fakepta_tpu.fake_pta import Pulsar
+
+    ephem = Ephemeris()
+    psrs = [Pulsar(np.linspace(0, 15 * const.yr, 780), 1e-7,
+                   np.arccos(1 - 2 * ((k + 0.5) / 100)), 2.39996 * k % (2 * np.pi),
+                   seed=k, ephem=ephem) for k in range(100)]
+
+    def full():
+        for p in psrs:
+            p.add_dm_noise(spectrum="powerlaw", log10_A=-13.8, gamma=3.0, seed=4)
+        add_common_correlated_noise(psrs, orf="hd", log10_A=np.log10(2e-15),
+                                    gamma=13 / 3, seed=5)
+        jup = ephem.planets["jupiter"]["mass"]
+        add_roemer_delay(psrs, "jupiter", d_mass=1e-4 * jup)
+    t = _timeit(full, repeats=2)
+    return {"config": 4, "metric": "full-array pipeline time (100 psr, GWB+DM+ephem)",
+            "value": round(t, 3), "unit": "s"}
+
+
+def config5():
+    """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    n_dev = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                            mesh=make_mesh(jax.devices()))
+    nreal, chunk = 10_000, 10_000
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    return {"config": 5,
+            "metric": "PTA realizations/sec/chip (100 psr, 15 yr, HD GWB)",
+            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip",
+            "vs_baseline": round(nreal / t / n_dev / (10_000 / (60.0 * 8)), 2)}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--configs", type=int, nargs="*", default=[1, 2, 3, 4, 5])
+    ap.add_argument("--platform", default=None)
+    ap.add_argument("--update-baseline", action="store_true")
+    args = ap.parse_args()
+    if args.platform:
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    import jax
+
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+    rows = []
+    for c in args.configs:
+        row = fns[c]()
+        row["platform"] = jax.devices()[0].platform
+        print(json.dumps(row))
+        rows.append(row)
+
+    if args.update_baseline and rows:
+        lines = [f"\n## Measured ({date.today().isoformat()}, "
+                 f"{rows[0]['platform']}, {len(jax.devices())} device(s))\n",
+                 "| # | metric | value | unit |\n", "|---|---|---|---|\n"]
+        for r in rows:
+            lines.append(f"| {r['config']} | {r['metric']} | {r['value']} "
+                         f"| {r['unit']} |\n")
+        with open(REPO / "BASELINE.md", "a") as fh:
+            fh.writelines(lines)
+        print("appended to BASELINE.md")
+
+
+if __name__ == "__main__":
+    main()

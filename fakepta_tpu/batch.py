@@ -46,6 +46,8 @@ class PulsarBatch:
     chrom_psd: jax.Array    # (P, NC) chromatic (scattering, idx=4) PSD (0 = off)
     epoch_idx: jax.Array    # (P, T) int32 per-TOA epoch id (for ECORR)
     ecorr_amp: jax.Array    # (P, T) per-TOA ECORR amplitude [s] (0 = off)
+    sys_psd: jax.Array      # (P, B, NS) per-backend system-noise PSD (0 = off)
+    sys_mask: jax.Array     # (P, B, T) TOA membership of each system band
     df_own: jax.Array       # (P,) per-pulsar bin width 1/Tspan_p [Hz]
     tspan_common: jax.Array # () array Tspan [s]
 
@@ -59,8 +61,8 @@ class PulsarBatch:
 
     @classmethod
     def from_pulsars(cls, psrs: Sequence, n_red: int = 30, n_dm: int = 100,
-                     n_chrom: int = 30, ecorr: bool = False, ecorr_dt: float = 1.0,
-                     dtype=jnp.float32) -> "PulsarBatch":
+                     n_chrom: int = 30, n_sys: int = 30, ecorr: bool = False,
+                     ecorr_dt: float = 1.0, dtype=jnp.float32) -> "PulsarBatch":
         """Pack a list of (facade or ENTERPRISE-style) pulsars into one batch.
 
         PSDs (red / DM / chromatic) are taken from each pulsar's injected
@@ -73,8 +75,12 @@ class PulsarBatch:
         and quantizes TOAs into epochs (``ecorr_dt`` days). The batch sampler
         exploits the block structure sigma^2 I + c^2 11^T exactly: one shared
         normal per epoch, no per-block Cholesky (vs the reference's dense MVN
-        per block, ``fake_pta.py:219-228``). Remaining limitation vs the
-        stateful shell: per-backend system noises are not batched.
+        per block, ``fake_pta.py:219-228``).
+
+        Per-backend system noises (``signal_model`` keys
+        ``'<backend>_system_noise_<backend>'``) become masked GP bands:
+        ``sys_psd`` holds each band's PSD and ``sys_mask`` its backend's TOA
+        membership, padded to the largest band count in the array.
         """
         from .ops.white import quantise_epochs
 
@@ -94,6 +100,7 @@ class PulsarBatch:
         chrom_psd = np.zeros((npsr, n_chrom))
         epoch_idx = np.zeros((npsr, T), dtype=np.int32)
         ecorr_amp = np.zeros((npsr, T))
+        sys_bands = []              # per pulsar: list of (mask (T,), psd (NS,))
         df_own = np.zeros(npsr)
         pos = np.stack([np.asarray(p.pos, dtype=np.float64) for p in psrs])
 
@@ -126,6 +133,36 @@ class PulsarBatch:
                 # epochs with a single TOA get plain white noise, matching the
                 # facade and the reference (fake_pta.py:223-224)
                 ecorr_amp[i, :n][ep_counts[idx] < 2] = 0.0
+            def check_grid(key, entry):
+                # every batched band lives on the standard n/Tspan_pulsar grid
+                # (df_own scaling assumes it); a custom f_psd must not be
+                # silently re-gridded
+                f = np.asarray(entry.get("f", []))
+                expect = np.arange(1, len(f) + 1) / tspan
+                if f.size and not np.allclose(f, expect, rtol=1e-6):
+                    raise ValueError(
+                        f"{p.name}.{key} uses a custom frequency grid; the "
+                        f"batch engine requires the standard n/Tspan grid")
+
+            bands = []
+            for key, entry in getattr(p, "signal_model", {}).items():
+                if "system_noise_" not in key:
+                    continue
+                if float(entry.get("idx", 0.0)) != 0.0:
+                    raise ValueError(f"{p.name}.{key} has idx={entry['idx']}; "
+                                     f"system bands assume idx=0")
+                check_grid(key, entry)
+                backend = key.split("system_noise_")[-1]
+                bmask = np.zeros(T, dtype=bool)
+                bmask[:n] = np.asarray(p.backend_flags)[:n] == backend
+                if not bmask.any():
+                    raise ValueError(f"{p.name}.{key}: backend {backend!r} has "
+                                     f"no TOAs")
+                bpsd = np.zeros(n_sys)
+                k = min(len(entry["psd"]), n_sys)
+                bpsd[:k] = entry["psd"][:k]
+                bands.append((bmask, bpsd))
+            sys_bands.append(bands)
             for signal, idx, target in (("red_noise", 0.0, red_psd),
                                         ("dm_gp", 2.0, dm_psd),
                                         ("chrom_gp", 4.0, chrom_psd)):
@@ -135,6 +172,7 @@ class PulsarBatch:
                         raise ValueError(
                             f"{p.name}.{signal} has idx={entry['idx']}; the batch "
                             f"engine assumes the canonical chromatic index {idx}")
+                    check_grid(signal, entry)
                     # the ensemble kernel scales by (1400/nu)^idx; a non-default
                     # reference frequency is a constant factor absorbed into the
                     # PSD: sqrt(S)(freqf/nu)^idx = sqrt(S (freqf/1400)^2idx)(1400/nu)^idx
@@ -144,6 +182,14 @@ class PulsarBatch:
                                      * (freqf / 1400.0) ** (2.0 * idx))
 
         t_common = (toas_pad - tmin) / tspan_common * mask
+
+        n_bands = max(1, max((len(b) for b in sys_bands), default=0))
+        sys_psd = np.zeros((npsr, n_bands, n_sys))
+        sys_mask = np.zeros((npsr, n_bands, T), dtype=bool)
+        for i, bands in enumerate(sys_bands):
+            for b, (bmask, bpsd) in enumerate(bands):
+                sys_mask[i, b] = bmask
+                sys_psd[i, b] = bpsd
 
         return cls(
             t_own=jnp.asarray(t_own, dtype),
@@ -157,6 +203,8 @@ class PulsarBatch:
             chrom_psd=jnp.asarray(chrom_psd, dtype),
             epoch_idx=jnp.asarray(epoch_idx),
             ecorr_amp=jnp.asarray(ecorr_amp, dtype),
+            sys_psd=jnp.asarray(sys_psd, dtype),
+            sys_mask=jnp.asarray(sys_mask),
             df_own=jnp.asarray(df_own, dtype),
             tspan_common=jnp.asarray(tspan_common, dtype),
         )
@@ -209,6 +257,8 @@ class PulsarBatch:
             chrom_psd=jnp.asarray(np.tile(chrom, (npsr, 1)), dtype),
             epoch_idx=jnp.tile(jnp.arange(ntoa, dtype=jnp.int32), (npsr, 1)),
             ecorr_amp=jnp.zeros((npsr, ntoa), dtype),
+            sys_psd=jnp.zeros((npsr, 1, 1), dtype),
+            sys_mask=jnp.zeros((npsr, 1, ntoa), dtype=bool),
             df_own=jnp.asarray(np.full(npsr, 1.0 / tspan), dtype),
             tspan_common=jnp.asarray(tspan, dtype),
         )

@@ -52,7 +52,7 @@ class GWBConfig:
 
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                     include_white, include_ecorr, include_red, include_dm,
-                    include_chrom, include_gwb):
+                    include_chrom, include_sys, include_gwb):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -74,6 +74,11 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
         chrom_basis = fourier_basis_norm(batch.t_own, n_chrom,
                                          scale=(1400.0 / batch.freqs) ** 4)
         chrom_w = jnp.sqrt(batch.chrom_psd * batch.df_own[:, None])    # (P,NC)
+    if include_sys:
+        n_sys = batch.sys_psd.shape[2]
+        sys_basis = fourier_basis_norm(batch.t_own, n_sys)             # (P,T,2,NS)
+        sys_w = jnp.sqrt(batch.sys_psd * batch.df_own[:, None, None])  # (P,B,NS)
+        n_bands = batch.sys_psd.shape[1]
     gwb_scale = None
     if gwb_idx:
         gwb_scale = (gwb_freqf / batch.freqs) ** gwb_idx
@@ -85,8 +90,8 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     def one(key):
         local_key = jax.random.fold_in(key, pidx)
-        kw, kr, kd, kc, ke = jax.random.split(
-            jax.random.fold_in(local_key, 0x51), 5)
+        kw, kr, kd, kc, ke, ks = jax.random.split(
+            jax.random.fold_in(local_key, 0x51), 6)
         res = jnp.zeros((p_local, batch.t_own.shape[1]), dtype)
         if include_white:
             z = jax.random.normal(kw, batch.sigma2.shape, dtype)
@@ -108,6 +113,17 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             c = jax.random.normal(kc, (p_local, 2, n_chrom), dtype) \
                 * chrom_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", chrom_basis, c)
+        if include_sys:
+            # per-(pulsar, backend-band) GP on the shared basis, masked to the
+            # band's TOAs (shell equivalent: fake_pta.py:333-355 via the masked
+            # injector; bands share the basis, draws are independent). Static
+            # loop over the (small) band count so no (R, P, B, T) intermediate
+            # is ever materialized under the realization vmap.
+            c = jax.random.normal(ks, (p_local, n_bands, 2, n_sys), dtype) \
+                * sys_w[:, :, None, :]
+            for b in range(n_bands):
+                contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
+                res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
         if include_gwb:
             # identical z on every psr shard (key NOT folded with pidx): the
             # (npsr x npsr) correlation matmul is replicated, then sliced locally
@@ -158,7 +174,7 @@ class EnsembleSimulator:
 
     def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
                  mesh=None, include=("white", "ecorr", "red", "dm", "chrom",
-                                     "gwb"),
+                                     "sys", "gwb"),
                  nbins: int = 15, use_pallas: Optional[bool] = None):
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
@@ -193,10 +209,12 @@ class EnsembleSimulator:
         # is traced for them
         has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0))
         has_ecorr = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+        has_sys = bool(np.any(np.asarray(batch.sys_psd) > 0.0))
         self._include = (("white" in include),
                          ("ecorr" in include and has_ecorr),
                          ("red" in include),
                          ("dm" in include), ("chrom" in include and has_chrom),
+                         ("sys" in include and has_sys),
                          ("gwb" in include and gwb is not None))
 
         # angular bins for the correlation curve (static, from positions)
@@ -230,12 +248,11 @@ class EnsembleSimulator:
     def _build_step(self):
         mesh = self.mesh
         batch_specs = _batch_specs()
-        inc_w, inc_e, inc_r, inc_d, inc_c, inc_g = self._include
+        inc = self._include
 
         def sharded(keys, batch, chol, gwb_w):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, inc_w, inc_e, inc_r, inc_d,
-                                  inc_c, inc_g)
+                                  self._gwb_freqf, *inc)
             return _correlation_rows(res, batch.mask)
 
         shmapped = jax.shard_map(

@@ -221,7 +221,7 @@ def test_ecorr_epoch_sampler_matches_block_covariance():
     f = jax.jit(jax.shard_map(
         lambda k, b: _simulate_block(k, b, jnp.eye(2), jnp.zeros((1,)), 0.0,
                                      1400.0, False, True, False, False, False,
-                                     False),
+                                     False, False),
         mesh=mesh1, in_specs=(P(), specs), out_specs=P(), check_vma=False))
     res = np.asarray(f(keys, batch))                 # (3000, 2, T)
     c2 = (10.0 ** log10_c) ** 2
@@ -287,3 +287,28 @@ def test_pallas_fused_multichip_psum():
     np.testing.assert_allclose(o8["curves"], r8["curves"], atol=1e-2 * scale)
     np.testing.assert_allclose(o8["autos"], r8["autos"], rtol=1e-2)
     assert o1["curves"].shape == o8["curves"].shape
+
+
+def test_system_noise_band_masked_and_scaled():
+    """from_pulsars turns '<backend>_system_noise_<backend>' entries into masked
+    GP bands: variance lands only on that backend's TOAs and matches sum(psd*df)."""
+    toas = np.linspace(0, 10 * const.yr, 60)
+    p = Pulsar(toas, 1e-9, 1.0, 1.0, seed=0, backends=["A.1400", "B.600"],
+               custom_model={"RN": None, "DM": None, "Sv": None})
+    p.add_system_noise(backend="A.1400", components=5, spectrum="powerlaw",
+                       log10_A=-13.0, gamma=3.0, seed=1)
+    batch = PulsarBatch.from_pulsars([p], n_red=4, n_dm=4, n_sys=5)
+    assert batch.sys_psd.shape == (1, 1, 5)
+    m = np.asarray(batch.sys_mask)[0, 0]
+    flags = np.asarray(p.backend_flags)
+    np.testing.assert_array_equal(m[:len(flags)], flags == "A.1400")
+
+    sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                            include=("sys",), nbins=4)
+    out = sim.run(800, seed=3, chunk=400, keep_corr=True)
+    auto = out["corr"][:, 0, 0].mean()   # mean over realizations of var estimate
+    # analytic variance on masked TOAs, diluted by the unmasked (zero) ones
+    frac = m.sum() / np.asarray(batch.mask)[0].sum()
+    want = float((np.asarray(batch.sys_psd)[0, 0]
+                  * np.asarray(batch.df_own)[0]).sum()) * frac
+    np.testing.assert_allclose(auto, want, rtol=0.25)
