@@ -2,6 +2,7 @@
 it hardcodes the author's absolute paths, SURVEY.md §4)."""
 
 import json
+import os
 import pickle
 import subprocess
 import sys
@@ -13,10 +14,15 @@ EXAMPLES = REPO / "examples"
 
 def _run(args, tmp_path):
     out = tmp_path / "out.pkl"
+    # The package is not necessarily pip-installed (fresh checkout): put the
+    # repo root on the subprocess's PYTHONPATH so `import fakepta_tpu` resolves.
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / "make_fake_array.py"), *args,
          "--platform", "cpu", "--out", str(out)],
-        capture_output=True, text=True, timeout=560, cwd=REPO)
+        capture_output=True, text=True, timeout=560, cwd=REPO, env=env)
     assert proc.returncode == 0, proc.stderr[-2000:]
     with open(out, "rb") as fh:
         psrs = pickle.load(fh)
