@@ -139,7 +139,8 @@ def _resolve_common_psd(spectrum, f_psd, custom_psd, kwargs):
         return np.asarray(custom_psd, dtype=np.float64), {}
     if spectrum not in spectrum_lib.SPECTRA:
         raise KeyError(f"unknown spectrum {spectrum!r}")
-    psd = np.asarray(spectrum_lib.evaluate(spectrum, f_psd, **kwargs), dtype=np.float64)
+    # device array: consumed by jitted kernels only (materialized at pickle time)
+    psd = spectrum_lib.evaluate(spectrum, f_psd, **kwargs)
     return psd, kwargs
 
 
@@ -174,25 +175,28 @@ def add_common_correlated_noise(psrs, orf="hd", spectrum="powerlaw", name="gw",
     chol = gwb_ops.orf_cholesky(orfs)
     key = rng_utils.as_key(seed) if seed is not None else \
         rng_utils.KeyStream(None, "gwb").next()
-    coeffs = np.asarray(gwb_ops.draw_correlated_coeffs(key, chol, psd_gwb))
+    # stays on device: per-pulsar slices feed straight back into jitted kernels,
+    # so the whole array injection runs without a single host sync
+    coeffs = gwb_ops.draw_correlated_coeffs(key, chol, psd_gwb)
+    inv_sqrt_df = 1.0 / np.sqrt(df)
 
     for n, psr in enumerate(psrs):
         if signal_name in psr.signal_model:
             # reconstruct_signal uses the OLD entry's stored freqf/idx scaling
-            psr.residuals = psr.residuals - psr.reconstruct_signal([signal_name])
+            psr._accumulate(-psr._reconstruct_signal_dev([signal_name]))
         entry = {
             "orf": orf,
             "spectrum": spectrum,
             "hmap": h_map,
             "f": f_psd,
             "psd": psd_gwb,
-            "fourier": coeffs[:, :, n] / np.sqrt(df)[None, :],
+            "fourier": coeffs[:, :, n] * inv_sqrt_df[None, :],
             "nbin": components,
             "idx": idx,
             "freqf": freqf,
         }
         psr.signal_model[signal_name] = entry
-        psr.residuals = psr.residuals + psr._reconstruct_gp(entry, None, None)
+        psr._accumulate(psr._reconstruct_gp(entry, None, None))
     return np.asarray(orfs)
 
 
@@ -261,14 +265,14 @@ def add_common_correlated_noise_gp(psrs, orf="hd", spectrum="powerlaw", name="gw
         if signal_name in psr.signal_model:
             # realization- and fourier-aware: a prior factorized injection under the
             # same name is subtracted with its own stored scaling
-            psr.residuals = psr.residuals - psr.reconstruct_signal([signal_name])
+            psr._accumulate(-psr._reconstruct_signal_dev([signal_name]))
         realization = draw[offsets[a]:offsets[a + 1]]
         psr.signal_model[signal_name] = {
             "orf": orf, "spectrum": spectrum, "hmap": h_map, "f": f_psd,
             "psd": psd_gwb, "nbin": len(f_psd), "idx": idx, "freqf": freqf,
             "realization": realization,
         }
-        psr.residuals = psr.residuals + realization
+        psr._accumulate(realization)
     return orfs
 
 
@@ -283,5 +287,5 @@ def add_roemer_delay(psrs, planet, d_mass=0.0, d_Om=0.0, d_omega=0.0, d_inc=0.0,
         if getattr(psr, "ephem", None) is None:
             raise ValueError(f'"ephem" not found in pulsar {psr.name}')
     for psr in psrs:
-        psr.residuals = psr.residuals + psr.ephem.roemer_delay(
-            psr.toas, psr.pos, planet, d_mass, d_Om, d_omega, d_inc, d_a, d_e, d_l0)
+        psr._accumulate(psr.ephem.roemer_delay(
+            psr.toas, psr.pos, planet, d_mass, d_Om, d_omega, d_inc, d_a, d_e, d_l0))

@@ -46,10 +46,37 @@ DAY_SECONDS = 86400.0
 
 @jax.jit
 def _k_inject(phase, scale, psd, df, key):
-    """Draw GP coefficients and inject: returns (delta_residuals, raw_coeffs)."""
+    """Draw GP coefficients and inject: returns (delta_residuals, stored fourier).
+
+    The stored-coefficient normalization ``c/sqrt(df)`` happens inside the kernel
+    so the facade never has to synchronize the draw back to host (padded bins have
+    ``df = 1`` by construction, so no NaN leaks through the division).
+    """
     basis = fourier_ops.basis_from_phase(phase, scale)
     coeffs = fourier_ops.draw_coeffs(key, psd)
-    return fourier_ops.inject_from_coeffs(basis, coeffs, df), coeffs
+    delta = fourier_ops.inject_from_coeffs(basis, coeffs, df)
+    return delta, coeffs / jnp.sqrt(df)[None, :]
+
+
+@jax.jit
+def _k_add(a, b):
+    """Accumulate a delta into the residuals entirely on device."""
+    return jnp.asarray(a) + b
+
+
+@jax.jit
+def _k_scatter_add(a, idx, delta):
+    """Masked accumulate: add delta at integer TOA indices, on device."""
+    return jnp.asarray(a).at[idx].add(delta)
+
+
+def _host_tree(obj):
+    """Recursively materialize device arrays to host numpy (pickle contract)."""
+    if isinstance(obj, jax.Array):
+        return np.asarray(obj)
+    if isinstance(obj, dict):
+        return {k: _host_tree(v) for k, v in obj.items()}
+    return obj
 
 
 @jax.jit
@@ -132,6 +159,57 @@ class Pulsar:
         self.make_Mmat()
         self.fitpars = list(self.tm_pars)
         self.init_noisedict(custom_noisedict)
+
+    # ------------------------------------------------------------------
+    # residual storage: device-resident between injector calls
+    # ------------------------------------------------------------------
+    #
+    # Host<->device round trips through the TPU runtime cost ~80 ms of latency
+    # each, flat, regardless of payload size — while jitted dispatch (including
+    # implicit uploads of numpy arguments) is sub-millisecond. The injectors
+    # therefore accumulate on device asynchronously and never synchronize; the
+    # host numpy view is materialized (one transfer) only when `.residuals` is
+    # actually read. Exactly one of the two slots is authoritative at any time,
+    # and reading drops the device copy so in-place numpy mutation of the
+    # returned array stays correct.
+
+    @property
+    def residuals(self):
+        """Timing residuals in seconds (host numpy view, lazily materialized).
+
+        Dtype note: device accumulation runs at the backend's default precision
+        (float32 on TPU unless ``jax_enable_x64``), matching the batch engine;
+        the reference accumulates in host float64 but its draws carry no more
+        than float32 information in the first place. Pickling always
+        materializes float64 (ENTERPRISE contract).
+        """
+        if self._res_host is None:
+            # np.array (not asarray): jax marks materialized buffers read-only,
+            # and callers may mutate the returned array in place
+            self._res_host = np.array(self._res_dev)
+            self._res_dev = None
+        return self._res_host
+
+    @residuals.setter
+    def residuals(self, value):
+        if isinstance(value, jax.Array):
+            self._res_dev = value
+            self._res_host = None
+        else:
+            self._res_host = np.asarray(value)
+            self._res_dev = None
+
+    def _res_current(self):
+        """Whichever residual buffer is authoritative, without forcing a sync."""
+        return self._res_dev if self._res_dev is not None else self._res_host
+
+    def _accumulate(self, delta, idx=None):
+        """residuals += delta (optionally scattered at TOA indices), no host sync."""
+        cur = self._res_current()
+        if idx is None:
+            self.residuals = _k_add(cur, delta)
+        else:
+            self.residuals = _k_scatter_add(cur, np.asarray(idx), delta)
 
     # ------------------------------------------------------------------
     # construction helpers
@@ -307,6 +385,9 @@ class Pulsar:
 
     @staticmethod
     def _pad_bins(arr, b_pad, fill=0.0):
+        if isinstance(arr, jax.Array):
+            # stays on device — padding a device-resident PSD must not sync
+            return jnp.pad(arr, (0, b_pad - arr.shape[0]), constant_values=fill)
         return pad_1d(np.asarray(arr, dtype=np.float64), b_pad, fill)
 
     # ------------------------------------------------------------------
@@ -341,7 +422,7 @@ class Pulsar:
             equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
             if add_ecorr:
                 ecorr[sel] = self.noisedict[f"{self.name}_{backend}_log10_ecorr"]
-        sigma2 = np.asarray(white_ops.white_sigma2(self.toaerrs, efac, equad))
+        sigma2 = white_ops.white_sigma2(self.toaerrs, efac, equad)
 
         if add_ecorr:
             epoch_idx, n_epochs, counts = self._epoch_segments()
@@ -350,7 +431,7 @@ class Pulsar:
                 key, sigma2, 10.0 ** (2.0 * ecorr), epoch_idx, n_epochs, weight)
         else:
             draw = _k_white(key, sigma2)
-        self.residuals = self.residuals + np.asarray(draw)
+        self._accumulate(draw)
 
     def _epoch_segments(self, dt=1.0, backends=None):
         """Integer epoch id per TOA — what the vectorized ECORR sampler consumes.
@@ -397,7 +478,10 @@ class Pulsar:
                 raise ValueError(
                     f"PSD parameters for {signal} must be in the noisedict or passed "
                     f"as keyword arguments (missing {exc})") from exc
-        psd = np.asarray(spectrum_lib.evaluate(spectrum, f_psd, **kwargs), dtype=np.float64)
+        # stays a device array: the PSD only feeds jitted kernels and the pickled
+        # signal_model (materialized at pickle time), so a host sync here would be
+        # a pure ~80 ms latency tax per injection
+        psd = spectrum_lib.evaluate(spectrum, f_psd, **kwargs)
         return psd, kwargs
 
     def add_red_noise(self, spectrum="powerlaw", f_psd=None, seed=None, **kwargs):
@@ -430,7 +514,7 @@ class Pulsar:
         if len(psd) != len(f_psd):
             raise ValueError('"psd" and "f_psd" must have the same length')
         if signal in self.signal_model:
-            self.residuals = self.residuals - self.reconstruct_signal([signal])
+            self._accumulate(-self._reconstruct_signal_dev([signal]))
         if resolved:
             self.update_noisedict(f"{self.name}_{signal}", resolved)
         self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
@@ -455,7 +539,7 @@ class Pulsar:
         if len(psd) != len(f_psd):
             raise ValueError('"psd" and "f_psd" must have the same length')
         if stored in self.signal_model:
-            self.residuals = self.residuals - self.reconstruct_signal([stored])
+            self._accumulate(-self._reconstruct_signal_dev([stored]))
         if resolved:
             self.update_noisedict(f"{self.name}_{signal}", resolved)
         self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
@@ -483,33 +567,30 @@ class Pulsar:
             mask = None
 
         f_psd = np.asarray(f_psd, dtype=np.float64)
-        psd = np.asarray(psd, dtype=np.float64)
+        if not isinstance(psd, jax.Array):
+            psd = np.asarray(psd, dtype=np.float64)
         if len(psd) != len(f_psd):
             raise ValueError('"psd" and "f_psd" must have the same length')
 
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, idx, freqf, mask)
         psd_pad = self._pad_bins(psd, len(df_pad))
-        delta_pad, coeffs_pad = _k_inject(phase, scale, psd_pad, df_pad, key)
-        delta = np.asarray(delta_pad)[:ntoa]
-        coeffs = np.asarray(coeffs_pad)[:, :nbin]
+        delta_pad, fourier_pad = _k_inject(phase, scale, psd_pad, df_pad, key)
 
-        df = df_pad[:nbin]
         self.signal_model[signal] = {
             "spectrum": spectrum,
             "f": f_psd,
             "psd": psd,
-            "fourier": coeffs / np.sqrt(df)[None, :],
+            "fourier": fourier_pad[:, :nbin],
             "nbin": nbin,
             "idx": idx,
             "freqf": freqf,
         }
+        delta = delta_pad[:ntoa]
         if mask is None:
-            self.residuals = self.residuals + delta
+            self._accumulate(delta)
         else:
-            out = self.residuals.copy()
-            out[mask] += delta
-            self.residuals = out
+            self._accumulate(delta, idx=np.flatnonzero(mask))
 
     # ------------------------------------------------------------------
     # deterministic injectors
@@ -532,7 +613,7 @@ class Pulsar:
             self.toas, self.pos, self.pdist, cos_gwtheta=costheta, gwphi=phi,
             cos_inc=cosinc, log10_mc=log10_mc, log10_fgw=log10_fgw, evolve=True,
             log10_h=log10_h, phase0=phase0, psi=psi, psrTerm=psrterm)
-        self.residuals = self.residuals + np.asarray(delay)
+        self._accumulate(delay)
 
     def add_deterministic(self, waveform, **kwargs):
         """Inject any user waveform ``waveform(toas=..., **kwargs)`` (ref :444-455).
@@ -544,7 +625,7 @@ class Pulsar:
         slot = self.signal_model.setdefault(fname, {})
         slot[str(len(slot))] = dict(kwargs)
         self._waveforms[fname] = waveform
-        self.residuals = self.residuals + np.asarray(waveform(toas=self.toas, **kwargs))
+        self._accumulate(waveform(toas=self.toas, **kwargs))
 
     # ------------------------------------------------------------------
     # coordinates and naming
@@ -609,7 +690,7 @@ class Pulsar:
         f_psd = np.asarray(entry["f"], dtype=np.float64)
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, entry["idx"], freqf, mask)
-        psd_pad = self._pad_bins(np.asarray(entry["psd"], dtype=np.float64), len(df_pad))
+        psd_pad = self._pad_bins(entry["psd"], len(df_pad))
         cov = np.asarray(_k_cov(phase, scale, psd_pad, df_pad))
         return cov[:ntoa, :ntoa]
 
@@ -659,32 +740,41 @@ class Pulsar:
         """
         if signals is None:
             signals = list(self.signal_model)
-        sig = np.zeros(len(self.toas))
+        # public API returns writable host numpy (reference contract); the device
+        # accumulation lives in _reconstruct_signal_dev for the injectors
+        return np.array(self._reconstruct_signal_dev(signals, freqf))
+
+    def _reconstruct_signal_dev(self, signals, freqf=None):
+        """Device-resident reconstruction: the injectors' re-injection path uses
+        this directly so subtract-old-realization never syncs to host."""
+        sig = jnp.zeros(len(self.toas))
         for signal in signals:
             if signal == "cgw":
                 for record in self.signal_model["cgw"].values():
-                    sig += np.asarray(cgw_model.cw_delay(
+                    sig = sig + cgw_model.cw_delay(
                         self.toas, self.pos, self.pdist,
                         cos_gwtheta=record["costheta"], gwphi=record["phi"],
                         cos_inc=record["cosinc"], log10_mc=record["log10_mc"],
                         log10_fgw=record["log10_fgw"], evolve=True,
                         log10_h=record["log10_h"], phase0=record["phase0"],
-                        psi=record["psi"], psrTerm=record["psrterm"]))
+                        psi=record["psi"], psrTerm=record["psrterm"])
             elif signal in self._waveforms:
                 for record in self.signal_model[signal].values():
-                    sig += np.asarray(self._waveforms[signal](toas=self.toas, **record))
+                    sig = sig + jnp.asarray(
+                        self._waveforms[signal](toas=self.toas, **record))
             elif "system_noise" in signal:
                 backend = signal.split("system_noise_")[1]
                 mask = self.backend_flags == backend
                 entry = self.signal_model[signal]
-                sig[mask] += self._reconstruct_gp(entry, freqf, mask)
+                sig = sig.at[np.flatnonzero(mask)].add(
+                    self._reconstruct_gp(entry, freqf, mask))
             elif signal in self.signal_model and "fourier" in self.signal_model[signal]:
                 entry = self.signal_model[signal]
-                sig += self._reconstruct_gp(entry, freqf, None)
+                sig = sig + self._reconstruct_gp(entry, freqf, None)
             elif signal in self.signal_model and \
                     "realization" in self.signal_model[signal]:
                 # joint-covariance common signals store the time-domain draw itself
-                sig += self.signal_model[signal]["realization"]
+                sig = sig + jnp.asarray(self.signal_model[signal]["realization"])
         return sig
 
     def _reconstruct_gp(self, entry, freqf, mask):
@@ -693,16 +783,16 @@ class Pulsar:
         f_psd = np.asarray(entry["f"], dtype=np.float64)
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, entry["idx"], freqf, mask)
-        four = np.zeros((2, len(df_pad)))
-        four[:, :nbin] = np.asarray(entry["fourier"])
-        out = np.asarray(_k_reconstruct(phase, scale, four, df_pad))
+        four = jnp.pad(jnp.asarray(entry["fourier"]),
+                       ((0, 0), (0, len(df_pad) - nbin)))
+        out = _k_reconstruct(phase, scale, four, df_pad)
         return out[:ntoa]
 
     def remove_signal(self, signals=None, freqf=None):
         """Subtract a signal's realization and forget it (ref ``fake_pta.py:557-567``)."""
         if signals is None:
             signals = list(self.signal_model)
-        self.residuals = self.residuals - self.reconstruct_signal(signals, freqf=freqf)
+        self._accumulate(-self._reconstruct_signal_dev(signals, freqf=freqf))
         for signal in signals:
             self.signal_model.pop(signal, None)
             self._waveforms.pop(signal, None)
@@ -711,15 +801,23 @@ class Pulsar:
                 if frag in key:
                     self.noisedict.pop(key)
 
-    # pickling: drop the non-serializable key stream / waveform callables gracefully
+    # pickling: materialize device-resident state to host numpy (the ENTERPRISE
+    # pickle contract, SURVEY.md §2.4) and drop the non-serializable key stream /
+    # waveform callables gracefully
     def __getstate__(self):
         state = dict(self.__dict__)
+        state.pop("_res_host", None)
+        state.pop("_res_dev", None)
+        state["residuals"] = np.asarray(self.residuals, dtype=np.float64)
+        state["signal_model"] = _host_tree(self.signal_model)
         state["_keys"] = None
         state["_waveforms"] = {}
         return state
 
     def __setstate__(self, state):
+        residuals = state.pop("residuals")
         self.__dict__.update(state)
+        self.residuals = np.asarray(residuals)
         if self.__dict__.get("_keys") is None:
             self._keys = rng_utils.KeyStream(None)
 
