@@ -20,6 +20,7 @@ Cited reference behavior being batched: per-pulsar Fourier injection
 from __future__ import annotations
 
 import dataclasses
+import warnings
 from typing import Optional, Sequence
 
 import jax
@@ -27,6 +28,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from .utils.masks import stack_ragged
+
+# the GP bands from_pulsars packs, with their canonical chromatic indices; the
+# unbatched-signal warning derives from the same tuple so they cannot drift
+_BATCHED_GPS = (("red_noise", 0.0), ("dm_gp", 2.0), ("chrom_gp", 4.0))
 
 
 @jax.tree_util.register_dataclass
@@ -144,6 +149,19 @@ class PulsarBatch:
                         f"{p.name}.{key} uses a custom frequency grid; the "
                         f"batch engine requires the standard n/Tspan grid")
 
+            # grid mismatches raise above; silently dropping *signals* would be
+            # inconsistent strictness, so anything this packer does not batch is
+            # warned about explicitly (ADVICE r1 #3)
+            known = {name for name, _ in _BATCHED_GPS}
+            unhandled = [key for key in getattr(p, "signal_model", {})
+                         if key not in known and "system_noise_" not in key]
+            if unhandled:
+                warnings.warn(
+                    f"{p.name}: signal_model entries {sorted(unhandled)} are not "
+                    f"batched by PulsarBatch.from_pulsars and will be absent from "
+                    f"ensemble simulations (common signals: pass a GWBConfig to "
+                    f"EnsembleSimulator instead)", stacklevel=2)
+
             bands = []
             for key, entry in getattr(p, "signal_model", {}).items():
                 if "system_noise_" not in key:
@@ -163,9 +181,10 @@ class PulsarBatch:
                 bpsd[:k] = entry["psd"][:k]
                 bands.append((bmask, bpsd))
             sys_bands.append(bands)
-            for signal, idx, target in (("red_noise", 0.0, red_psd),
-                                        ("dm_gp", 2.0, dm_psd),
-                                        ("chrom_gp", 4.0, chrom_psd)):
+            targets = {"red_noise": red_psd, "dm_gp": dm_psd,
+                       "chrom_gp": chrom_psd}
+            for signal, idx in _BATCHED_GPS:
+                target = targets[signal]
                 entry = getattr(p, "signal_model", {}).get(signal)
                 if entry is not None:
                     if float(entry.get("idx", idx)) != idx:

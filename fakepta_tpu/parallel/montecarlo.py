@@ -350,7 +350,7 @@ class EnsembleSimulator:
         """
         base = rng_utils.as_key(seed)
         chunk = int(min(chunk, nreal))
-        chunk -= chunk % self._n_real_shards or 0
+        chunk -= chunk % self._n_real_shards
         chunk = max(chunk, self._n_real_shards)
         curves_out, autos_out, corr_out = [], [], []
         done = 0
@@ -362,7 +362,7 @@ class EnsembleSimulator:
                 raise TypeError("checkpointing requires an integer seed (the "
                                 "checkpoint stores it to validate a resume)")
             ckpt = EnsembleCheckpoint(checkpoint)
-            state = ckpt.load(seed, nreal, chunk)
+            state = ckpt.load(seed, nreal, chunk, keep_corr=keep_corr)
             if state is not None:
                 done = int(state["done"])
                 curves_out.append(state["curves"])
@@ -388,9 +388,9 @@ class EnsembleSimulator:
             autos_out.append(np.asarray(autos))
             done += chunk
             if ckpt is not None:
-                ckpt.save(seed, nreal, chunk, done,
-                          np.concatenate(curves_out), np.concatenate(autos_out),
-                          np.concatenate(corr_out) if keep_corr else None)
+                # append-only: each save writes this chunk's arrays, O(chunk) I/O
+                ckpt.save(seed, nreal, chunk, done, curves_out[-1], autos_out[-1],
+                          corr_out[-1] if keep_corr else None)
             if progress is not None:
                 progress(min(done, nreal), nreal)
         out = {

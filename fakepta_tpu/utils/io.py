@@ -56,41 +56,79 @@ def load_custom_models(path) -> dict:
 class EnsembleCheckpoint:
     """Chunk-granular checkpoint/resume for :meth:`EnsembleSimulator.run`.
 
-    One ``.npz`` per run, rewritten atomically after every chunk: because each
-    chunk's RNG keys derive from ``fold_in(base_key, absolute_index)``, a resumed
-    run continues the *identical* realization stream — the result equals the
-    uninterrupted run, which the tests assert.
+    Append-only: each completed chunk is written once to its own ``.c<k>.npz``
+    file and a small manifest records how far the run got, so checkpoint I/O per
+    chunk is O(chunk), not O(done) (rewriting the accumulated history made each
+    save grow quadratically over the run). Because each chunk's RNG keys derive
+    from ``fold_in(base_key, absolute_index)``, a resumed run continues the
+    *identical* realization stream — the result equals the uninterrupted run,
+    which the tests assert.
     """
 
     def __init__(self, path):
         self.path = Path(path)
 
-    def load(self, seed, nreal: int, chunk: int) -> Optional[dict]:
-        """Return saved state if it matches this run's configuration."""
+    def _chunk_path(self, k: int) -> Path:
+        return self.path.with_name(self.path.name + f".c{k:06d}.npz")
+
+    def load(self, seed, nreal: int, chunk: int,
+             keep_corr: bool = True) -> Optional[dict]:
+        """Return accumulated saved state if it matches this run's configuration.
+
+        ``keep_corr=False`` skips reading the (large) per-chunk correlation
+        tensors that a ``keep_corr=False`` resume would discard anyway.
+        """
         if not self.path.exists():
             return None
         with np.load(self.path, allow_pickle=False) as z:
-            state = {k: z[k] for k in z.files}
-        if (int(state["seed"]) != int(seed) or int(state["nreal"]) != nreal
-                or int(state["chunk"]) != chunk):
+            manifest = {k: z[k] for k in z.files}
+        if (int(manifest["seed"]) != int(seed) or int(manifest["nreal"]) != nreal
+                or int(manifest["chunk"]) != chunk):
             raise ValueError(
                 f"checkpoint {self.path} was written by a different run "
-                f"(seed/nreal/chunk = {int(state['seed'])}/{int(state['nreal'])}"
-                f"/{int(state['chunk'])}, requested {seed}/{nreal}/{chunk}); "
-                f"delete it or use a different path")
+                f"(seed/nreal/chunk = {int(manifest['seed'])}/"
+                f"{int(manifest['nreal'])}/{int(manifest['chunk'])}, requested "
+                f"{seed}/{nreal}/{chunk}); delete it or use a different path")
+        done = int(manifest["done"])
+        if done and not self._chunk_path(0).exists():
+            raise ValueError(
+                f"checkpoint {self.path} has no chunk files (written by an "
+                f"older single-file format, or the .c*.npz files were removed); "
+                f"delete it and restart the run")
+        parts = []
+        for k in range(done // chunk):
+            with np.load(self._chunk_path(k), allow_pickle=False) as z:
+                keys = [key for key in z.files if keep_corr or key != "corr"]
+                parts.append({key: z[key] for key in keys})
+        state = {
+            "done": done,
+            "curves": np.concatenate([p["curves"] for p in parts]),
+            "autos": np.concatenate([p["autos"] for p in parts]),
+        }
+        if parts and all("corr" in p for p in parts):
+            state["corr"] = np.concatenate([p["corr"] for p in parts])
         return state
 
     def save(self, seed, nreal: int, chunk: int, done: int, curves, autos,
              corr=None):
+        """Record one completed chunk (its arrays only, not the accumulation)."""
         self.path.parent.mkdir(parents=True, exist_ok=True)
-        payload = dict(seed=np.int64(seed), nreal=np.int64(nreal),
-                       chunk=np.int64(chunk), done=np.int64(done),
-                       curves=curves, autos=autos)
+        payload = dict(curves=curves, autos=autos)
         if corr is not None:
             payload["corr"] = corr
-        tmp = self.path.with_suffix(".tmp.npz")
+        cpath = self._chunk_path(done // chunk - 1)
+        tmp = cpath.with_suffix(".tmp.npz")
         np.savez(tmp, **payload)
+        tmp.replace(cpath)
+        # manifest last: a crash between the two writes leaves an unreferenced
+        # chunk file that the next save simply overwrites
+        manifest = dict(seed=np.int64(seed), nreal=np.int64(nreal),
+                        chunk=np.int64(chunk), done=np.int64(done))
+        tmp = self.path.with_suffix(".tmp.npz")
+        np.savez(tmp, **manifest)
         tmp.replace(self.path)
 
     def delete(self):
+        for p in self.path.parent.glob(self.path.name + ".c*.npz"):
+            p.unlink(missing_ok=True)
         self.path.unlink(missing_ok=True)

@@ -83,6 +83,45 @@ def test_checkpoint_mismatched_run_rejected(sim, tmp_path):
         sim.run(24, seed=jax.random.key(0), chunk=8, checkpoint=ck)
 
 
+def test_checkpoint_saves_are_append_only(sim, tmp_path):
+    """Each save writes one O(chunk) chunk file; earlier files are untouched
+    (the previous format rewrote the full accumulated history every chunk)."""
+    ck = tmp_path / "mc.npz"
+    mtimes = {}
+    real_save = io_utils.EnsembleCheckpoint.save
+    def spy(self, *args, **kwargs):
+        real_save(self, *args, **kwargs)
+        for p in tmp_path.glob("mc.npz.c*.npz"):
+            mtimes.setdefault(p.name, []).append(p.stat().st_mtime_ns)
+    class Stop(Exception):
+        pass
+    def boom(done, nreal):
+        if done >= 24:
+            raise Stop
+    io_utils.EnsembleCheckpoint.save = spy
+    try:
+        with pytest.raises(Stop):
+            sim.run(24, seed=5, chunk=8, checkpoint=ck, progress=boom)
+    finally:
+        io_utils.EnsembleCheckpoint.save = real_save
+    assert len(mtimes) == 3                      # one file per completed chunk
+    for name, stamps in mtimes.items():
+        assert len(set(stamps)) == 1, f"{name} was rewritten"
+    # chunk files hold exactly one chunk of realizations
+    with np.load(tmp_path / "mc.npz.c000000.npz") as z:
+        assert z["curves"].shape[0] == 8
+
+
+def test_from_pulsars_warns_on_unbatched_signals():
+    toas = np.linspace(0, 10 * const.yr, 64)
+    p = Pulsar(toas, 1e-7, 1.0, 1.0, seed=0,
+               custom_model={"RN": 4, "DM": None, "Sv": None})
+    p.add_cgw(costheta=0.1, phi=1.0, cosinc=0.2, log10_mc=9.0, log10_fgw=-8.0,
+              log10_h=-14.0, phase0=0.5, psi=0.3)
+    with pytest.warns(UserWarning, match="cgw.*not.*batched"):
+        PulsarBatch.from_pulsars([p], n_red=4, n_dm=4)
+
+
 def test_progress_callback_reports_chunks(sim):
     seen = []
     sim.run(20, seed=1, chunk=8, progress=lambda d, n: seen.append((d, n)))
