@@ -14,8 +14,10 @@ SPMD layout (see :mod:`fakepta_tpu.parallel.mesh`):
   from an identical per-realization key ("replicate the small, shard the large"),
   so the *only* collective in the program is one ``all_gather`` of residual blocks
   over 'psr' to form cross-correlation rows;
-- per-shard independence of the local noises comes from folding the realization
-  key with ``lax.axis_index('psr')``.
+- per-pulsar noise keys fold the realization key with the *global* pulsar index
+  (``axis_index('psr') * p_local + local index``), so the realization stream is
+  bit-identical on every mesh shape — resharding changes how draws are
+  distributed, never what they are.
 
 Everything is a single jitted program per chunk; chunking bounds device memory at
 a few hundred MB regardless of the total realization count.
@@ -88,29 +90,43 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     dm_w = jnp.sqrt(batch.dm_psd * batch.df_own[:, None])              # (P,ND)
     p_total = chol.shape[0]
 
+    T = batch.t_own.shape[1]
+
     def one(key):
-        local_key = jax.random.fold_in(key, pidx)
-        kw, kr, kd, kc, ke, ks = jax.random.split(
-            jax.random.fold_in(local_key, 0x51), 6)
-        res = jnp.zeros((p_local, batch.t_own.shape[1]), dtype)
+        # noise keys fold by GLOBAL pulsar index, so realization streams are
+        # bit-identical on any mesh shape (1 device or a pod slice shard the
+        # same draws differently, they don't change them)
+        gidx = pidx * p_local + jnp.arange(p_local)
+        # the 0x51 domain tag is folded BEFORE the pulsar index so no global
+        # index can alias another key domain (fold_in(key, 107) would otherwise
+        # collide with the GWB key fold_in(key, 0x6B) at npsr >= 108)
+        noise_root = jax.random.fold_in(key, 0x51)
+
+        def psr_keys(g):
+            return jax.random.split(jax.random.fold_in(noise_root, g), 6)
+
+        kw, kr, kd, kc, ke, ks = jnp.moveaxis(jax.vmap(psr_keys)(gidx), 1, 0)
+        res = jnp.zeros((p_local, T), dtype)
         if include_white:
-            z = jax.random.normal(kw, batch.sigma2.shape, dtype)
+            z = jax.vmap(lambda k: jax.random.normal(k, (T,), dtype))(kw)
             res = res + jnp.sqrt(batch.sigma2) * z
         if include_ecorr:
             # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
             # ONE shared normal per epoch: no per-block Cholesky (the reference
             # draws a dense MVN per block, fake_pta.py:219-228)
-            u = jax.random.normal(ke, batch.epoch_idx.shape, dtype)  # >= n_epochs
+            u = jax.vmap(lambda k: jax.random.normal(k, (T,), dtype))(ke)
             shared = jnp.take_along_axis(u, batch.epoch_idx, axis=1)
             res = res + batch.ecorr_amp * shared
         if include_red:
-            c = jax.random.normal(kr, (p_local, 2, n_red), dtype) * red_w[:, None, :]
+            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_red), dtype))(kr) \
+                * red_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", red_basis, c)
         if include_dm:
-            c = jax.random.normal(kd, (p_local, 2, n_dm), dtype) * dm_w[:, None, :]
+            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_dm), dtype))(kd) \
+                * dm_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", dm_basis, c)
         if include_chrom:
-            c = jax.random.normal(kc, (p_local, 2, n_chrom), dtype) \
+            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_chrom), dtype))(kc) \
                 * chrom_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", chrom_basis, c)
         if include_sys:
@@ -119,7 +135,8 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             # injector; bands share the basis, draws are independent). Static
             # loop over the (small) band count so no (R, P, B, T) intermediate
             # is ever materialized under the realization vmap.
-            c = jax.random.normal(ks, (p_local, n_bands, 2, n_sys), dtype) \
+            c = jax.vmap(lambda k: jax.random.normal(k, (n_bands, 2, n_sys),
+                                                     dtype))(ks) \
                 * sys_w[:, :, None, :]
             for b in range(n_bands):
                 contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
@@ -338,11 +355,14 @@ class EnsembleSimulator:
         mean autocorrelations ``(nreal,)``, bin centers and (optionally) the raw
         pair-correlation matrices.
 
-        ``checkpoint``: a path — the run saves its accumulated outputs after every
-        chunk and, if the file already exists for the same (seed, nreal, chunk),
-        resumes after the last completed chunk. Because per-realization keys are
-        ``fold_in(base_key, absolute_index)``, the resumed stream is identical to
-        an uninterrupted run. The file is removed on successful completion.
+        ``checkpoint``: a path — after every chunk the run appends that chunk's
+        outputs to a sibling ``<path>.c<k>.npz`` file and updates a small
+        manifest at ``<path>`` (move/copy the whole family to relocate a
+        checkpoint). If a matching manifest for the same (seed, nreal, chunk)
+        exists, the run resumes after the last completed chunk. Because
+        per-realization keys are ``fold_in(base_key, absolute_index)``, the
+        resumed stream is identical to an uninterrupted run. All files are
+        removed on successful completion.
 
         ``progress``: callable ``(done, nreal) -> None`` invoked after each chunk
         (the reference's observability is print statements; this is the hook for
