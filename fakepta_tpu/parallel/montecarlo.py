@@ -106,28 +106,29 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             return jax.random.split(jax.random.fold_in(noise_root, g), 6)
 
         kw, kr, kd, kc, ke, ks = jnp.moveaxis(jax.vmap(psr_keys)(gidx), 1, 0)
+
+        def draw(keys_p, *shape):
+            """(P, *shape) normals, one independent stream per pulsar key."""
+            return jax.vmap(
+                lambda k: jax.random.normal(k, shape, dtype))(keys_p)
+
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
-            z = jax.vmap(lambda k: jax.random.normal(k, (T,), dtype))(kw)
-            res = res + jnp.sqrt(batch.sigma2) * z
+            res = res + jnp.sqrt(batch.sigma2) * draw(kw, T)
         if include_ecorr:
             # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
             # ONE shared normal per epoch: no per-block Cholesky (the reference
             # draws a dense MVN per block, fake_pta.py:219-228)
-            u = jax.vmap(lambda k: jax.random.normal(k, (T,), dtype))(ke)
-            shared = jnp.take_along_axis(u, batch.epoch_idx, axis=1)
+            shared = jnp.take_along_axis(draw(ke, T), batch.epoch_idx, axis=1)
             res = res + batch.ecorr_amp * shared
         if include_red:
-            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_red), dtype))(kr) \
-                * red_w[:, None, :]
+            c = draw(kr, 2, n_red) * red_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", red_basis, c)
         if include_dm:
-            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_dm), dtype))(kd) \
-                * dm_w[:, None, :]
+            c = draw(kd, 2, n_dm) * dm_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", dm_basis, c)
         if include_chrom:
-            c = jax.vmap(lambda k: jax.random.normal(k, (2, n_chrom), dtype))(kc) \
-                * chrom_w[:, None, :]
+            c = draw(kc, 2, n_chrom) * chrom_w[:, None, :]
             res = res + jnp.einsum("ptkn,pkn->pt", chrom_basis, c)
         if include_sys:
             # per-(pulsar, backend-band) GP on the shared basis, masked to the
@@ -135,9 +136,7 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             # injector; bands share the basis, draws are independent). Static
             # loop over the (small) band count so no (R, P, B, T) intermediate
             # is ever materialized under the realization vmap.
-            c = jax.vmap(lambda k: jax.random.normal(k, (n_bands, 2, n_sys),
-                                                     dtype))(ks) \
-                * sys_w[:, :, None, :]
+            c = draw(ks, n_bands, 2, n_sys) * sys_w[:, :, None, :]
             for b in range(n_bands):
                 contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
                 res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
