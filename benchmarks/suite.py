@@ -99,6 +99,44 @@ def config4():
             "value": round(t, 3), "unit": "s"}
 
 
+def config6():
+    """Config 4 as ONE device program (VERDICT r2 #5): 100-psr GWB + DM +
+    BayesEphem Roemer perturbation in the ensemble engine, Monte-Carlo over
+    realizations — no per-pulsar host loop anywhere."""
+    import jax
+
+    from fakepta_tpu import constants as const
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
+                                                 RoemerConfig)
+
+    n_dev = len(jax.devices())
+    npsr, ntoa = 100, 780
+    batch = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    toas_abs = np.tile(53000.0 * 86400.0
+                       + np.linspace(0.0, 15 * const.yr, ntoa), (npsr, 1))
+    sim = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"),
+        include=("white", "dm", "gwb", "det"),
+        roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
+        toas_abs=toas_abs, mesh=make_mesh(jax.devices()))
+    nreal, chunk = 4000, 4000
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    return {"config": 6,
+            "metric": "GWB+DM+BayesEphem realizations/s/chip (100 psr, one "
+                      "device program)",
+            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -157,7 +195,7 @@ def config5():
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", type=int, nargs="*", default=[1, 2, 3, 4, 5])
+    ap.add_argument("--configs", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     args = ap.parse_args()
@@ -166,7 +204,8 @@ def main():
         jax.config.update("jax_platforms", args.platform)
     import jax
 
-    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5}
+    fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
+           6: config6}
     rows = []
     for c in args.configs:
         row = fns[c]()

@@ -159,8 +159,8 @@ class PulsarBatch:
                 warnings.warn(
                     f"{p.name}: signal_model entries {sorted(unhandled)} are not "
                     f"batched by PulsarBatch.from_pulsars and will be absent from "
-                    f"ensemble simulations (common signals: pass a GWBConfig to "
-                    f"EnsembleSimulator instead)", stacklevel=2)
+                    f"ensemble simulations (pass GWBConfig / CGWConfig / "
+                    f"RoemerConfig to EnsembleSimulator instead)", stacklevel=2)
 
             bands = []
             for key, entry in getattr(p, "signal_model", {}).items():
@@ -281,6 +281,34 @@ class PulsarBatch:
             df_own=jnp.asarray(np.full(npsr, 1.0 / tspan), dtype),
             tspan_common=jnp.asarray(tspan, dtype),
         )
+
+
+def padded_abs_toas(psrs: Sequence) -> np.ndarray:
+    """(npsr, max_toa) float64 absolute MJD-second TOAs, zero-padded.
+
+    Companion to :meth:`PulsarBatch.from_pulsars` for the deterministic-signal
+    configs (CGW / BayesEphem): those need absolute epochs at host float64
+    precision, which the batch's normalized f32 times deliberately discard.
+    """
+    toas_pad, _ = stack_ragged(
+        [np.asarray(p.toas, dtype=np.float64) for p in psrs])
+    return toas_pad
+
+
+def padded_pdist(psrs: Sequence) -> np.ndarray:
+    """(npsr, 2) pulsar-distance (mean, sigma) pairs in kpc.
+
+    Scalar ``pdist`` attributes (copy_array replays store one number) get
+    sigma 0.
+    """
+    out = np.zeros((len(psrs), 2))
+    for i, p in enumerate(psrs):
+        pd = getattr(p, "pdist", (1.0, 0.2))
+        if np.ndim(pd) == 0:
+            out[i] = (float(pd), 0.0)
+        else:
+            out[i] = (float(pd[0]), float(pd[1]))
+    return out
 
 
 def fourier_basis_norm(t_norm, nbin: int, scale=None):

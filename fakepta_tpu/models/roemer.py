@@ -1,0 +1,201 @@
+"""Device-side solar-system ephemeris: batched orbits and BayesEphem deltas.
+
+The host :class:`fakepta_tpu.ephemeris.Ephemeris` computes Roemer-delay
+perturbations as the float64 difference of a perturbed and a nominal orbit
+(reference ``ephemeris.py:118-144``) — a ~1e-7 s difference of ~1e3
+light-second positions, hopeless in float32. This module makes the same physics
+run inside the f32 device program by never forming that difference:
+
+- the **nominal** orbit state (eccentric anomaly, elements, in-plane
+  coordinates, rotation trig, equatorial position) is propagated ONCE on host
+  in float64 and shipped to device as an :class:`OrbitState` pytree;
+- the **perturbation response** is computed on device entirely in first-order-
+  exact difference form: ``dE`` from :func:`fakepta_tpu.ops.kepler.
+  kepler_delta_newton` (Newton on the *difference* of the Kepler equations),
+  trig differences via ``2 sin(d/2) cos(mid)`` identities, rotation deltas per
+  axis — every intermediate is O(perturbation), so float32 round-off enters
+  only multiplicatively.
+
+This is what lets an ensemble sample BayesEphem nuisance parameters per
+realization on TPU — a capability with no reference counterpart (the reference
+cannot vary the ephemeris inside any loop without its in-place mutation bug,
+``ephemeris.py:131-136``).
+
+The nominal device path (:func:`orbit_positions_dev`) wires the jittable
+:func:`fakepta_tpu.ops.kepler.kepler_newton` solver into position assembly for
+batched (planet x pulsar x TOA) evaluation, validated against the float64 host
+ephemeris in the tests.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import constants as const
+from ..ops.kepler import delta_trig as _delta_trig
+from ..ops.kepler import kepler_delta_newton, kepler_newton
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass(frozen=True)
+class OrbitState:
+    """Nominal orbit of one body, propagated on host f64, device-resident.
+
+    All per-TOA leaves share the TOA shape ``(..., T)``; ``pos`` appends the
+    coordinate axis. Angles are stored as sine/cosine pairs so the device never
+    evaluates trig of a large or precision-critical angle.
+    """
+
+    sinE: jax.Array       # (..., T) eccentric anomaly
+    cosE: jax.Array
+    e: jax.Array          # (..., T) eccentricity (element rates make it per-TOA)
+    a: jax.Array          # (..., T) semi-major axis [light-s]
+    b: jax.Array          # (..., T) sqrt(1 - e^2)
+    x: jax.Array          # (..., T) in-plane coordinates [light-s]
+    y: jax.Array
+    sin_argp: jax.Array   # (..., T) argument of periapsis (varpi - Om)
+    cos_argp: jax.Array
+    sin_inc: jax.Array
+    cos_inc: jax.Array
+    sin_Om: jax.Array
+    cos_Om: jax.Array
+    pos: jax.Array        # (..., T, 3) nominal equatorial position [light-s]
+    mass: jax.Array       # () body mass [kg]
+    mass_ss: jax.Array    # () total solar-system mass [kg]
+
+
+def nominal_state(ephem, planet: str, toas, dtype=jnp.float32) -> OrbitState:
+    """Propagate the nominal orbit on host float64 and pack it for device use.
+
+    ``ephem``: a host :class:`fakepta_tpu.ephemeris.Ephemeris`; ``toas`` MJD
+    seconds of any shape (e.g. ``(T,)`` or padded ``(P, T)``).
+    """
+    el = ephem.planets[planet]
+    E, a_t, e_t, Om_t, varpi_t, inc_t = ephem._propagate_elements(
+        np.asarray(toas, dtype=np.float64), el["T"], el["Om"], el["omega"],
+        el["inc"], el["a"], el["e"], el["l0"])
+    argp_t = varpi_t - Om_t
+    b_t = np.sqrt(1.0 - e_t**2)
+    x = a_t * (np.cos(E) - e_t)
+    y = a_t * b_t * np.sin(E)
+    pos = ephem.get_orbit_planet(np.asarray(toas, dtype=np.float64), planet)
+
+    def dev(arr):
+        return jnp.asarray(np.broadcast_to(arr, np.shape(E)), dtype)
+
+    return OrbitState(
+        sinE=dev(np.sin(E)), cosE=dev(np.cos(E)), e=dev(e_t), a=dev(a_t),
+        b=dev(b_t), x=dev(x), y=dev(y),
+        sin_argp=dev(np.sin(argp_t)), cos_argp=dev(np.cos(argp_t)),
+        sin_inc=dev(np.sin(inc_t)), cos_inc=dev(np.cos(inc_t)),
+        sin_Om=dev(np.sin(Om_t)), cos_Om=dev(np.cos(Om_t)),
+        pos=jnp.asarray(pos, dtype),
+        mass=jnp.asarray(el["mass"], dtype),
+        mass_ss=jnp.asarray(ephem.mass_ss, dtype),
+    )
+
+
+def roemer_delay_dev(state: OrbitState, psr_pos, d_mass=0.0, d_Om=0.0,
+                     d_omega=0.0, d_inc=0.0, d_a=0.0, d_e=0.0, d_l0=0.0):
+    """BayesEphem Roemer delay [s] on device, float32-stable.
+
+    Same parameterization and units as the host
+    :meth:`fakepta_tpu.ephemeris.Ephemeris.roemer_delay` (angles in degrees,
+    ``d_a`` in AU, ``d_mass`` in kg): the SSB shift is
+    ``[(m + dm) r' - m r] / M_ss`` projected on the pulsar direction, computed
+    as ``[m (r' - r) + dm r'] / M_ss`` with ``r' - r`` assembled from
+    difference identities only. Perturbation arguments are scalars or arrays
+    broadcastable to the TOA shape — vmap over them for per-realization
+    BayesEphem sampling.
+
+    ``psr_pos``: ``(..., 3)`` unit vectors broadcasting against the state's
+    leading axes (e.g. ``(P, 3)`` with a ``(P, T)`` state).
+    """
+    dtype = state.x.dtype
+    deg = jnp.asarray(jnp.deg2rad(1.0), dtype)
+    d_M = (jnp.asarray(d_l0, dtype) - jnp.asarray(d_omega, dtype)) * deg
+    d_varpi = jnp.asarray(d_omega, dtype) * deg
+    d_Om_r = jnp.asarray(d_Om, dtype) * deg
+    d_argp = d_varpi - d_Om_r
+    d_inc_r = jnp.asarray(d_inc, dtype) * deg
+    d_a_ls = jnp.asarray(d_a, dtype) * (const.AU / const.c)
+    d_e = jnp.asarray(d_e, dtype)
+
+    e, a, b = state.e, state.a, state.b
+    dE = kepler_delta_newton(state.sinE, state.cosE, e, d_M, d_e)
+    d_sinE, d_cosE = _delta_trig(state.sinE, state.cosE, dE)
+
+    e_p = e + d_e
+    a_p = a + d_a_ls
+    # b' - b = (e^2 - e'^2)/(b + b'), with b' ~ b in the denominator at first
+    # order; solve the quadratic-free form iteratively once (ample at O(d))
+    d_b = -(d_e * (e + e_p)) / (b + jnp.sqrt(jnp.maximum(1.0 - e_p**2, 0.0)))
+    b_p = b + d_b
+
+    # in-plane deltas (x = a (cos E - e), y = a b sin E)
+    d_x = a_p * (d_cosE - d_e) + d_a_ls * (state.cosE - e)
+    d_y = a_p * b_p * d_sinE + (a_p * d_b + d_a_ls * b) * state.sinE
+
+    # stage 1: in-plane rotation by argp
+    d_s_argp, d_c_argp = _delta_trig(state.sin_argp, state.cos_argp, d_argp)
+    c_argp_p = state.cos_argp + d_c_argp
+    s_argp_p = state.sin_argp + d_s_argp
+    u = state.x * state.cos_argp - state.y * state.sin_argp
+    v = state.x * state.sin_argp + state.y * state.cos_argp
+    d_u = d_x * c_argp_p - d_y * s_argp_p + state.x * d_c_argp - state.y * d_s_argp
+    d_v = d_x * s_argp_p + d_y * c_argp_p + state.x * d_s_argp + state.y * d_c_argp
+
+    # stage 2: inclination about the node line
+    d_s_inc, d_c_inc = _delta_trig(state.sin_inc, state.cos_inc, d_inc_r)
+    p = state.cos_inc * v
+    d_p = (state.cos_inc + d_c_inc) * d_v + v * d_c_inc
+    d_q = (state.sin_inc + d_s_inc) * d_v + v * d_s_inc
+
+    # stage 3: rotation by Om about the ecliptic pole
+    d_s_Om, d_c_Om = _delta_trig(state.sin_Om, state.cos_Om, d_Om_r)
+    c_Om_p = state.cos_Om + d_c_Om
+    s_Om_p = state.sin_Om + d_s_Om
+    d_x_ec = c_Om_p * d_u - s_Om_p * d_p + u * d_c_Om - p * d_s_Om
+    d_y_ec = s_Om_p * d_u + c_Om_p * d_p + u * d_s_Om + p * d_c_Om
+    d_z_ec = d_q
+
+    # constant obliquity tilt (exactly linear — applies to the delta directly)
+    ce = jnp.asarray(np.cos(const.OBLIQUITY), dtype)
+    se = jnp.asarray(np.sin(const.OBLIQUITY), dtype)
+    d_r = jnp.stack([d_x_ec, ce * d_y_ec - se * d_z_ec,
+                     se * d_y_ec + ce * d_z_ec], axis=-1)
+
+    d_mass = jnp.asarray(d_mass, dtype)
+    d_ssb = (state.mass * d_r + d_mass * (state.pos + d_r)) / state.mass_ss
+    psr_pos = jnp.asarray(psr_pos, dtype)
+    return jnp.einsum("...ti,...i->...t", d_ssb, psr_pos)
+
+
+def orbit_positions_dev(M, e, a, sin_Om, cos_Om, sin_argp, cos_argp, sin_inc,
+                        cos_inc):
+    """Nominal equatorial positions [light-s] on device via the jittable
+    :func:`kepler_newton` solver.
+
+    ``M`` must be reduced mod 2 pi on host (float64) before casting — the
+    raw mean longitude spans ~1e3 revolutions over a century, far beyond f32.
+    Batched over any leading shape: (planet x pulsar x TOA) in one call.
+    """
+    E = kepler_newton(M, e)
+    b = jnp.sqrt(1.0 - e**2)
+    x = a * (jnp.cos(E) - e)
+    y = a * b * jnp.sin(E)
+    u = x * cos_argp - y * sin_argp
+    v = x * sin_argp + y * cos_argp
+    p = cos_inc * v
+    q = sin_inc * v
+    x_ec = cos_Om * u - sin_Om * p
+    y_ec = sin_Om * u + cos_Om * p
+    z_ec = q
+    ce = jnp.cos(jnp.asarray(const.OBLIQUITY, x_ec.dtype))
+    se = jnp.sin(jnp.asarray(const.OBLIQUITY, x_ec.dtype))
+    return jnp.stack([x_ec, ce * y_ec - se * z_ec, se * y_ec + ce * z_ec],
+                     axis=-1)

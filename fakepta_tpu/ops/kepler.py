@@ -39,3 +39,47 @@ def kepler_newton(M, e, iters: int = _DEFAULT_ITERS):
     for _ in range(iters):
         E = E - (E - e * jnp.sin(E) - M) / (1.0 - e * jnp.cos(E))
     return E
+
+
+def delta_trig(sin_a, cos_a, d):
+    """Stable ``(sin(a+d) - sin a, cos(a+d) - cos a)`` from the nominal pair.
+
+    Uses the half-angle identities ``2 sin(d/2) cos(a + d/2)`` /
+    ``-2 sin(d/2) sin(a + d/2)`` so no large angle is ever evaluated and every
+    output is O(d) — the building block of the float32-stable perturbed-orbit
+    path (see :func:`kepler_delta_newton` and ``models/roemer.py``).
+    """
+    sin_half = jnp.sin(0.5 * d)
+    cos_half = jnp.cos(0.5 * d)
+    sin_mid = sin_a * cos_half + cos_a * sin_half
+    cos_mid = cos_a * cos_half - sin_a * sin_half
+    return 2.0 * cos_mid * sin_half, -2.0 * sin_mid * sin_half
+
+
+def kepler_delta_newton(sinE, cosE, e, d_M, d_e, iters: int = _DEFAULT_ITERS):
+    """Perturbation ``dE = E' - E`` of the eccentric anomaly, cancellation-free.
+
+    Given the nominal solution ``E - e sin E = M`` (passed as its sine/cosine),
+    solves the *difference* of the perturbed Kepler equation
+    ``(E+dE) - (e+de) sin(E+dE) = M + dM`` directly for ``dE``:
+
+        f(dE)  = dE - 2 e sin(dE/2) cos(E + dE/2) - de sin(E + dE) - dM
+        f'(dE) = 1 - (e + de) cos(E + dE)
+
+    Every term is O(perturbation), so the solve is exact in float32 even though
+    ``E' - E`` computed from two separate float32 Kepler solves would be pure
+    round-off. This is what lets BayesEphem-style perturbed orbits run inside
+    the f32 device program (the host reference computes both orbits in f64 and
+    subtracts, ``ephemeris.py:139``).
+    """
+    sinE = jnp.asarray(sinE)
+    cosE = jnp.asarray(cosE)
+    dE = (d_M + d_e * sinE) / (1.0 - e * cosE)
+    for _ in range(iters):
+        d_sin, d_cos = delta_trig(sinE, cosE, dE)
+        # e [sin(E+dE) - sin E] written via the stable difference; the full-
+        # angle values only multiply the already-small d_e
+        f = dE - e * d_sin - d_e * (sinE + d_sin) - d_M
+        fp = 1.0 - (e + d_e) * (cosE + d_cos)
+        dE = dE - f / fp
+    return dE

@@ -52,6 +52,47 @@ class GWBConfig:
     freqf: float = 1400.0
 
 
+@dataclasses.dataclass(frozen=True)
+class CGWConfig:
+    """A deterministic continuous-wave source for the ensemble.
+
+    Same parameterization as the facade's ``Pulsar.add_cgw`` (reference
+    ``fake_pta.py:422-442``); evaluated once at simulator construction with
+    :func:`fakepta_tpu.models.cgw.cw_delay` vmapped over the pulsar batch.
+    """
+
+    costheta: float
+    phi: float
+    cosinc: float
+    log10_mc: float
+    log10_fgw: float
+    log10_h: Optional[float] = None
+    log10_dist: Optional[float] = None
+    phase0: float = 0.0
+    psi: float = 0.0
+    psrterm: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class RoemerConfig:
+    """A BayesEphem-style ephemeris perturbation for the ensemble.
+
+    Same parameterization and units as the facade's
+    ``correlated_noises.add_roemer_delay`` (reference ``ephemeris.py:118-144``);
+    evaluated on device with the float32-stable delta kernel
+    (:func:`fakepta_tpu.models.roemer.roemer_delay_dev`).
+    """
+
+    planet: str
+    d_mass: float = 0.0
+    d_Om: float = 0.0
+    d_omega: float = 0.0
+    d_inc: float = 0.0
+    d_a: float = 0.0
+    d_e: float = 0.0
+    d_l0: float = 0.0
+
+
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                     include_white, include_ecorr, include_red, include_dm,
                     include_chrom, include_sys, include_gwb):
@@ -154,6 +195,73 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     return jax.vmap(one)(keys)
 
 
+def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
+    """(P, T) summed deterministic delay block, or None if nothing configured.
+
+    ``cgw``/``roemer`` accept a single config or a sequence. CGW waveforms are
+    vmapped over pulsars on device (f32 phases are fine: the ~1e-6 rad error
+    from 28 s TOA quantization is far below the waveform scale); Roemer deltas
+    go through the f32-stable difference kernel with the nominal orbit
+    propagated host-side in float64.
+    """
+    cgw_list = [] if cgw is None else (list(cgw) if isinstance(
+        cgw, (list, tuple)) else [cgw])
+    roe_list = [] if roemer is None else (list(roemer) if isinstance(
+        roemer, (list, tuple)) else [roemer])
+    if not cgw_list and not roe_list:
+        return None
+    if toas_abs is None:
+        raise ValueError(
+            "cgw/roemer deterministic signals need toas_abs: the padded "
+            "(npsr, max_toa) absolute MJD-second TOAs (float64 host array; "
+            "see batch.padded_abs_toas)")
+    toas_abs = np.asarray(toas_abs, dtype=np.float64)
+    if toas_abs.shape != batch.t_own.shape:
+        raise ValueError(f"toas_abs shape {toas_abs.shape} != batch "
+                         f"{batch.t_own.shape}")
+
+    det = jnp.zeros(batch.t_own.shape, dtype)
+    if cgw_list:
+        from jax import enable_x64
+
+        from ..models import cgw as cgw_model
+
+        if pdist is None:
+            pdist = np.zeros((batch.npsr, 2))
+        pdist = np.asarray(pdist, dtype=np.float64).reshape(batch.npsr, 2)
+        pos64 = np.asarray(batch.pos, dtype=np.float64)
+        # construction-time, once: evaluate at float64 on the host CPU backend
+        # (absolute MJD-second epochs ~4.6e9 s quantize at ~550 s in f32 —
+        # ~2e-5 rad of phase error the one-off f64 evaluation avoids for free)
+        with enable_x64(), jax.default_device(jax.devices("cpu")[0]):
+            for cfg in cgw_list:
+                delay = jax.vmap(
+                    lambda t, pos, pd, c=cfg: cgw_model.cw_delay(
+                        t, pos, (pd[0], pd[1]), cos_gwtheta=c.costheta,
+                        gwphi=c.phi, cos_inc=c.cosinc, log10_mc=c.log10_mc,
+                        log10_fgw=c.log10_fgw, log10_h=c.log10_h,
+                        log10_dist=c.log10_dist, phase0=c.phase0, psi=c.psi,
+                        psrTerm=c.psrterm, evolve=True))(
+                    jnp.asarray(toas_abs), jnp.asarray(pos64),
+                    jnp.asarray(pdist))
+                det = det + jnp.asarray(np.asarray(delay), dtype)
+    if roe_list:
+        from ..models import roemer as roemer_dev
+
+        if ephem is None:
+            from ..ephemeris import Ephemeris
+            ephem = Ephemeris()
+        for cfg in roe_list:
+            state = roemer_dev.nominal_state(ephem, cfg.planet, toas_abs,
+                                             dtype=dtype)
+            delay = jax.jit(roemer_dev.roemer_delay_dev)(
+                state, batch.pos, d_mass=cfg.d_mass, d_Om=cfg.d_Om,
+                d_omega=cfg.d_omega, d_inc=cfg.d_inc, d_a=cfg.d_a,
+                d_e=cfg.d_e, d_l0=cfg.d_l0)
+            det = det + delay.astype(dtype)
+    return jnp.where(batch.mask, det, 0.0)
+
+
 def _batch_specs():
     """PartitionSpecs for a PulsarBatch: every (npsr, ...) leaf shards over the
     psr axis, scalars replicate. Derived from the dataclass fields so adding a
@@ -190,8 +298,9 @@ class EnsembleSimulator:
 
     def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
                  mesh=None, include=("white", "ecorr", "red", "dm", "chrom",
-                                     "sys", "gwb"),
-                 nbins: int = 15, use_pallas: Optional[bool] = None):
+                                     "sys", "gwb", "det"),
+                 nbins: int = 15, use_pallas: Optional[bool] = None,
+                 cgw=None, roemer=None, ephem=None, toas_abs=None, pdist=None):
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
         n_psr_shards = self.mesh.shape[PSR_AXIS]
@@ -233,6 +342,20 @@ class EnsembleSimulator:
                          ("sys" in include and has_sys),
                          ("gwb" in include and gwb is not None))
 
+        # deterministic signals (CGW sources + BayesEphem Roemer perturbations):
+        # evaluated ONCE here into a (P, T) delay block that the kernel adds to
+        # every realization — BASELINE config 4 (GWB + DM + BayesEphem at 100
+        # psr) as a single device program. ``toas_abs`` are the padded absolute
+        # MJD-second TOAs (host float64: the ephemeris element propagation and
+        # CGW epoch both need more than f32 gives on ~1e9 s). Only built when
+        # the 'det' stage is actually enabled.
+        self._det = _build_deterministic(
+            batch, cgw, roemer, ephem, toas_abs, pdist, dtype) \
+            if "det" in include else None
+        self._has_det = self._det is not None
+        if self._det is None:
+            self._det = jnp.zeros_like(batch.t_own)
+
         # angular bins for the correlation curve (static, from positions)
         pos = np.asarray(batch.pos, dtype=np.float64)
         ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
@@ -265,15 +388,18 @@ class EnsembleSimulator:
         mesh = self.mesh
         batch_specs = _batch_specs()
         inc = self._include
+        has_det = self._has_det
 
-        def sharded(keys, batch, chol, gwb_w):
+        def sharded(keys, batch, chol, gwb_w, det):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
+            if has_det:
+                res = res + det[None]
             return _correlation_rows(res, batch.mask)
 
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs, P(), P()),
+            in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS)),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
 
@@ -282,7 +408,8 @@ class EnsembleSimulator:
             # per-realization keys derived on device: one tiny transfer per chunk
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
-            corr = shmapped(keys, self.batch, self._chol, self._gwb_w)
+            corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
+                            self._det)
             curves = (jnp.einsum("rpq,pqn->rn", corr, self._bin_onehot)
                       / self._bin_counts)
             # normalize by the mean autocorrelation to a unitless HD statistic
@@ -316,9 +443,13 @@ class EnsembleSimulator:
         nbins = self.nbins
         interpret = self._pallas_interpret
 
-        def sharded(keys, batch, chol, gwb_w, weights):
+        has_det = self._has_det
+
+        def sharded(keys, batch, chol, gwb_w, weights, det):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
+            if has_det:
+                res = res + det[None]
             res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
             rt = next(k for k in (16, 8, 4, 2, 1) if r_local % k == 0)
@@ -330,7 +461,7 @@ class EnsembleSimulator:
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
-                      P(None, PSR_AXIS, None)),
+                      P(None, PSR_AXIS, None), P(PSR_AXIS)),
             out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
             # pallas_call does not annotate vma on its outputs; the psum above
             # makes the outputs replicated over 'psr' by construction
@@ -342,7 +473,7 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             return shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._stat_weights)
+                            self._stat_weights, self._det)
 
         return step
 

@@ -1,0 +1,111 @@
+"""Deterministic signals (CGW + BayesEphem Roemer) inside the ensemble engine.
+
+BASELINE config 4 (GWB + DM + BayesEphem at 100 pulsars) must run as ONE
+device program (VERDICT r2 missing #5); the facade injectors are the parity
+oracle.
+"""
+
+import jax
+import numpy as np
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.batch import PulsarBatch, padded_abs_toas, padded_pdist
+from fakepta_tpu.correlated_noises import add_roemer_delay
+from fakepta_tpu.ephemeris import Ephemeris
+from fakepta_tpu.fake_pta import Pulsar
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import (CGWConfig, EnsembleSimulator,
+                                             RoemerConfig)
+
+MJD0_S = 53000.0 * 86400.0
+
+CGW = dict(costheta=0.21, phi=2.9, cosinc=0.4, log10_mc=9.2, log10_fgw=-7.9,
+           log10_h=-13.6, phase0=1.1, psi=0.7)
+ROEMER = dict(planet="jupiter", d_mass=1.5e-4 * 1.899e27, d_Om=2e-4,
+              d_l0=-3e-4)
+
+
+def _psrs(n=3, T=90):
+    ephem = Ephemeris()
+    psrs = []
+    for k in range(n):
+        toas = MJD0_S + np.linspace(0, (8 + 2 * k) * const.yr, T - 5 * k)
+        psrs.append(Pulsar(toas, 1e-7, 1.0 + 0.3 * k, 0.5 + 0.7 * k, seed=k,
+                           pdist=(1.0 + 0.1 * k, 0.0), ephem=ephem,
+                           custom_model={"RN": 4, "DM": None, "Sv": None}))
+    return psrs, ephem
+
+
+def test_det_delay_matches_facade_injections():
+    """The simulator's (P, T) deterministic block equals what the facade
+    injects per pulsar (CGW earth+pulsar term plus Roemer perturbation)."""
+    psrs, ephem = _psrs()
+    for p in psrs:
+        p.make_ideal()
+        p.add_cgw(psrterm=True, **CGW)
+    add_roemer_delay(psrs, **ROEMER)
+
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    sim = EnsembleSimulator(
+        batch, mesh=make_mesh(jax.devices()[:1]),
+        cgw=CGWConfig(psrterm=True, **CGW), roemer=RoemerConfig(**ROEMER),
+        ephem=ephem, toas_abs=padded_abs_toas(psrs), pdist=padded_pdist(psrs))
+
+    det = np.asarray(sim._det)
+    for i, p in enumerate(psrs):
+        n = len(p.toas)
+        want = p.residuals
+        scale = np.abs(want).max()
+        assert scale > 0
+        np.testing.assert_allclose(det[i, :n], want, atol=2e-5 * scale,
+                                   err_msg=p.name)
+        np.testing.assert_array_equal(det[i, n:], 0.0)
+
+
+def test_det_signals_enter_the_ensemble_statistics():
+    """det-only ensemble: every realization carries exactly the deterministic
+    residual power; disabling via include removes it."""
+    psrs, ephem = _psrs()
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    kw = dict(mesh=make_mesh(jax.devices()[:1]), cgw=CGWConfig(**CGW),
+              ephem=ephem, toas_abs=padded_abs_toas(psrs),
+              pdist=padded_pdist(psrs))
+    on = EnsembleSimulator(batch, include=("det",), **kw)
+    out = on.run(4, seed=0, chunk=4, keep_corr=True)
+    # deterministic only: all realizations identical
+    assert np.ptp(out["corr"], axis=0).max() == 0.0
+    det = np.asarray(on._det)
+    mask = np.asarray(batch.mask)
+    want_auto = np.array([
+        (det[i] ** 2).sum() / mask[i].sum() for i in range(batch.npsr)])
+    np.testing.assert_allclose(out["corr"][0, np.arange(3), np.arange(3)],
+                               want_auto, rtol=1e-5)
+
+    off = EnsembleSimulator(batch, include=("white",), **kw)
+    assert not off._has_det
+    out_off = off.run(4, seed=0, chunk=4)
+    assert np.all(np.isfinite(out_off["curves"]))
+
+
+def test_det_sharded_mesh_matches_single_device():
+    """The deterministic block shards over 'psr' like every other (P, T) leaf."""
+    psrs, ephem = _psrs(n=4, T=64)
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    kw = dict(cgw=CGWConfig(**CGW), roemer=RoemerConfig(**ROEMER), ephem=ephem,
+              toas_abs=padded_abs_toas(psrs), pdist=padded_pdist(psrs))
+    o1 = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]), **kw
+                           ).run(8, seed=3, chunk=8)
+    o8 = EnsembleSimulator(batch, mesh=make_mesh(jax.devices(), psr_shards=2),
+                           **kw).run(8, seed=3, chunk=8)
+    scale = np.abs(o1["curves"]).max()
+    np.testing.assert_allclose(o8["curves"], o1["curves"], rtol=1e-5,
+                               atol=1e-4 * scale)
+
+
+def test_missing_toas_abs_raises():
+    psrs, _ = _psrs()
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    import pytest
+    with pytest.raises(ValueError, match="toas_abs"):
+        EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                          cgw=CGWConfig(**CGW))

@@ -1,0 +1,134 @@
+"""Device ephemeris tests: batched Kepler path + f32-stable BayesEphem deltas.
+
+Parity oracle is the float64 host :class:`fakepta_tpu.ephemeris.Ephemeris`
+(reference semantics, ``ephemeris.py:58-144``); the device code under test is
+:mod:`fakepta_tpu.models.roemer` (VERDICT r2 missing #6 / next #8).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.ephemeris import Ephemeris
+from fakepta_tpu.models import roemer as roemer_dev
+
+MJD0_S = 53000.0 * 86400.0   # ~2004, mid-range of the JPL element validity
+TOAS = MJD0_S + np.linspace(0.0, 15 * const.yr, 300)
+
+# a typical BayesEphem-scale perturbation of Jupiter
+DELTAS = dict(d_mass=1.2e-4 * 1.899e27, d_Om=3e-4, d_omega=-2e-4, d_inc=1e-4,
+              d_a=4e-8, d_e=3e-7, d_l0=-5e-4)
+
+
+def _host_elements(ephem, planet, toas):
+    el = ephem.planets[planet]
+    E, a_t, e_t, Om_t, varpi_t, inc_t = ephem._propagate_elements(
+        toas, el["T"], el["Om"], el["omega"], el["inc"], el["a"], el["e"],
+        el["l0"])
+    M = E - e_t * np.sin(E)
+    argp_t = varpi_t - Om_t
+    return dict(M=M, e=e_t, a=a_t, sin_Om=np.sin(Om_t), cos_Om=np.cos(Om_t),
+                sin_argp=np.sin(argp_t), cos_argp=np.cos(argp_t),
+                sin_inc=np.sin(inc_t), cos_inc=np.cos(inc_t))
+
+
+def test_orbit_positions_dev_matches_host_f64():
+    """The jitted kepler_newton position path reproduces the host orbit."""
+    ephem = Ephemeris()
+    want = ephem.get_orbit_planet(TOAS, "jupiter")
+    el = _host_elements(ephem, "jupiter", TOAS)
+    got = np.asarray(jax.jit(roemer_dev.orbit_positions_dev)(
+        **{k: jnp.asarray(v) for k, v in el.items()}))
+    np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-9)
+
+
+def test_orbit_positions_dev_f32_batched_planets():
+    """(planet, T) batched f32 positions agree with the host to f32 tolerance."""
+    ephem = Ephemeris()
+    planets = ["earth", "mars", "jupiter", "saturn"]
+    els = [_host_elements(ephem, p, TOAS) for p in planets]
+    stacked = {k: jnp.asarray(np.stack([e[k] for e in els]), jnp.float32)
+               for k in els[0]}
+    got = np.asarray(jax.jit(roemer_dev.orbit_positions_dev)(**stacked))
+    for i, p in enumerate(planets):
+        want = ephem.get_orbit_planet(TOAS, p)
+        scale = np.abs(want).max()
+        np.testing.assert_allclose(got[i], want, atol=3e-6 * scale,
+                                   err_msg=p)
+
+
+def test_roemer_delta_matches_host_in_f64():
+    """Difference-form kernel == host perturbed-minus-nominal, both f64."""
+    ephem = Ephemeris()
+    pos = np.array([0.3, -0.5, np.sqrt(1 - 0.09 - 0.25)])
+    want = ephem.roemer_delay(TOAS, pos, "jupiter", **DELTAS)
+    state = roemer_dev.nominal_state(ephem, "jupiter", TOAS, dtype=jnp.float64)
+    got = np.asarray(roemer_dev.roemer_delay_dev(state, pos, **DELTAS))
+    assert np.abs(want).max() > 1e-9   # the perturbation is non-trivial
+    np.testing.assert_allclose(got, want, rtol=1e-9,
+                               atol=1e-9 * np.abs(want).max())
+
+
+def test_roemer_delta_is_float32_stable():
+    """The headline property: the delta kernel stays accurate in f32, where the
+    naive perturbed-minus-nominal subtraction is pure round-off."""
+    ephem = Ephemeris()
+    pos = np.array([0.3, -0.5, np.sqrt(1 - 0.09 - 0.25)])
+    want = ephem.roemer_delay(TOAS, pos, "jupiter", **DELTAS)
+    scale = np.abs(want).max()
+
+    state32 = roemer_dev.nominal_state(ephem, "jupiter", TOAS,
+                                       dtype=jnp.float32)
+    got32 = np.asarray(roemer_dev.roemer_delay_dev(state32, pos, **DELTAS))
+    err = np.abs(got32 - want).max()
+    assert err < 1e-4 * scale, (err, scale)
+
+    # the naive f32 route for comparison: difference of two f32 orbit
+    # projections is dominated by round-off of the ~1e3 light-second orbit
+    el = ephem.planets["jupiter"]
+    pert = {k: list(el[k]) for k in ("Om", "omega", "inc", "a", "e", "l0")}
+    pert["Om"][0] += DELTAS["d_Om"]; pert["omega"][0] += DELTAS["d_omega"]
+    pert["inc"][0] += DELTAS["d_inc"]; pert["a"][0] += DELTAS["d_a"]
+    pert["e"][0] += DELTAS["d_e"]; pert["l0"][0] += DELTAS["d_l0"]
+    perturbed = ephem.compute_orbit(TOAS, el["T"], pert["Om"], pert["omega"],
+                                    pert["inc"], pert["a"], pert["e"],
+                                    pert["l0"])
+    m, dm = el["mass"], DELTAS["d_mass"]
+    nominal = ephem.get_orbit_planet(TOAS, "jupiter")
+    naive32 = (((m + dm) * perturbed.astype(np.float32)
+                - m * nominal.astype(np.float32)) / ephem.mass_ss
+               ).astype(np.float32) @ pos.astype(np.float32)
+    naive_err = np.abs(naive32 - want).max()
+    assert err < naive_err / 30, (err, naive_err)
+
+
+def test_roemer_delta_batched_pulsars_and_vmap_sampling():
+    """(P, T) states with (P, 3) positions broadcast; vmap over d_mass gives
+    per-realization BayesEphem draws in one jitted program."""
+    ephem = Ephemeris()
+    T = 80
+    toas = MJD0_S + np.stack([np.linspace(0, 10 * const.yr, T),
+                              np.linspace(0, 14 * const.yr, T)])
+    pos = np.array([[0.0, 0.6, 0.8], [1.0, 0.0, 0.0]])
+    state = roemer_dev.nominal_state(ephem, "saturn", toas, dtype=jnp.float64)
+    got = np.asarray(roemer_dev.roemer_delay_dev(state, pos, **DELTAS))
+    assert got.shape == (2, T)
+    for i in range(2):
+        want = ephem.roemer_delay(toas[i], pos[i], "saturn", **DELTAS)
+        np.testing.assert_allclose(got[i], want, rtol=1e-9,
+                                   atol=1e-9 * np.abs(want).max())
+
+    d_masses = jnp.asarray([0.0, 1e-4, -2e-4]) * 5.685e26
+    sampled = jax.jit(jax.vmap(
+        lambda dm: roemer_dev.roemer_delay_dev(state, pos, d_mass=dm)))(d_masses)
+    assert np.asarray(sampled).shape == (3, 2, T)
+    np.testing.assert_allclose(np.asarray(sampled)[0], 0.0, atol=1e-25)
+
+
+def test_delta_kernel_zero_perturbation_is_exactly_zero():
+    ephem = Ephemeris()
+    state = roemer_dev.nominal_state(ephem, "earth", TOAS[:50],
+                                     dtype=jnp.float32)
+    got = np.asarray(roemer_dev.roemer_delay_dev(state, np.array([0, 0, 1.0])))
+    np.testing.assert_array_equal(got, 0.0)
