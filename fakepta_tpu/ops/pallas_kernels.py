@@ -38,7 +38,8 @@ def _pad_to(x, axis, mult):
     return jnp.pad(x, widths)
 
 
-def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins):
+def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins,
+                        bf16):
     """One grid step: ``rt`` realizations; emit curves+autos into output lanes.
 
     res_l_ref: (rt, PL, T)   local residual rows (zero-padded)
@@ -48,12 +49,20 @@ def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins):
     """
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
     for r in range(rt):
-        # bf16 operands + f32 accumulation: matches XLA's default TPU matmul
-        # precision for f32 inputs, at 2x the MXU rate of full f32
-        a = res_l_ref[r].astype(jnp.bfloat16)
-        b = res_f_ref[r].astype(jnp.bfloat16)
+        if bf16:
+            # bf16 operands + f32 accumulation: matches XLA's default TPU
+            # matmul precision for f32 inputs, at 2x the MXU rate of full f32;
+            # the operand rounding bounds each pair correlation at ~4e-3
+            # relative (bf16 has 8 mantissa bits)
+            a = res_l_ref[r].astype(jnp.bfloat16)
+            b = res_f_ref[r].astype(jnp.bfloat16)
+        else:
+            a = res_l_ref[r]
+            b = res_f_ref[r]
         corr = jax.lax.dot_general(a, b, (((1,), (1,)), ((), ())),
-                                   preferred_element_type=jnp.float32)
+                                   preferred_element_type=jnp.float32,
+                                   precision=None if bf16
+                                   else jax.lax.Precision.HIGHEST)
         acc = jnp.zeros((1, LANES), jnp.float32)
         for n in range(nbins + 1):
             s = jnp.sum(corr * w_ref[n])
@@ -61,9 +70,45 @@ def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins):
         out_ref[r] = acc[0]
 
 
-@functools.partial(jax.jit, static_argnames=("nbins", "rt", "interpret"))
+def _padded_dims(p_local: int, p_full: int, t: int):
+    """(PL, PF, T) after the kernel's tile padding.
+
+    Single source of truth for the layout rules: :func:`binned_correlation`
+    asserts its actually-padded operands match this, so :func:`pick_rt`'s VMEM
+    model cannot drift from the real block shapes.
+    """
+    return (p_local + (-p_local) % SUBLANES,
+            p_full + (-p_full) % LANES,
+            t + (-t) % LANES)
+
+
+def pick_rt(r_local: int, p_local: int, p_full: int, t: int, nbins: int,
+            budget_bytes: int = 12 << 20) -> int:
+    """Largest realization tile whose VMEM working set fits the budget.
+
+    Per grid step the kernel holds (rt, PL, T) + (rt, PF, T) f32 residual
+    blocks, the (nbins+1, PL, PF) weights and the (rt, LANES) output in VMEM
+    (~16 MB/core on v5e; the default budget leaves headroom for Mosaic's own
+    buffers). Grid-indexed blocks (residuals, output) are counted TWICE:
+    Mosaic double-buffers them to overlap the next step's copy-in with compute.
+    At the flagship size (PL=104, PF=128, T=896 after padding) rt=16 demands
+    ~27 MB — over budget — so this returns 4 there (ADVICE r1 #1).
+    """
+    pl_pad, pf_pad, t_pad = _padded_dims(p_local, p_full, t)
+    w_bytes = 4 * (nbins + 1) * pl_pad * pf_pad
+    for rt in (16, 8, 4, 2, 1):
+        if r_local % rt != 0:
+            continue
+        res_bytes = 2 * 4 * rt * (pl_pad + pf_pad) * t_pad   # double-buffered
+        if w_bytes + res_bytes + 2 * 4 * rt * LANES <= budget_bytes:
+            return rt
+    return 1
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("nbins", "rt", "interpret", "precision"))
 def binned_correlation(res_local, res_full, weights, nbins: int, rt: int = 8,
-                       interpret: bool = False):
+                       interpret: bool = False, precision: str = "bf16"):
     """Fused correlation + angular binning.
 
     res_local: (R, PL, T) this shard's residual rows.
@@ -72,22 +117,32 @@ def binned_correlation(res_local, res_full, weights, nbins: int, rt: int = 8,
                stack with the normalized auto-trace weight in slot ``nbins``
                (already holding any 1/count normalization, so the kernel is a
                plain weighted sum).
+    precision: ``'bf16'`` (default — bf16 operands, f32 accumulation, 2x MXU
+               rate, ~4e-3 relative operand rounding) or ``'f32'`` (full f32
+               matmul, highest precision, half rate).
+    Choose ``rt`` with :func:`pick_rt` so the working set fits VMEM.
     Returns (curves (R, nbins), autos (R,)) — the *local* partial sums; callers
     inside shard_map psum over the pulsar axis.
     """
+    if precision not in ("bf16", "f32"):
+        raise ValueError(f"precision must be 'bf16' or 'f32', got {precision!r}")
     R = res_local.shape[0]
     if R % rt != 0:
         raise ValueError(f"nreal per shard ({R}) must be divisible by rt={rt}")
+    orig = (res_local.shape[1], res_full.shape[1], res_local.shape[2])
     res_local = _pad_to(_pad_to(res_local, 2, LANES), 1, SUBLANES)
     res_full = _pad_to(_pad_to(res_full, 2, LANES), 1, LANES)
     weights = _pad_to(_pad_to(weights, 2, LANES), 1, SUBLANES)
     _, PL, T = res_local.shape
     PF = res_full.shape[1]
+    assert (PL, PF, T) == _padded_dims(*orig), \
+        "padding rules drifted from _padded_dims — update both together"
     if nbins + 1 > LANES:
         raise ValueError(f"nbins={nbins} does not fit the {LANES}-lane output")
 
     out = pl.pallas_call(
-        functools.partial(_binned_corr_kernel, rt=rt, nbins=nbins),
+        functools.partial(_binned_corr_kernel, rt=rt, nbins=nbins,
+                          bf16=(precision == "bf16")),
         grid=(R // rt,),
         in_specs=[
             pl.BlockSpec((rt, PL, T), lambda i: (i, 0, 0),

@@ -271,6 +271,48 @@ def test_pallas_fused_statistic_matches_xla_path():
                                             keep_corr=True)["corr"])
 
 
+def test_pallas_f32_mode_is_tighter_than_bf16():
+    """precision='f32' must match the XLA path to f32 round-off, much tighter
+    than the bf16 default's ~4e-3 operand-rounding bound."""
+    batch = PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0, toaerr=1e-7,
+                                  n_red=4, n_dm=4, seed=1)
+    gwb = _gwb_cfg(batch)
+    mesh = make_mesh(jax.devices()[:1])
+    ref = EnsembleSimulator(batch, gwb=gwb, mesh=mesh, use_pallas=False)
+    f32 = EnsembleSimulator(batch, gwb=gwb, mesh=mesh, use_pallas=True,
+                            pallas_precision="f32")
+    out_r = ref.run(8, seed=3, chunk=8)
+    out_f = f32.run(8, seed=3, chunk=8)
+    scale = np.abs(out_r["curves"]).max()
+    np.testing.assert_allclose(out_f["curves"], out_r["curves"],
+                               atol=1e-5 * scale)
+    np.testing.assert_allclose(out_f["autos"], out_r["autos"], rtol=1e-5)
+
+    import pytest
+    with pytest.raises(ValueError, match="precision"):
+        from fakepta_tpu.ops.pallas_kernels import binned_correlation
+        binned_correlation(np.zeros((2, 8, 64), np.float32),
+                           np.zeros((2, 8, 64), np.float32),
+                           np.zeros((5, 8, 8), np.float32), nbins=4, rt=2,
+                           interpret=True, precision="f16")
+
+
+def test_pick_rt_respects_vmem_budget():
+    """At the flagship size the rt=16 tile overflows VMEM (ADVICE r1 #1); the
+    picker must step down, and always returns a divisor of the shard size."""
+    from fakepta_tpu.ops.pallas_kernels import pick_rt
+
+    # flagship: 100 psr unsharded, 780 TOAs, 15 bins -> rt=16 needs ~27 MB
+    # with Mosaic's double-buffering of the grid-indexed blocks
+    assert pick_rt(10_000, 100, 100, 780, 15) == 4
+    # small config: everything fits at 16
+    assert pick_rt(64, 8, 8, 64, 15) == 16
+    # divisibility respected even when the budget would allow more
+    assert pick_rt(12, 8, 8, 64, 15) == 4
+    # pathological budget still returns a legal tile
+    assert pick_rt(8, 512, 1024, 8192, 15, budget_bytes=1 << 20) == 1
+
+
 def test_pallas_fused_multichip_psum():
     """Fused path on the 8-device mesh (2 psr shards): psum over shards must
     reproduce the single-device fused statistics."""
