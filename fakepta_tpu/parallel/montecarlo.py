@@ -93,6 +93,33 @@ class RoemerConfig:
     d_l0: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class RoemerSampling:
+    """Per-realization BayesEphem nuisance sampling inside the device program.
+
+    Each realization draws independent Gaussian perturbations
+    ``d_<param> ~ N(0, s_<param>)`` (same units as :class:`RoemerConfig`) and
+    runs them through the float32-stable delta kernel — ephemeris uncertainty
+    marginalized by Monte Carlo, entirely on device. The reference cannot vary
+    its ephemeris inside any loop at all (its ``roemer_delay`` mutates the
+    stored orbital elements in place, ``ephemeris.py:131-136``).
+
+    The draws are global nuisance parameters: they fold the realization key
+    only (never the pulsar-shard index), so every psr shard perturbs the same
+    solar system and the stream is mesh-shape independent like every other
+    stage.
+    """
+
+    planet: str
+    s_mass: float = 0.0
+    s_Om: float = 0.0
+    s_omega: float = 0.0
+    s_inc: float = 0.0
+    s_a: float = 0.0
+    s_e: float = 0.0
+    s_l0: float = 0.0
+
+
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                     include_white, include_ecorr, include_red, include_dm,
                     include_chrom, include_sys, include_gwb):
@@ -195,6 +222,55 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     return jax.vmap(one)(keys)
 
 
+def _sampled_roemer(keys, state, scales, pos_local):
+    """(R_local, P_local, T) per-realization BayesEphem delays (shard_map body).
+
+    ``state`` is this shard's slice of the nominal
+    :class:`~fakepta_tpu.models.roemer.OrbitState` (its per-TOA leaves shard
+    over 'psr' exactly like the batch); the f32-stable delta kernel runs on
+    per-realization Gaussian draws. The draw key folds a domain tag but never
+    the shard index: the perturbed solar system is one global nuisance per
+    realization.
+    """
+    from ..models.roemer import roemer_delay_dev
+
+    dtype = scales.dtype
+
+    def one(key):
+        z = jax.random.normal(jax.random.fold_in(key, 0x77), (7,), dtype)
+        d = z * scales
+        return roemer_delay_dev(state, pos_local, d_mass=d[0], d_Om=d[1],
+                                d_omega=d[2], d_inc=d[3], d_a=d[4], d_e=d[5],
+                                d_l0=d[6])
+
+    return jax.vmap(one)(keys)
+
+
+def _validated_toas_abs(batch, toas_abs, what: str) -> np.ndarray:
+    """Shared validation for features that need absolute host-f64 epochs."""
+    if toas_abs is None:
+        raise ValueError(
+            f"{what} needs toas_abs: the padded (npsr, max_toa) absolute "
+            f"MJD-second TOAs (float64 host array; see batch.padded_abs_toas)")
+    toas_abs = np.asarray(toas_abs, dtype=np.float64)
+    if toas_abs.shape != batch.t_own.shape:
+        raise ValueError(f"toas_abs shape {toas_abs.shape} != batch "
+                         f"{batch.t_own.shape}")
+    return toas_abs
+
+
+def _orbit_state_specs():
+    """PartitionSpecs for an OrbitState: per-TOA leaves shard over 'psr',
+    the scalar masses replicate (mirrors :func:`_batch_specs`)."""
+    from ..models.roemer import OrbitState
+
+    specs = {f.name: P(PSR_AXIS)
+             for f in dataclasses.fields(OrbitState)}
+    specs["mass"] = P()
+    specs["mass_ss"] = P()
+    return OrbitState(**specs)
+
+
 def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
     """(P, T) summed deterministic delay block, or None if nothing configured.
 
@@ -210,15 +286,8 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
         roemer, (list, tuple)) else [roemer])
     if not cgw_list and not roe_list:
         return None
-    if toas_abs is None:
-        raise ValueError(
-            "cgw/roemer deterministic signals need toas_abs: the padded "
-            "(npsr, max_toa) absolute MJD-second TOAs (float64 host array; "
-            "see batch.padded_abs_toas)")
-    toas_abs = np.asarray(toas_abs, dtype=np.float64)
-    if toas_abs.shape != batch.t_own.shape:
-        raise ValueError(f"toas_abs shape {toas_abs.shape} != batch "
-                         f"{batch.t_own.shape}")
+    toas_abs = _validated_toas_abs(batch, toas_abs,
+                                   "cgw/roemer deterministic signals")
 
     det = jnp.zeros(batch.t_own.shape, dtype)
     if cgw_list:
@@ -301,7 +370,8 @@ class EnsembleSimulator:
                                      "sys", "gwb", "det"),
                  nbins: int = 15, use_pallas: Optional[bool] = None,
                  pallas_precision: str = "bf16",
-                 cgw=None, roemer=None, ephem=None, toas_abs=None, pdist=None):
+                 cgw=None, roemer=None, roemer_sample=None, ephem=None,
+                 toas_abs=None, pdist=None):
         """``use_pallas`` enables the fused statistic kernel
         (:mod:`fakepta_tpu.ops.pallas_kernels`); ``pallas_precision`` is
         ``'bf16'`` (default: bf16 matmul operands with f32 accumulation —
@@ -363,6 +433,27 @@ class EnsembleSimulator:
         if self._det is None:
             self._det = jnp.zeros_like(batch.t_own)
 
+        # per-realization BayesEphem sampling (RoemerSampling): nominal orbit
+        # state propagated once on host f64, perturbation drawn and evaluated
+        # per realization inside the kernel. Enabled by passing the config —
+        # NOT gated on `include` — and skipped entirely when every prior scale
+        # is zero (nothing to sample), matching the skip-zero-stage convention.
+        self._roe_state = None
+        self._roe_scales = None
+        scales = None if roemer_sample is None else [
+            roemer_sample.s_mass, roemer_sample.s_Om, roemer_sample.s_omega,
+            roemer_sample.s_inc, roemer_sample.s_a, roemer_sample.s_e,
+            roemer_sample.s_l0]
+        if roemer_sample is not None and any(s != 0.0 for s in scales):
+            toas64 = _validated_toas_abs(batch, toas_abs, "roemer_sample")
+            from ..models import roemer as roemer_dev
+            if ephem is None:
+                from ..ephemeris import Ephemeris
+                ephem = Ephemeris()
+            self._roe_state = roemer_dev.nominal_state(
+                ephem, roemer_sample.planet, toas64, dtype=dtype)
+            self._roe_scales = jnp.asarray(scales, dtype)
+
         # angular bins for the correlation curve (static, from positions)
         pos = np.asarray(batch.pos, dtype=np.float64)
         ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
@@ -400,19 +491,28 @@ class EnsembleSimulator:
         batch_specs = _batch_specs()
         inc = self._include
         has_det = self._has_det
+        roe_state, roe_scales = self._roe_state, self._roe_scales
 
-        def sharded(keys, batch, chol, gwb_w, det):
+        use_roe = roe_state is not None
+
+        def sharded(keys, batch, chol, gwb_w, det, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
             if has_det:
                 res = res + det[None]
+            if use_roe:
+                term = _sampled_roemer(keys, roe[0], roe_scales, batch.pos)
+                res = res + jnp.where(batch.mask, term, 0.0)
             return _correlation_rows(res, batch.mask)
 
+        roe_specs = (_orbit_state_specs(),) if use_roe else ()
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS)),
+            in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS),
+                      *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
+        roe_args = (roe_state,) if use_roe else ()
 
         @partial(jax.jit, static_argnums=(2,))
         def step(base_key, offset, nreal):
@@ -420,7 +520,7 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._det)
+                            self._det, *roe_args)
             curves = (jnp.einsum("rpq,pqn->rn", corr, self._bin_onehot)
                       / self._bin_counts)
             # normalize by the mean autocorrelation to a unitless HD statistic
@@ -455,12 +555,17 @@ class EnsembleSimulator:
         interpret = self._pallas_interpret
 
         has_det = self._has_det
+        roe_state, roe_scales = self._roe_state, self._roe_scales
+        use_roe = roe_state is not None
 
-        def sharded(keys, batch, chol, gwb_w, weights, det):
+        def sharded(keys, batch, chol, gwb_w, weights, det, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
             if has_det:
                 res = res + det[None]
+            if use_roe:
+                term = _sampled_roemer(keys, roe[0], roe_scales, batch.pos)
+                res = res + jnp.where(batch.mask, term, 0.0)
             res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
             # realization tile capped by the kernel's VMEM working set
@@ -475,7 +580,8 @@ class EnsembleSimulator:
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
-                      P(None, PSR_AXIS, None), P(PSR_AXIS)),
+                      P(None, PSR_AXIS, None), P(PSR_AXIS),
+                      *((_orbit_state_specs(),) if use_roe else ())),
             out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
             # pallas_call does not annotate vma on its outputs; the psum above
             # makes the outputs replicated over 'psr' by construction
@@ -487,7 +593,8 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             return shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._stat_weights, self._det)
+                            self._stat_weights, self._det,
+                            *((roe_state,) if use_roe else ()))
 
         return step
 

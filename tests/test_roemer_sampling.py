@@ -1,0 +1,107 @@
+"""Per-realization BayesEphem sampling inside the ensemble (RoemerSampling)."""
+
+import jax
+import numpy as np
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, RoemerSampling
+
+MJD0_S = 53000.0 * 86400.0
+NPSR, NTOA = 4, 96
+
+
+def _setup(**sim_kw):
+    batch = PulsarBatch.synthetic(npsr=NPSR, ntoa=NTOA, tspan_years=12.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=2)
+    toas_abs = np.tile(MJD0_S + np.linspace(0.0, 12 * const.yr, NTOA),
+                       (NPSR, 1))
+    return batch, toas_abs, EnsembleSimulator(
+        batch, toas_abs=toas_abs, **sim_kw)
+
+
+def test_sampled_roemer_adds_ephemeris_scatter():
+    """Sampling Jupiter's mass at BayesEphem scale must add realization-to-
+    realization scatter that a fixed ephemeris does not have, and zero scales
+    must reproduce the unsampled stream exactly."""
+    mesh = make_mesh(jax.devices()[:1])
+    sampling = RoemerSampling("jupiter", s_mass=1e-4 * 1.899e27)
+    _, _, on = _setup(mesh=mesh, include=("det",), roemer_sample=sampling)
+    out_on = on.run(64, seed=5, chunk=64, keep_corr=True)
+    # every realization differs (a different solar system each draw)
+    assert np.ptp(out_on["corr"][:, 0, 0]) > 0
+
+    _, _, zero = _setup(mesh=mesh, include=("det",),
+                        roemer_sample=RoemerSampling("jupiter"))
+    out_zero = zero.run(64, seed=5, chunk=64, keep_corr=True)
+    np.testing.assert_array_equal(out_zero["corr"], 0.0)
+
+
+def test_sampled_roemer_variance_matches_linear_response():
+    """A mass-only perturbation is exactly linear in d_mass, so the ensemble
+    variance of the residual equals s_mass^2 times the squared unit response."""
+    from fakepta_tpu.ephemeris import Ephemeris
+
+    mesh = make_mesh(jax.devices()[:1])
+    s_mass = 2e-4 * 1.899e27
+    sampling = RoemerSampling("jupiter", s_mass=s_mass)
+    batch, toas_abs, sim = _setup(mesh=mesh, include=("det",),
+                                  roemer_sample=sampling)
+    out = sim.run(4000, seed=11, chunk=1000, keep_corr=True)
+    # corr[r, i, i] = sum_t res^2 / n_toa; E[corr_ii] = s^2 * mean_t(unit^2)
+    ephem = Ephemeris()
+    got = out["corr"][:, np.arange(NPSR), np.arange(NPSR)].mean(0)
+    want = np.empty(NPSR)
+    pos = np.asarray(batch.pos, dtype=np.float64)
+    probe = 1e22   # 1 kg would vanish in f64 against Jupiter's 1.9e27 kg
+    for i in range(NPSR):
+        unit = ephem.roemer_delay(toas_abs[i], pos[i], "jupiter",
+                                  d_mass=probe) / probe
+        want[i] = (s_mass ** 2) * (unit ** 2).mean()
+    np.testing.assert_allclose(got, want, rtol=0.15)
+
+
+def test_sampled_roemer_mesh_shape_independent():
+    """The nuisance draw folds only the realization key, so any mesh produces
+    the same realizations (f32 reduction tolerance)."""
+    sampling = RoemerSampling("saturn", s_mass=3e-4 * 5.685e26, s_Om=3e-4,
+                              s_l0=2e-4)
+    _, _, s1 = _setup(mesh=make_mesh(jax.devices()[:1]),
+                      include=("white", "det"), roemer_sample=sampling)
+    _, _, s8 = _setup(mesh=make_mesh(jax.devices(), psr_shards=2),
+                      include=("white", "det"), roemer_sample=sampling)
+    o1 = s1.run(16, seed=3, chunk=16)
+    o8 = s8.run(16, seed=3, chunk=16)
+    scale = np.abs(o1["curves"]).max()
+    np.testing.assert_allclose(o8["curves"], o1["curves"], rtol=1e-5,
+                               atol=1e-4 * scale)
+    np.testing.assert_allclose(o8["autos"], o1["autos"], rtol=1e-5)
+
+
+def test_sampled_roemer_fused_path_matches_xla():
+    """The fused Pallas step has its own roe-addition branch; it must agree
+    with the XLA path (f32 kernel precision for a tight bound)."""
+    mesh = make_mesh(jax.devices()[:1])
+    sampling = RoemerSampling("jupiter", s_mass=1e-4 * 1.899e27, s_Om=2e-4)
+    _, _, ref = _setup(mesh=mesh, include=("white", "det"),
+                       roemer_sample=sampling)
+    _, _, fus = _setup(mesh=mesh, include=("white", "det"),
+                       roemer_sample=sampling, use_pallas=True,
+                       pallas_precision="f32")
+    out_r = ref.run(8, seed=7, chunk=8)
+    out_f = fus.run(8, seed=7, chunk=8)
+    scale = np.abs(out_r["curves"]).max()
+    np.testing.assert_allclose(out_f["curves"], out_r["curves"],
+                               atol=1e-5 * scale)
+    np.testing.assert_allclose(out_f["autos"], out_r["autos"], rtol=1e-5)
+
+
+def test_sampling_requires_toas_abs():
+    import pytest
+
+    batch = PulsarBatch.synthetic(npsr=NPSR, ntoa=NTOA, tspan_years=12.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=2)
+    with pytest.raises(ValueError, match="toas_abs"):
+        EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                          roemer_sample=RoemerSampling("jupiter", s_mass=1.0))
