@@ -107,7 +107,8 @@ class RoemerSampling:
     The draws are global nuisance parameters: they fold the realization key
     only (never the pulsar-shard index), so every psr shard perturbs the same
     solar system and the stream is mesh-shape independent like every other
-    stage.
+    stage. Pass a sequence of configs to ``EnsembleSimulator(roemer_sample=...)``
+    to sample several bodies per realization (draws are independent per body).
     """
 
     planet: str
@@ -222,22 +223,23 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     return jax.vmap(one)(keys)
 
 
-def _sampled_roemer(keys, state, scales, pos_local):
+def _sampled_roemer(keys, state, scales, pos_local, tag):
     """(R_local, P_local, T) per-realization BayesEphem delays (shard_map body).
 
     ``state`` is this shard's slice of the nominal
     :class:`~fakepta_tpu.models.roemer.OrbitState` (its per-TOA leaves shard
     over 'psr' exactly like the batch); the f32-stable delta kernel runs on
-    per-realization Gaussian draws. The draw key folds a domain tag but never
-    the shard index: the perturbed solar system is one global nuisance per
-    realization.
+    per-realization Gaussian draws. The draw key folds the 0x77 domain tag and
+    the per-planet index ``tag`` but never the shard index: each perturbed
+    solar-system body is one global nuisance per realization.
     """
     from ..models.roemer import roemer_delay_dev
 
     dtype = scales.dtype
 
     def one(key):
-        z = jax.random.normal(jax.random.fold_in(key, 0x77), (7,), dtype)
+        kz = jax.random.fold_in(jax.random.fold_in(key, 0x77), tag)
+        z = jax.random.normal(kz, (7,), dtype)
         d = z * scales
         return roemer_delay_dev(state, pos_local, d_mass=d[0], d_Om=d[1],
                                 d_omega=d[2], d_inc=d[3], d_a=d[4], d_e=d[5],
@@ -251,7 +253,8 @@ def _validated_toas_abs(batch, toas_abs, what: str) -> np.ndarray:
     if toas_abs is None:
         raise ValueError(
             f"{what} needs toas_abs: the padded (npsr, max_toa) absolute "
-            f"MJD-second TOAs (float64 host array; see batch.padded_abs_toas)")
+            f"MJD-second TOAs (float64 host array; build one from a pulsar "
+            f"list with fakepta_tpu.batch.padded_abs_toas(psrs))")
     toas_abs = np.asarray(toas_abs, dtype=np.float64)
     if toas_abs.shape != batch.t_own.shape:
         raise ValueError(f"toas_abs shape {toas_abs.shape} != batch "
@@ -433,26 +436,32 @@ class EnsembleSimulator:
         if self._det is None:
             self._det = jnp.zeros_like(batch.t_own)
 
-        # per-realization BayesEphem sampling (RoemerSampling): nominal orbit
-        # state propagated once on host f64, perturbation drawn and evaluated
-        # per realization inside the kernel. Enabled by passing the config —
-        # NOT gated on `include` — and skipped entirely when every prior scale
-        # is zero (nothing to sample), matching the skip-zero-stage convention.
-        self._roe_state = None
-        self._roe_scales = None
-        scales = None if roemer_sample is None else [
-            roemer_sample.s_mass, roemer_sample.s_Om, roemer_sample.s_omega,
-            roemer_sample.s_inc, roemer_sample.s_a, roemer_sample.s_e,
-            roemer_sample.s_l0]
-        if roemer_sample is not None and any(s != 0.0 for s in scales):
+        # per-realization BayesEphem sampling (RoemerSampling, single config or
+        # a sequence — one per sampled body): nominal orbit states propagated
+        # once on host f64, perturbations drawn and evaluated per realization
+        # inside the kernel. Enabled by passing the config(s) — NOT gated on
+        # `include` — with all-zero-scale entries skipped entirely (nothing to
+        # sample), matching the skip-zero-stage convention.
+        sample_list = [] if roemer_sample is None else (
+            list(roemer_sample) if isinstance(roemer_sample, (list, tuple))
+            else [roemer_sample])
+        self._roe_states: Tuple = ()
+        self._roe_scales: Tuple = ()
+        active = [(cfg, [cfg.s_mass, cfg.s_Om, cfg.s_omega, cfg.s_inc,
+                         cfg.s_a, cfg.s_e, cfg.s_l0])
+                  for cfg in sample_list]
+        active = [(cfg, sc) for cfg, sc in active if any(s != 0.0 for s in sc)]
+        if active:
             toas64 = _validated_toas_abs(batch, toas_abs, "roemer_sample")
             from ..models import roemer as roemer_dev
             if ephem is None:
                 from ..ephemeris import Ephemeris
                 ephem = Ephemeris()
-            self._roe_state = roemer_dev.nominal_state(
-                ephem, roemer_sample.planet, toas64, dtype=dtype)
-            self._roe_scales = jnp.asarray(scales, dtype)
+            self._roe_states = tuple(
+                roemer_dev.nominal_state(ephem, cfg.planet, toas64,
+                                         dtype=dtype) for cfg, _ in active)
+            self._roe_scales = tuple(
+                jnp.asarray(sc, dtype) for _, sc in active)
 
         # angular bins for the correlation curve (static, from positions)
         pos = np.asarray(batch.pos, dtype=np.float64)
@@ -491,28 +500,28 @@ class EnsembleSimulator:
         batch_specs = _batch_specs()
         inc = self._include
         has_det = self._has_det
-        roe_state, roe_scales = self._roe_state, self._roe_scales
-
-        use_roe = roe_state is not None
+        roe_scales = self._roe_scales
+        n_roe = len(self._roe_states)
 
         def sharded(keys, batch, chol, gwb_w, det, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
             if has_det:
                 res = res + det[None]
-            if use_roe:
-                term = _sampled_roemer(keys, roe[0], roe_scales, batch.pos)
+            for j in range(n_roe):
+                term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
+                                       tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
             return _correlation_rows(res, batch.mask)
 
-        roe_specs = (_orbit_state_specs(),) if use_roe else ()
+        roe_specs = tuple(_orbit_state_specs() for _ in range(n_roe))
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS),
                       *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
-        roe_args = (roe_state,) if use_roe else ()
+        roe_args = self._roe_states
 
         @partial(jax.jit, static_argnums=(2,))
         def step(base_key, offset, nreal):
@@ -555,16 +564,17 @@ class EnsembleSimulator:
         interpret = self._pallas_interpret
 
         has_det = self._has_det
-        roe_state, roe_scales = self._roe_state, self._roe_scales
-        use_roe = roe_state is not None
+        roe_scales = self._roe_scales
+        n_roe = len(self._roe_states)
 
         def sharded(keys, batch, chol, gwb_w, weights, det, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc)
             if has_det:
                 res = res + det[None]
-            if use_roe:
-                term = _sampled_roemer(keys, roe[0], roe_scales, batch.pos)
+            for j in range(n_roe):
+                term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
+                                       tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
             res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
@@ -581,7 +591,8 @@ class EnsembleSimulator:
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
                       P(None, PSR_AXIS, None), P(PSR_AXIS),
-                      *((_orbit_state_specs(),) if use_roe else ())),
+                      *(tuple(_orbit_state_specs()
+                              for _ in range(n_roe)))),
             out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
             # pallas_call does not annotate vma on its outputs; the psum above
             # makes the outputs replicated over 'psr' by construction
@@ -594,7 +605,7 @@ class EnsembleSimulator:
                 offset + jnp.arange(nreal))
             return shmapped(keys, self.batch, self._chol, self._gwb_w,
                             self._stat_weights, self._det,
-                            *((roe_state,) if use_roe else ()))
+                            *self._roe_states)
 
         return step
 
