@@ -144,7 +144,6 @@ def config7():
     but had never been in a measured number — VERDICT r2 weak #9)."""
     import jax
 
-    from fakepta_tpu import constants as const
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.fake_pta import Pulsar
     from fakepta_tpu.parallel.mesh import make_mesh

@@ -1,1 +1,1 @@
-from . import cgw  # noqa: F401
+from . import cgw, roemer  # noqa: F401
