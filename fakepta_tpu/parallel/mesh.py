@@ -26,10 +26,52 @@ def make_mesh(devices: Optional[Sequence] = None, psr_shards: int = 1) -> Mesh:
 
     ``psr_shards`` must divide the device count; the remaining devices go to the
     realization axis. One device -> a 1x1 mesh, so every code path is identical on
-    a laptop CPU, one TPU chip, or a pod slice.
+    a laptop CPU, one TPU chip, or a pod slice. In a multi-host program
+    ``jax.devices()`` already spans every process (after
+    :func:`initialize_multihost`), so the same call builds the global pod mesh.
     """
     devices = list(devices if devices is not None else jax.devices())
     if len(devices) % psr_shards != 0:
         raise ValueError(f"psr_shards={psr_shards} must divide {len(devices)} devices")
     grid = np.array(devices).reshape(len(devices) // psr_shards, psr_shards)
     return Mesh(grid, (REAL_AXIS, PSR_AXIS))
+
+
+def initialize_multihost(coordinator_address: Optional[str] = None,
+                         num_processes: Optional[int] = None,
+                         process_id: Optional[int] = None) -> Mesh:
+    """Join JAX's distributed runtime and return the global pod mesh.
+
+    The multi-host analog of the reference's (nonexistent) communication
+    backend: one SPMD program per host, XLA collectives over ICI within a
+    slice and DCN across slices — no NCCL/MPI code to port. On Cloud TPU
+    pods every argument is discovered from the environment, so
+    ``initialize_multihost()`` with no arguments is the whole setup; other
+    clusters pass the coordinator explicitly (`jax.distributed.initialize`
+    semantics).
+
+    After this call ``jax.devices()`` spans all processes and
+    :func:`make_mesh` builds the global mesh. Per-host result gathering is
+    handled inside :meth:`EnsembleSimulator.run` (non-addressable outputs go
+    through ``process_allgather``), so the single-host user code runs
+    unchanged on a pod.
+    """
+    jax.distributed.initialize(coordinator_address=coordinator_address,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return make_mesh(jax.devices())
+
+
+def to_host(x) -> np.ndarray:
+    """Materialize a (possibly multi-host-sharded) device array on every host.
+
+    Single-process arrays are fully addressable and copy directly; in a
+    multi-host program the 'real'-sharded outputs live partly on other
+    processes, where ``np.asarray`` would raise — ``process_allgather``
+    assembles the global value on every host instead.
+    """
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x, tiled=True)

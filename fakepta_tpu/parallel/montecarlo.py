@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
 from ..utils import rng as rng_utils
-from .mesh import PSR_AXIS, REAL_AXIS, make_mesh
+from .mesh import PSR_AXIS, REAL_AXIS, make_mesh, to_host
 
 
 @dataclasses.dataclass(frozen=True)
@@ -665,12 +665,15 @@ class EnsembleSimulator:
             else:
                 curves, autos, corr = self._step(base, done, chunk)
                 if keep_corr:
-                    corr_out.append(np.asarray(corr))
-            curves_out.append(np.asarray(curves))
-            autos_out.append(np.asarray(autos))
+                    corr_out.append(to_host(corr))
+            curves_out.append(to_host(curves))
+            autos_out.append(to_host(autos))
             done += chunk
-            if ckpt is not None:
-                # append-only: each save writes this chunk's arrays, O(chunk) I/O
+            if ckpt is not None and jax.process_index() == 0:
+                # append-only: each save writes this chunk's arrays, O(chunk)
+                # I/O. Only process 0 writes — to_host replicates outputs to
+                # every host, and concurrent renames of the same checkpoint
+                # files from N processes would race on shared storage
                 ckpt.save(seed, nreal, chunk, done, curves_out[-1], autos_out[-1],
                           corr_out[-1] if keep_corr else None)
             if progress is not None:
@@ -682,6 +685,6 @@ class EnsembleSimulator:
         }
         if keep_corr:
             out["corr"] = np.concatenate(corr_out)[:nreal]
-        if ckpt is not None:
+        if ckpt is not None and jax.process_index() == 0:
             ckpt.delete()
         return out

@@ -174,6 +174,21 @@ def test_ensemble_anisotropic_and_chromatic_gwb(small_batch):
                                rtol=1e-4)
 
 
+def test_to_host_materializes_sharded_outputs(small_batch):
+    """to_host copies fully-addressable sharded arrays (the single-process
+    path; multi-host arrays route through process_allgather)."""
+    from fakepta_tpu.parallel.mesh import to_host
+
+    sim = EnsembleSimulator(small_batch, gwb=_gwb_cfg(small_batch),
+                            mesh=make_mesh(jax.devices(), psr_shards=2))
+    curves, autos, corr = sim._step(jax.random.key(0), 0, 8)
+    got = to_host(curves)
+    assert isinstance(got, np.ndarray) and got.shape == (8, 15)
+    np.testing.assert_array_equal(got, np.asarray(curves))
+    # numpy passthrough
+    np.testing.assert_array_equal(to_host(np.arange(3.0)), np.arange(3.0))
+
+
 def test_mesh_validation(small_batch):
     with pytest.raises(ValueError):
         EnsembleSimulator(small_batch, gwb=None, mesh=make_mesh(jax.devices(),
