@@ -1,0 +1,79 @@
+"""Compile-and-validate the fused Pallas kernel on the real TPU (VERDICT r2 #4).
+
+Runs the fused statistic path NON-interpreted (a real Mosaic kernel):
+1. parity vs the XLA path at a small size, both precisions;
+2. compile + run at the FLAGSHIP size (100 psr, 780 TOAs) where the VMEM-capped
+   realization tile matters (pick_rt returns 4 there);
+3. throughput: XLA vs fused at the flagship size.
+
+Prints one JSON line per check. Exits non-zero on any parity failure.
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    assert jax.devices()[0].platform == "tpu", "this check needs the real TPU"
+    mesh = make_mesh(jax.devices())
+    ok = True
+
+    def gwb(batch, ncomp=8, log10_A=-13.5):
+        f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+        return GWBConfig(psd=np.asarray(spectrum_lib.powerlaw(
+            f, log10_A=log10_A, gamma=13 / 3)), orf="hd")
+
+    # 1. small-size parity, real Mosaic kernel
+    small = PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=1)
+    ref = EnsembleSimulator(small, gwb=gwb(small), mesh=mesh,
+                            use_pallas=False).run(8, seed=3, chunk=8)
+    for prec, atol_scale in (("bf16", 1e-2), ("f32", 1e-5)):
+        out = EnsembleSimulator(small, gwb=gwb(small), mesh=mesh,
+                                use_pallas=True, pallas_precision=prec
+                                ).run(8, seed=3, chunk=8)
+        scale = float(np.abs(ref["curves"]).max())
+        err = float(np.abs(out["curves"] - ref["curves"]).max())
+        passed = bool(err <= atol_scale * scale
+                      and np.allclose(out["autos"], ref["autos"],
+                                      rtol=atol_scale))
+        ok &= passed
+        print(json.dumps({"check": f"parity_{prec}_mosaic", "passed": passed,
+                          "max_err": err, "scale": scale}))
+
+    # 2 + 3. flagship size: compile under the VMEM cap, throughput both paths
+    flag = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                 toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    cfg = gwb(flag, ncomp=30, log10_A=np.log10(2e-15))
+    nreal, chunk = 10_000, 10_000
+    results = {}
+    for name, kw in (("xla", dict(use_pallas=False)),
+                     ("pallas_bf16", dict(use_pallas=True,
+                                          pallas_precision="bf16"))):
+        sim = EnsembleSimulator(flag, gwb=cfg, mesh=mesh, **kw)
+        sim.run(chunk, seed=9, chunk=chunk)          # compile + warm
+        t0 = time.perf_counter()
+        out = sim.run(nreal, seed=1, chunk=chunk)
+        t = time.perf_counter() - t0
+        assert np.all(np.isfinite(out["curves"]))
+        results[name] = nreal / t / len(jax.devices())
+        print(json.dumps({"check": f"flagship_{name}",
+                          "real_per_s_per_chip": round(results[name], 2)}))
+    print(json.dumps({"check": "flagship_speedup_fused_vs_xla",
+                      "ratio": round(results["pallas_bf16"] / results["xla"],
+                                     3)}))
+    sys.exit(0 if ok else 1)
+
+
+if __name__ == "__main__":
+    main()
