@@ -24,7 +24,9 @@ def main():
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
 
-    assert jax.devices()[0].platform == "tpu", "this check needs the real TPU"
+    if jax.devices()[0].platform != "tpu":
+        raise SystemExit("this check needs the real TPU (interpret-mode parity "
+                         "is already covered by the test suite)")
     mesh = make_mesh(jax.devices())
     ok = True
 
@@ -51,7 +53,12 @@ def main():
         print(json.dumps({"check": f"parity_{prec}_mosaic", "passed": passed,
                           "max_err": err, "scale": scale}))
 
-    # 2 + 3. flagship size: compile under the VMEM cap, throughput both paths
+    # 2 + 3. flagship size: compile under the VMEM cap, throughput both paths.
+    # Skipped when parity already failed: benchmarking a kernel that produces
+    # wrong answers would publish meaningless speedup numbers.
+    if not ok:
+        print(json.dumps({"check": "flagship", "skipped": "parity failed"}))
+        sys.exit(1)
     flag = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
     cfg = gwb(flag, ncomp=30, log10_A=np.log10(2e-15))
@@ -65,7 +72,10 @@ def main():
         t0 = time.perf_counter()
         out = sim.run(nreal, seed=1, chunk=chunk)
         t = time.perf_counter() - t0
-        assert np.all(np.isfinite(out["curves"]))
+        if not np.all(np.isfinite(out["curves"])):
+            print(json.dumps({"check": f"flagship_{name}",
+                              "passed": False, "reason": "non-finite output"}))
+            sys.exit(1)
         results[name] = nreal / t / len(jax.devices())
         print(json.dumps({"check": f"flagship_{name}",
                           "real_per_s_per_chip": round(results[name], 2)}))
