@@ -45,7 +45,11 @@ def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins,
     res_l_ref: (rt, PL, T)   local residual rows (zero-padded)
     res_f_ref: (rt, PF, T)   full (gathered) residuals (zero-padded)
     w_ref:     (nbins+1, PL, PF) binning weights; slot nbins is the auto weight
-    out_ref:   (rt, LANES)   lane n < nbins: curve bin n; lane nbins: autos
+    out_ref:   (1, rt, LANES) lane n < nbins: curve bin n; lane nbins: autos.
+               The leading unit axis makes the block's trailing dims (rt, LANES)
+               equal the array dims — Mosaic rejects a 2-D (rt, LANES) block
+               when rt < 8 (sublane divisibility), and the VMEM cap picks
+               rt=4 at the flagship size.
     """
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
     for r in range(rt):
@@ -67,7 +71,7 @@ def _binned_corr_kernel(res_l_ref, res_f_ref, w_ref, out_ref, *, rt, nbins,
         for n in range(nbins + 1):
             s = jnp.sum(corr * w_ref[n])
             acc = acc + jnp.where(lane == n, s, 0.0)
-        out_ref[r] = acc[0]
+        out_ref[0, r] = acc[0]
 
 
 def _padded_dims(p_local: int, p_full: int, t: int):
@@ -87,7 +91,7 @@ def pick_rt(r_local: int, p_local: int, p_full: int, t: int, nbins: int,
     """Largest realization tile whose VMEM working set fits the budget.
 
     Per grid step the kernel holds (rt, PL, T) + (rt, PF, T) f32 residual
-    blocks, the (nbins+1, PL, PF) weights and the (rt, LANES) output in VMEM
+    blocks, the (nbins+1, PL, PF) weights and the (1, rt, LANES) output in VMEM
     (~16 MB/core on v5e; the default budget leaves headroom for Mosaic's own
     buffers). Grid-indexed blocks (residuals, output) are counted TWICE:
     Mosaic double-buffers them to overlap the next step's copy-in with compute.
@@ -152,9 +156,10 @@ def binned_correlation(res_local, res_full, weights, nbins: int, rt: int = 8,
             pl.BlockSpec((nbins + 1, PL, PF), lambda i: (0, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((rt, LANES), lambda i: (i, 0),
+        out_specs=pl.BlockSpec((1, rt, LANES), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((R, LANES), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((R // rt, rt, LANES), jnp.float32),
         interpret=interpret,
     )(res_local, res_full, weights)
+    out = out.reshape(R, LANES)
     return out[:, :nbins], out[:, nbins]
