@@ -55,19 +55,24 @@ def main():
     corr64 = np.einsum("rpt,rqt->rpq", res_l.astype(np.float64),
                        res_f.astype(np.float64))
     want = np.einsum("rpq,npq->rn", corr64, w.astype(np.float64))
-    rt = pick_rt(R, PLOC, PFULL, T, NB)
-    for prec, tol in (("bf16", 1e-2), ("f32", 1e-5)):
-        curves, autos = binned_correlation(
-            jnp.asarray(res_l), jnp.asarray(res_f), jnp.asarray(w),
-            nbins=NB, rt=rt, precision=prec)
-        got = np.concatenate([np.asarray(curves),
-                              np.asarray(autos)[:, None]], axis=1)
-        scale = float(np.abs(want).max())
-        err = float(np.abs(got - want).max())
-        passed = bool(err <= tol * scale)
-        ok &= passed
-        print(json.dumps({"check": f"kernel_parity_{prec}_mosaic",
-                          "passed": passed, "max_rel_err": err / scale}))
+    # rt=4 exercises the sublane-padded (1, rt, LANES) output layout the
+    # flagship's VMEM cap forces (pick_rt returns 4 there); rt=8 the aligned
+    # one. An indexing bug specific to rt<8 would otherwise reach the flagship
+    # stage checked only for finiteness.
+    assert pick_rt(R, PLOC, PFULL, T, NB) == 8, "small-size pick_rt drifted"
+    for rt in (4, 8):
+        for prec, tol in (("bf16", 1e-2), ("f32", 1e-5)):
+            curves, autos = binned_correlation(
+                jnp.asarray(res_l), jnp.asarray(res_f), jnp.asarray(w),
+                nbins=NB, rt=rt, precision=prec)
+            got = np.concatenate([np.asarray(curves),
+                                  np.asarray(autos)[:, None]], axis=1)
+            scale = float(np.abs(want).max())
+            err = float(np.abs(got - want).max())
+            passed = bool(err <= tol * scale)
+            ok &= passed
+            print(json.dumps({"check": f"kernel_parity_{prec}_rt{rt}_mosaic",
+                              "passed": passed, "max_rel_err": err / scale}))
 
     # 1b. end-to-end simulator parity, XLA vs fused, at the generation-path
     # tolerance (default-precision matmuls bound both runs at ~bf16 rounding).

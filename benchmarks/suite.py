@@ -12,6 +12,7 @@ Prints one JSON line per config. The reference publishes no numbers
 
 import argparse
 import json
+import sys
 import time
 from datetime import date
 from pathlib import Path
@@ -19,6 +20,7 @@ from pathlib import Path
 import numpy as np
 
 REPO = Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
 
 
 def _timeit(fn, repeats=3):
