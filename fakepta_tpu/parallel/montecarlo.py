@@ -161,6 +161,25 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     T = batch.t_own.shape[1]
 
+    # All GP signals project through ONE concatenated (P, T, K_total) basis and
+    # one einsum per realization. The projections are HBM-bound, not FLOP-bound,
+    # under the realization vmap: separate einsums each materialize an
+    # (R_local, P, T)-sized temporary (3.1 GB at the flagship chunk), and
+    # merging them collapsed ~30 ms/chunk of traffic. Coefficient DRAWS stay
+    # per-signal with unchanged keys/shapes, so realization streams are
+    # bit-identical to the unmerged program. System noise stays separate: its
+    # per-band mask applies after projection.
+    gp_bases = []
+    if include_red:
+        gp_bases.append(red_basis.reshape(p_local, T, -1))
+    if include_dm:
+        gp_bases.append(dm_basis.reshape(p_local, T, -1))
+    if include_chrom:
+        gp_bases.append(chrom_basis.reshape(p_local, T, -1))
+    if include_gwb:
+        gp_bases.append(gwb_basis.reshape(p_local, T, -1))
+    gp_basis_all = jnp.concatenate(gp_bases, axis=-1) if gp_bases else None
+
     def one(key):
         # noise keys fold by GLOBAL pulsar index, so realization streams are
         # bit-identical on any mesh shape (1 device or a pod slice shard the
@@ -190,15 +209,16 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             # draws a dense MVN per block, fake_pta.py:219-228)
             shared = jnp.take_along_axis(draw(ke, T), batch.epoch_idx, axis=1)
             res = res + batch.ecorr_amp * shared
+        coeffs = []
         if include_red:
             c = draw(kr, 2, n_red) * red_w[:, None, :]
-            res = res + jnp.einsum("ptkn,pkn->pt", red_basis, c)
+            coeffs.append(c.reshape(p_local, -1))
         if include_dm:
             c = draw(kd, 2, n_dm) * dm_w[:, None, :]
-            res = res + jnp.einsum("ptkn,pkn->pt", dm_basis, c)
+            coeffs.append(c.reshape(p_local, -1))
         if include_chrom:
             c = draw(kc, 2, n_chrom) * chrom_w[:, None, :]
-            res = res + jnp.einsum("ptkn,pkn->pt", chrom_basis, c)
+            coeffs.append(c.reshape(p_local, -1))
         if include_sys:
             # per-(pulsar, backend-band) GP on the shared basis, masked to the
             # band's TOAs (shell equivalent: fake_pta.py:333-355 via the masked
@@ -217,7 +237,10 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             corr = z @ chol.T
             corr_local = lax.dynamic_slice_in_dim(corr, pidx * p_local, p_local, axis=2)
             c = corr_local * gwb_w[None, :, None]                      # (2,C,P_loc)
-            res = res + jnp.einsum("ptkc,kcp->pt", gwb_basis, c)
+            coeffs.append(jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1))
+        if coeffs:
+            res = res + jnp.einsum("ptk,pk->pt", gp_basis_all,
+                                   jnp.concatenate(coeffs, axis=-1))
         return jnp.where(batch.mask, res, 0.0)
 
     return jax.vmap(one)(keys)
@@ -332,6 +355,24 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
                 d_e=cfg.d_e, d_l0=cfg.d_l0)
             det = det + delay.astype(dtype)
     return jnp.where(batch.mask, det, 0.0)
+
+
+def pack_stats(curves, autos):
+    """Pack per-realization curves+autos into one (n, nbins+1) array.
+
+    The single source of truth for the packed statistic layout: lane
+    ``n < nbins`` is curve bin n, lane ``nbins`` is the mean autocorrelation.
+    Curves and autos ride one array so a chunk's outputs are ONE device->host
+    fetch (a round-trip through a remote-TPU tunnel costs ~80 ms flat
+    regardless of size). Works on device and host arrays alike.
+    """
+    lib = np if isinstance(curves, np.ndarray) else jnp
+    return lib.concatenate([curves, autos[:, None]], axis=1)
+
+
+def unpack_stats(packed, nbins: int):
+    """Inverse of :func:`pack_stats`: (curves (n, nbins), autos (n,))."""
+    return packed[:, :nbins], packed[:, nbins]
 
 
 def _batch_specs():
@@ -523,8 +564,8 @@ class EnsembleSimulator:
         )
         roe_args = self._roe_states
 
-        @partial(jax.jit, static_argnums=(2,))
-        def step(base_key, offset, nreal):
+        @partial(jax.jit, static_argnums=(2, 3))
+        def step(base_key, offset, nreal, with_corr=False):
             # per-realization keys derived on device: one tiny transfer per chunk
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
@@ -534,7 +575,13 @@ class EnsembleSimulator:
                       / self._bin_counts)
             # normalize by the mean autocorrelation to a unitless HD statistic
             autos = jnp.einsum("rpp->r", corr) / corr.shape[1]
-            return curves, autos, corr
+            # with_corr=False drops the (nreal, P, P) tensor from the program
+            # outputs entirely: it stays a fusible intermediate instead of a
+            # forced 400 MB HBM output buffer at the flagship size
+            packed = pack_stats(curves, autos)
+            if with_corr:
+                return packed, corr
+            return packed
 
         return step
 
@@ -603,9 +650,11 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal):
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
-            return shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._stat_weights, self._det,
-                            *self._roe_states)
+            curves, autos = shmapped(keys, self.batch, self._chol, self._gwb_w,
+                                     self._stat_weights, self._det,
+                                     *self._roe_states)
+            # same packed single-transfer contract as the XLA step
+            return pack_stats(curves, autos)
 
         return step
 
@@ -634,7 +683,8 @@ class EnsembleSimulator:
         chunk = int(min(chunk, nreal))
         chunk -= chunk % self._n_real_shards
         chunk = max(chunk, self._n_real_shards)
-        curves_out, autos_out, corr_out = [], [], []
+        packed_out, corr_out = [], []
+        nb = self.nbins
         done = 0
 
         ckpt = None
@@ -647,8 +697,7 @@ class EnsembleSimulator:
             state = ckpt.load(seed, nreal, chunk, keep_corr=keep_corr)
             if state is not None:
                 done = int(state["done"])
-                curves_out.append(state["curves"])
-                autos_out.append(state["autos"])
+                packed_out.append(pack_stats(state["curves"], state["autos"]))
                 if keep_corr:
                     if "corr" not in state:
                         raise ValueError("checkpoint was written without "
@@ -656,31 +705,48 @@ class EnsembleSimulator:
                     corr_out.append(state["corr"])
 
         fused = self._step_fused is not None and not keep_corr
+        # Per-chunk host materialization is only needed when somebody consumes
+        # host data mid-run (checkpointing). Otherwise chunks stay device-side:
+        # the jitted steps dispatch asynchronously, so the loop pipelines all
+        # chunks' compute, and the packed outputs are fetched once at the end —
+        # device->host round-trips through the remote-TPU tunnel cost ~80 ms
+        # flat each, which dominated the chunk time before this.
+        sync_each = ckpt is not None
         while done < nreal:
             # every step runs at the full chunk size (the final one overshoots and
             # is truncated below): the steps are jitted with a static realization
             # count, so a smaller tail chunk would recompile the SPMD program
             if fused:
-                curves, autos = self._step_fused(base, done, chunk)
+                packed = self._step_fused(base, done, chunk)
             else:
-                curves, autos, corr = self._step(base, done, chunk)
                 if keep_corr:
+                    packed, corr = self._step(base, done, chunk, True)
                     corr_out.append(to_host(corr))
-            curves_out.append(to_host(curves))
-            autos_out.append(to_host(autos))
+                else:
+                    packed = self._step(base, done, chunk, False)
+            if sync_each:
+                packed = to_host(packed)
+            elif hasattr(packed, "copy_to_host_async"):
+                packed.copy_to_host_async()   # overlap the fetch with compute
+            packed_out.append(packed)
             done += chunk
             if ckpt is not None and jax.process_index() == 0:
                 # append-only: each save writes this chunk's arrays, O(chunk)
                 # I/O. Only process 0 writes — to_host replicates outputs to
                 # every host, and concurrent renames of the same checkpoint
                 # files from N processes would race on shared storage
-                ckpt.save(seed, nreal, chunk, done, curves_out[-1], autos_out[-1],
+                c_chunk, a_chunk = unpack_stats(packed_out[-1], nb)
+                ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
                           corr_out[-1] if keep_corr else None)
             if progress is not None:
+                if not sync_each:
+                    jax.block_until_ready(packed)   # completion, not dispatch
                 progress(min(done, nreal), nreal)
+        packed_h = np.concatenate([to_host(p) for p in packed_out])[:nreal]
+        curves_h, autos_h = unpack_stats(packed_h, nb)
         out = {
-            "curves": np.concatenate(curves_out)[:nreal],
-            "autos": np.concatenate(autos_out)[:nreal],
+            "curves": curves_h,
+            "autos": autos_h,
             "bin_centers": np.asarray(self.bin_centers),
         }
         if keep_corr:

@@ -181,10 +181,10 @@ def test_to_host_materializes_sharded_outputs(small_batch):
 
     sim = EnsembleSimulator(small_batch, gwb=_gwb_cfg(small_batch),
                             mesh=make_mesh(jax.devices(), psr_shards=2))
-    curves, autos, corr = sim._step(jax.random.key(0), 0, 8)
-    got = to_host(curves)
-    assert isinstance(got, np.ndarray) and got.shape == (8, 15)
-    np.testing.assert_array_equal(got, np.asarray(curves))
+    packed = sim._step(jax.random.key(0), 0, 8, False)
+    got = to_host(packed)
+    assert isinstance(got, np.ndarray) and got.shape == (8, 16)
+    np.testing.assert_array_equal(got, np.asarray(packed))
     # numpy passthrough
     np.testing.assert_array_equal(to_host(np.arange(3.0)), np.arange(3.0))
 
