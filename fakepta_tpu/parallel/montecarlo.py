@@ -384,22 +384,19 @@ def _batch_specs():
     return PulsarBatch(**specs)
 
 
-def _correlation_rows(res_local, mask_local):
-    """Cross-correlation rows via the program's one collective.
+def _correlation_rows(res_local):
+    """Raw cross-correlation rows via the program's one collective.
 
     all_gathers the residual blocks over 'psr' and contracts local rows against
-    the full array: returns (R_local, P_local, P_total) pair correlations
-    normalized by valid-pair TOA counts (ref ``correlated_noises.py:14-19``
+    the full array: returns (R_local, P_local, P_total) pair-product sums. The
+    1/valid-pair-TOA-count normalization (ref ``correlated_noises.py:14-19``
     divides by the full TOA count; identical on uniform grids, correct under
-    padding here).
+    padding here) is NOT applied — the counts are static (mask-derived), so
+    callers fold them into precomputed binning weights instead of spending an
+    elementwise (R, P, P) HBM pass per chunk on the division.
     """
     res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
-    mask_full = lax.all_gather(mask_local, PSR_AXIS, axis=0, tiled=True)
-    counts = jnp.einsum("pt,qt->pq", mask_local.astype(res_local.dtype),
-                        mask_full.astype(res_local.dtype))
-    counts = jnp.maximum(counts, 1.0)
-    corr = jnp.einsum("rpt,rqt->rpq", res_local, res_full)
-    return corr / counts
+    return jnp.einsum("rpt,rqt->rpq", res_local, res_full)
 
 
 class EnsembleSimulator:
@@ -421,7 +418,12 @@ class EnsembleSimulator:
         ``'bf16'`` (default: bf16 matmul operands with f32 accumulation —
         ~4e-3 relative rounding on individual pair correlations, 2x the MXU
         rate) or ``'f32'`` (full-precision matmul at half rate). The XLA path
-        (default) always computes in f32."""
+        (default) accumulates in f32 but its big correlation contraction also
+        runs XLA's default TPU matmul precision (f32 operands rounded to bf16
+        — the same ~4e-3 pair-correlation bound); the angular-binning einsums
+        are pinned to full f32 precision. Wrap construction AND the ``run``
+        call in ``jax.default_matmul_precision('highest')`` for a full-f32
+        program at roughly half the matmul rate."""
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
         n_psr_shards = self.mesh.shape[PSR_AXIS]
@@ -514,9 +516,22 @@ class EnsembleSimulator:
         onehot[np.arange(batch.npsr)[:, None], np.arange(batch.npsr)[None, :],
                bin_idx] = 1.0
         onehot *= offdiag[:, :, None]
-        self._bin_onehot = jnp.asarray(onehot, dtype)
-        self._bin_counts = jnp.maximum(self._bin_onehot.sum((0, 1)), 1.0)
         self.bin_centers = edges[:-1] + 0.5 * (edges[1] - edges[0])
+
+        # Pair-count normalization folded into static statistic weights (the
+        # counts depend only on the TOA masks). corr stays raw pair sums inside
+        # the program and the pre-divided weights produce identical
+        # curves/autos; this also removes the mask all_gather + counts einsum
+        # from the shard_map body and matches how the fused Pallas path already
+        # normalizes (measured perf-neutral: XLA was fusing the division).
+        mask_np = np.asarray(batch.mask, dtype=np.float64)
+        counts_full = np.maximum(mask_np @ mask_np.T, 1.0)
+        bc = np.maximum(onehot.sum((0, 1)), 1.0)
+        self._w_bins = jnp.asarray(
+            onehot / counts_full[:, :, None] / bc, dtype)
+        self._w_auto = jnp.asarray(
+            np.eye(batch.npsr) / counts_full / batch.npsr, dtype)
+        self._counts_dev = jnp.asarray(counts_full, dtype)
 
         # fused pallas statistic path (curves+autos without materializing the
         # (R, P, P) correlation tensor in HBM). Opt-in: the XLA path is already
@@ -531,7 +546,6 @@ class EnsembleSimulator:
             raise ValueError(f"pallas_precision must be 'bf16' or 'f32', "
                              f"got {pallas_precision!r}")
         self._pallas_precision = pallas_precision
-        self._onehot_np = onehot
 
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
@@ -553,7 +567,7 @@ class EnsembleSimulator:
                 term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
                                        tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            return _correlation_rows(res, batch.mask)
+            return _correlation_rows(res)
 
         roe_specs = tuple(_orbit_state_specs() for _ in range(n_roe))
         shmapped = jax.shard_map(
@@ -570,17 +584,22 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._det, *roe_args)
-            curves = (jnp.einsum("rpq,pqn->rn", corr, self._bin_onehot)
-                      / self._bin_counts)
-            # normalize by the mean autocorrelation to a unitless HD statistic
-            autos = jnp.einsum("rpp->r", corr) / corr.shape[1]
+                            self._det, *roe_args)   # raw pair sums
+            # HIGHEST: these einsums lower to matmuls, and XLA's default TPU
+            # matmul rounds f32 operands to bf16 — a free-to-avoid ~4e-3
+            # relative error here (the binning is a trivial fraction of the
+            # program's FLOPs; the big corr contraction keeps the fast default)
+            hi = jax.lax.Precision.HIGHEST
+            curves = jnp.einsum("rpq,pqn->rn", corr, self._w_bins,
+                                precision=hi)
+            # mean autocorrelation (count-normalized trace / P)
+            autos = jnp.einsum("rpq,pq->r", corr, self._w_auto, precision=hi)
             # with_corr=False drops the (nreal, P, P) tensor from the program
             # outputs entirely: it stays a fusible intermediate instead of a
             # forced 400 MB HBM output buffer at the flagship size
             packed = pack_stats(curves, autos)
             if with_corr:
-                return packed, corr
+                return packed, corr / self._counts_dev
             return packed
 
         return step
@@ -591,18 +610,11 @@ class EnsembleSimulator:
         :mod:`fakepta_tpu.ops.pallas_kernels`)."""
         from ..ops.pallas_kernels import binned_correlation, pick_rt
 
-        batch = self.batch
-        dtype = batch.t_own.dtype
-        # combined statistic weights, fused-path-only state: slot n < nbins is
-        # onehot/(pair counts * bin count); slot nbins is the normalized trace
-        mask_np = np.asarray(batch.mask, dtype=np.float64)
-        counts = np.maximum(mask_np @ mask_np.T, 1.0)          # (P, P) pair TOAs
-        bc = np.asarray(self._bin_counts, dtype=np.float64)
-        w_bins = self._onehot_np.transpose(2, 0, 1) / counts[None] \
-            / bc[:, None, None]
-        w_auto = (np.eye(batch.npsr) / counts / batch.npsr)[None]
-        self._stat_weights = jnp.asarray(
-            np.concatenate([w_bins, w_auto], axis=0), dtype)   # (nbins+1, P, P)
+        # combined statistic weights, single-sourced from the XLA path's
+        # normalization: slot n < nbins is onehot/(pair counts * bin count);
+        # slot nbins is the normalized auto trace. (nbins+1, P, P)
+        self._stat_weights = jnp.concatenate(
+            [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]], axis=0)
 
         mesh = self.mesh
         batch_specs = _batch_specs()
