@@ -129,7 +129,7 @@ def config6():
         include=("white", "dm", "gwb", "det"),
         roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
         toas_abs=toas_abs, mesh=make_mesh(jax.devices()))
-    nreal, chunk = 4000, 4000
+    nreal, chunk = 40_000, 4000          # chunks pipeline; steady-state rate
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -175,7 +175,7 @@ def config7():
                                      ecorr=True)
     sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()),
                             include=("white", "ecorr", "red", "dm", "sys"))
-    nreal, chunk = 4000, 4000
+    nreal, chunk = 40_000, 4000          # chunks pipeline; steady-state rate
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)

@@ -392,8 +392,10 @@ def _correlation_rows(res_local):
     1/valid-pair-TOA-count normalization (ref ``correlated_noises.py:14-19``
     divides by the full TOA count; identical on uniform grids, correct under
     padding here) is NOT applied — the counts are static (mask-derived), so
-    callers fold them into precomputed binning weights instead of spending an
-    elementwise (R, P, P) HBM pass per chunk on the division.
+    callers fold them into precomputed binning weights. That keeps the mask
+    all_gather + counts einsum out of the shard_map body and single-sources
+    the normalization with the fused Pallas path (the division itself was
+    measured perf-neutral: XLA fused it).
     """
     res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
     return jnp.einsum("rpt,rqt->rpq", res_local, res_full)
