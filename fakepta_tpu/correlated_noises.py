@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import spectrum as spectrum_lib
+from .ops import fourier as fourier_ops
 from .ops import gwb as gwb_ops
 from .utils import rng as rng_utils
 
@@ -127,6 +128,39 @@ def curn(psrs):
 # the GWB injector (ref :111-160)
 # ---------------------------------------------------------------------------
 
+# One fused kernel per pulsar (and one for the shared correlated draw): through
+# a remote-TPU tunnel every eager op costs ~1.6 ms of flat dispatch latency, so
+# the injection is dispatch-count-bound — see the fused kernels in fake_pta.py.
+
+@jax.jit
+def _k_gwb_draw(key, folds, chol, psd):
+    k = rng_utils.fold_key_in_kernel(key, folds)
+    return gwb_ops.draw_correlated_coeffs(k, chol, psd)
+
+
+def _gwb_delta(phase, scale, coeffs, n, inv_sqrt_df, df):
+    col = jnp.take(coeffs, n, axis=2)                        # (2, ncomp)
+    col_pad = jnp.pad(col, ((0, 0), (0, df.shape[0] - col.shape[1])))
+    basis = fourier_ops.basis_from_phase(phase, scale)
+    delta = fourier_ops.inject_from_coeffs(basis, col_pad, df)
+    return delta, col * jnp.asarray(inv_sqrt_df)[None, :]
+
+
+@jax.jit
+def _k_gwb_inject_acc(cur, phase, scale, coeffs, n, inv_sqrt_df, df):
+    delta, fourier = _gwb_delta(phase, scale, coeffs, n, inv_sqrt_df, df)
+    return jnp.asarray(cur) + delta[: cur.shape[0]], fourier
+
+
+@jax.jit
+def _k_gwb_reinject_acc(cur, phase, scale, coeffs, n, inv_sqrt_df, df,
+                        old_phase, old_scale, old_fourier, old_df):
+    delta, fourier = _gwb_delta(phase, scale, coeffs, n, inv_sqrt_df, df)
+    old = fourier_ops.reconstruct_old_padded(old_phase, old_scale, old_fourier,
+                                             old_df)
+    return jnp.asarray(cur) + (delta - old)[: cur.shape[0]], fourier
+
+
 def _array_tspan(psrs):
     return (max(psr.toas.max() for psr in psrs)
             - min(psr.toas.min() for psr in psrs))
@@ -139,8 +173,8 @@ def _resolve_common_psd(spectrum, f_psd, custom_psd, kwargs):
         return np.asarray(custom_psd, dtype=np.float64), {}
     if spectrum not in spectrum_lib.SPECTRA:
         raise KeyError(f"unknown spectrum {spectrum!r}")
-    # device array: consumed by jitted kernels only (materialized at pickle time)
-    psd = spectrum_lib.evaluate(spectrum, f_psd, **kwargs)
+    # host numpy via the local CPU backend: zero accelerator dispatches
+    psd = spectrum_lib.evaluate_host(spectrum, f_psd, **kwargs)
     return psd, kwargs
 
 
@@ -173,30 +207,48 @@ def add_common_correlated_noise(psrs, orf="hd", spectrum="powerlaw", name="gw",
     pos = _positions(psrs)
     orfs = gwb_ops.build_orf(orf, pos, h_map)
     chol = gwb_ops.orf_cholesky(orfs)
-    key = rng_utils.as_key(seed) if seed is not None else \
-        rng_utils.KeyStream(None, "gwb").next()
+    if seed is not None:
+        key, folds = rng_utils.as_key(seed), rng_utils.NO_FOLDS
+    else:
+        key, folds = rng_utils.KeyStream(None, "gwb").next_spec()
     # stays on device: per-pulsar slices feed straight back into jitted kernels,
     # so the whole array injection runs without a single host sync
-    coeffs = gwb_ops.draw_correlated_coeffs(key, chol, psd_gwb)
+    coeffs = _k_gwb_draw(key, folds, chol, psd_gwb)
     inv_sqrt_df = 1.0 / np.sqrt(df)
 
     for n, psr in enumerate(psrs):
-        if signal_name in psr.signal_model:
-            # reconstruct_signal uses the OLD entry's stored freqf/idx scaling
+        old = psr.signal_model.get(signal_name)
+        if old is not None and "fourier" not in old:
+            # joint-covariance entries store the realization itself (rare path)
             psr._accumulate(-psr._reconstruct_signal_dev([signal_name]))
-        entry = {
+            old = None
+        phase, scale, df_pad, ntoa, nbin = psr._padded_phase_scale(
+            f_psd, idx, freqf, None)
+        cur = psr._res_current()
+        if old is None:
+            new, fourier = _k_gwb_inject_acc(
+                cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad)
+        else:
+            # the OLD entry's stored freqf/idx scaling reconstructs what was
+            # actually injected, whatever this call's scaling is
+            old_f = np.asarray(old["f"], dtype=np.float64)
+            old_phase, old_scale, old_df, _, _ = psr._padded_phase_scale(
+                old_f, old["idx"], old.get("freqf", 1400.0), None)
+            new, fourier = _k_gwb_reinject_acc(
+                cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad,
+                old_phase, old_scale, old["fourier"], old_df)
+        psr.residuals = new
+        psr.signal_model[signal_name] = {
             "orf": orf,
             "spectrum": spectrum,
             "hmap": h_map,
             "f": f_psd,
             "psd": psd_gwb,
-            "fourier": coeffs[:, :, n] * inv_sqrt_df[None, :],
+            "fourier": fourier,
             "nbin": components,
             "idx": idx,
             "freqf": freqf,
         }
-        psr.signal_model[signal_name] = entry
-        psr._accumulate(psr._reconstruct_gp(entry, None, None))
     return np.asarray(orfs)
 
 

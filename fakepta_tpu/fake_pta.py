@@ -23,6 +23,7 @@ ephemeral inside kernel calls.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Dict
 
 import jax
@@ -44,18 +45,68 @@ DAY_SECONDS = 86400.0
 # Jitted device kernels shared by all Pulsar instances (shapes bucketed by caller).
 # ---------------------------------------------------------------------------
 
-@jax.jit
-def _k_inject(phase, scale, psd, df, key):
-    """Draw GP coefficients and inject: returns (delta_residuals, stored fourier).
+# Every injector is ONE fused kernel call: key folding, coefficient draw, old-
+# realization subtraction (re-injection), projection and residual accumulation
+# all happen inside a single jit. Through a remote-TPU tunnel each eager op
+# costs ~1.6 ms of flat dispatch latency regardless of size, so the facade's
+# per-call cost is dispatch-count-bound — one dispatch per injection is the
+# floor for per-pulsar device-resident residuals.
 
-    The stored-coefficient normalization ``c/sqrt(df)`` happens inside the kernel
-    so the facade never has to synchronize the draw back to host (padded bins have
-    ``df = 1`` by construction, so no NaN leaks through the division).
-    """
+
+def _gp_draw_delta(phase, scale, psd, df, key, folds):
+    """(padded delta, stored fourier) for a fresh GP draw, inside-jit."""
+    k = rng_utils.fold_key_in_kernel(key, folds)
     basis = fourier_ops.basis_from_phase(phase, scale)
-    coeffs = fourier_ops.draw_coeffs(key, psd)
+    coeffs = fourier_ops.draw_coeffs(k, psd)
     delta = fourier_ops.inject_from_coeffs(basis, coeffs, df)
     return delta, coeffs / jnp.sqrt(df)[None, :]
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_inject_acc(cur, phase, scale, psd, df, key, folds, nbin):
+    delta, fourier = _gp_draw_delta(phase, scale, psd, df, key, folds)
+    return jnp.asarray(cur) + delta[: cur.shape[0]], fourier[:, :nbin]
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_reinject_acc(cur, phase, scale, psd, df, key, folds,
+                       old_phase, old_scale, old_fourier, old_df, nbin):
+    delta, fourier = _gp_draw_delta(phase, scale, psd, df, key, folds)
+    old = fourier_ops.reconstruct_old_padded(old_phase, old_scale, old_fourier, old_df)
+    new = jnp.asarray(cur) + (delta - old)[: cur.shape[0]]
+    return new, fourier[:, :nbin]
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_inject_scatter(cur, idx, phase, scale, psd, df, key, folds, nbin):
+    delta, fourier = _gp_draw_delta(phase, scale, psd, df, key, folds)
+    return (jnp.asarray(cur).at[idx].add(delta[: idx.shape[0]]),
+            fourier[:, :nbin])
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_reinject_scatter(cur, idx, phase, scale, psd, df, key, folds,
+                           old_phase, old_scale, old_fourier, old_df, nbin):
+    delta, fourier = _gp_draw_delta(phase, scale, psd, df, key, folds)
+    old = fourier_ops.reconstruct_old_padded(old_phase, old_scale, old_fourier, old_df)
+    new = jnp.asarray(cur).at[idx].add((delta - old)[: idx.shape[0]])
+    return new, fourier[:, :nbin]
+
+
+@jax.jit
+def _k_white_acc(cur, key, folds, toaerrs, efac, equad):
+    k = rng_utils.fold_key_in_kernel(key, folds)
+    sigma2 = white_ops.white_sigma2(toaerrs, efac, equad)
+    return jnp.asarray(cur) + white_ops.draw_white(k, sigma2)
+
+
+@partial(jax.jit, static_argnames=("n_epochs",))
+def _k_white_ecorr_acc(cur, key, folds, toaerrs, efac, equad, ecorr_var,
+                       epoch_idx, n_epochs, weight):
+    k = rng_utils.fold_key_in_kernel(key, folds)
+    sigma2 = white_ops.white_sigma2(toaerrs, efac, equad)
+    return jnp.asarray(cur) + white_ops.draw_white_ecorr(
+        k, sigma2, ecorr_var, epoch_idx, n_epochs, weight)
 
 
 @jax.jit
@@ -89,11 +140,6 @@ def _k_reconstruct(phase, scale, fourier, df):
 def _k_cov(phase, scale, psd, df):
     basis = fourier_ops.basis_from_phase(phase, scale)
     return fourier_ops.gp_covariance(basis, psd, df)
-
-
-@jax.jit
-def _k_white(key, sigma2):
-    return white_ops.draw_white(key, sigma2)
 
 
 @jax.jit
@@ -365,10 +411,27 @@ class Pulsar:
     def _padded_phase_scale(self, f_psd, idx, freqf=1400.0, mask=None):
         """Host-side float64 phase table, bucket-padded for the jit cache.
 
-        Returns (phase (T,B), scale (T,), psd_pad_fn, df (B,), ntoa, nbin) where
-        T/B are bucketed sizes. Padded TOAs get zero scale; padded frequency bins get
+        Returns (phase (T,B), scale (T,), df (B,), ntoa, nbin) where T/B are
+        bucketed sizes. Padded TOAs get zero scale; padded frequency bins get
         zero PSD (callers pad) and df=1 so no NaN leaks through sqrt/division.
+
+        Memoized per pulsar: a workflow injects on the same (toas, grid) pair
+        over and over (re-injection, every ``add_*_noise`` call), and the
+        ~ms-scale ``np.outer`` dominates the host side of a fused single-
+        dispatch injection. The key hashes every input the table depends on, so
+        ``copy_array``-style attribute overwrites invalidate naturally.
         """
+        f_psd = np.asarray(f_psd, dtype=np.float64)
+        cache_key = (self.toas.tobytes(), f_psd.tobytes(), float(idx),
+                     float(freqf),
+                     self.freqs.tobytes() if idx else None,
+                     mask.tobytes() if mask is not None else None)
+        cache = getattr(self, "_phase_cache", None)
+        if cache is None:
+            cache = self._phase_cache = {}
+        hit = cache.get(cache_key)
+        if hit is not None:
+            return hit
         toas = self.toas if mask is None else self.toas[mask]
         nu = self.freqs if mask is None else self.freqs[mask]
         ntoa, nbin = len(toas), len(f_psd)
@@ -381,7 +444,17 @@ class Pulsar:
         scale[:ntoa] = (freqf / nu) ** idx
         df = np.ones(b_pad)
         df[:nbin] = np.diff(np.concatenate([[0.0], f_psd]))
-        return phase, scale, df, ntoa, nbin
+        out = (phase, scale, df, ntoa, nbin)
+        # bound by bytes, not entries: one 4k-TOA x 100-bin table is ~4 MB of
+        # float64, and a 100-pulsar array holds one cache per pulsar
+        entry_bytes = phase.nbytes + scale.nbytes + df.nbytes
+        self._phase_cache_bytes = getattr(self, "_phase_cache_bytes", 0)
+        if self._phase_cache_bytes + entry_bytes > 8 << 20:
+            cache.clear()
+            self._phase_cache_bytes = 0
+        cache[cache_key] = out
+        self._phase_cache_bytes += entry_bytes
+        return out
 
     @staticmethod
     def _pad_bins(arr, b_pad, fill=0.0):
@@ -402,7 +475,10 @@ class Pulsar:
         ``10^(2 log10_ecorr)`` for the block variance. ``randomize`` redraws the
         white-noise dictionary entries uniformly as the reference does (:203-210).
         """
-        key = self._keys.next("white") if seed is None else rng_utils.as_key(seed)
+        if seed is None:
+            key, folds = self._keys.next_spec("white")
+        else:
+            key, folds = rng_utils.as_key(seed), rng_utils.NO_FOLDS
         if randomize:
             host = self._keys.host_rng("white_randomize")
             for k in self.noisedict:
@@ -422,16 +498,16 @@ class Pulsar:
             equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
             if add_ecorr:
                 ecorr[sel] = self.noisedict[f"{self.name}_{backend}_log10_ecorr"]
-        sigma2 = white_ops.white_sigma2(self.toaerrs, efac, equad)
-
+        cur = self._res_current()
         if add_ecorr:
             epoch_idx, n_epochs, counts = self._epoch_segments()
             weight = (counts >= 2).astype(np.float64)
-            draw = white_ops.draw_white_ecorr(
-                key, sigma2, 10.0 ** (2.0 * ecorr), epoch_idx, n_epochs, weight)
+            self.residuals = _k_white_ecorr_acc(
+                cur, key, folds, self.toaerrs, efac, equad,
+                10.0 ** (2.0 * ecorr), epoch_idx, n_epochs, weight)
         else:
-            draw = _k_white(key, sigma2)
-        self._accumulate(draw)
+            self.residuals = _k_white_acc(cur, key, folds, self.toaerrs, efac,
+                                          equad)
 
     def _epoch_segments(self, dt=1.0, backends=None):
         """Integer epoch id per TOA — what the vectorized ECORR sampler consumes.
@@ -478,10 +554,9 @@ class Pulsar:
                 raise ValueError(
                     f"PSD parameters for {signal} must be in the noisedict or passed "
                     f"as keyword arguments (missing {exc})") from exc
-        # stays a device array: the PSD only feeds jitted kernels and the pickled
-        # signal_model (materialized at pickle time), so a host sync here would be
-        # a pure ~80 ms latency tax per injection
-        psd = spectrum_lib.evaluate(spectrum, f_psd, **kwargs)
+        # host numpy via the local CPU backend: tiny grids, zero accelerator
+        # dispatches, pickles directly (see spectrum.evaluate_host)
+        psd = spectrum_lib.evaluate_host(spectrum, f_psd, **kwargs)
         return psd, kwargs
 
     def add_red_noise(self, spectrum="powerlaw", f_psd=None, seed=None, **kwargs):
@@ -513,12 +588,13 @@ class Pulsar:
         psd, resolved = self._resolve_psd(signal, spectrum, f_psd, kwargs)
         if len(psd) != len(f_psd):
             raise ValueError('"psd" and "f_psd" must have the same length')
-        if signal in self.signal_model:
-            self._accumulate(-self._reconstruct_signal_dev([signal]))
         if resolved:
             self.update_noisedict(f"{self.name}_{signal}", resolved)
+        # re-injection: the old realization is subtracted INSIDE the fused
+        # injection kernel (one dispatch total), not as a separate accumulate
         self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
-                                       f_psd=f_psd, idx=idx, seed=seed)
+                                       f_psd=f_psd, idx=idx, seed=seed,
+                                       _subtract=self.signal_model.get(signal))
 
     def add_system_noise(self, backend=None, components=30, spectrum="powerlaw",
                          f_psd=None, seed=None, **kwargs):
@@ -538,16 +614,16 @@ class Pulsar:
         psd, resolved = self._resolve_psd(signal, spectrum, f_psd, kwargs)
         if len(psd) != len(f_psd):
             raise ValueError('"psd" and "f_psd" must have the same length')
-        if stored in self.signal_model:
-            self._accumulate(-self._reconstruct_signal_dev([stored]))
         if resolved:
             self.update_noisedict(f"{self.name}_{signal}", resolved)
         self.add_time_correlated_noise(signal=signal, spectrum=spectrum, psd=psd,
-                                       f_psd=f_psd, idx=0.0, backend=backend, seed=seed)
+                                       f_psd=f_psd, idx=0.0, backend=backend,
+                                       seed=seed,
+                                       _subtract=self.signal_model.get(stored))
 
     def add_time_correlated_noise(self, signal="", spectrum="powerlaw", psd=None,
                                   f_psd=None, idx=0, freqf=1400, backend=None,
-                                  seed=None):
+                                  seed=None, _subtract=None):
         """Core Fourier-basis GP injector (ref ``fake_pta.py:357-387``).
 
         Draws coefficients ``c ~ N(0, sqrt(psd))``, accumulates
@@ -556,8 +632,16 @@ class Pulsar:
         are ``c/sqrt(df)``). Chromatic scaling uses the masked radio frequencies —
         the reference broadcasts the full-length frequency array against masked
         residuals, which fails for a proper backend subset (:386).
+
+        ``_subtract`` (internal): a stored ``signal_model`` entry whose
+        realization is subtracted inside the same fused kernel — the
+        re-injection path of the ``add_*_noise`` wrappers, kept to a single
+        device dispatch.
         """
-        key = self._keys.next(signal or "gp") if seed is None else rng_utils.as_key(seed)
+        if seed is None:
+            key, folds = self._keys.next_spec(signal or "gp")
+        else:
+            key, folds = rng_utils.as_key(seed), rng_utils.NO_FOLDS
         if backend is not None:
             signal = f"{backend}_{signal}"
             mask = self.backend_flags == backend
@@ -575,22 +659,46 @@ class Pulsar:
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, idx, freqf, mask)
         psd_pad = self._pad_bins(psd, len(df_pad))
-        delta_pad, fourier_pad = _k_inject(phase, scale, psd_pad, df_pad, key)
+        if _subtract is not None and "fourier" not in _subtract:
+            # joint-covariance entries store the realization itself; subtract it
+            # the slow way (rare path) and inject fresh below
+            self._accumulate(-jnp.asarray(_subtract["realization"]))
+            _subtract = None
+
+        cur = self._res_current()
+        if _subtract is None:
+            if mask is None:
+                new, fourier = _k_gp_inject_acc(
+                    cur, phase, scale, psd_pad, df_pad, key, folds, nbin=nbin)
+            else:
+                new, fourier = _k_gp_inject_scatter(
+                    cur, np.flatnonzero(mask), phase, scale, psd_pad, df_pad,
+                    key, folds, nbin=nbin)
+        else:
+            old_f = np.asarray(_subtract["f"], dtype=np.float64)
+            old_phase, old_scale, old_df, _, _ = self._padded_phase_scale(
+                old_f, _subtract["idx"], _subtract.get("freqf", 1400.0), mask)
+            if mask is None:
+                new, fourier = _k_gp_reinject_acc(
+                    cur, phase, scale, psd_pad, df_pad, key, folds,
+                    old_phase, old_scale, _subtract["fourier"], old_df,
+                    nbin=nbin)
+            else:
+                new, fourier = _k_gp_reinject_scatter(
+                    cur, np.flatnonzero(mask), phase, scale, psd_pad, df_pad,
+                    key, folds, old_phase, old_scale, _subtract["fourier"],
+                    old_df, nbin=nbin)
+        self.residuals = new
 
         self.signal_model[signal] = {
             "spectrum": spectrum,
             "f": f_psd,
             "psd": psd,
-            "fourier": fourier_pad[:, :nbin],
+            "fourier": fourier,
             "nbin": nbin,
             "idx": idx,
             "freqf": freqf,
         }
-        delta = delta_pad[:ntoa]
-        if mask is None:
-            self._accumulate(delta)
-        else:
-            self._accumulate(delta, idx=np.flatnonzero(mask))
 
     # ------------------------------------------------------------------
     # deterministic injectors
@@ -808,6 +916,8 @@ class Pulsar:
         state = dict(self.__dict__)
         state.pop("_res_host", None)
         state.pop("_res_dev", None)
+        state.pop("_phase_cache", None)   # derived host tables, never pickled
+        state.pop("_phase_cache_bytes", None)
         state["residuals"] = np.asarray(self.residuals, dtype=np.float64)
         state["signal_model"] = _host_tree(self.signal_model)
         state["_keys"] = None

@@ -98,6 +98,21 @@ def reconstruct_from_fourier(basis, fourier, df, toa_mask=None):
     return res
 
 
+def reconstruct_old_padded(old_phase, old_scale, old_fourier, old_df):
+    """Padded realization of a stored GP entry, for inside-jit subtraction.
+
+    The single implementation of "rebuild what a signal_model entry injected"
+    used by every fused re-injection kernel (GP and GWB): pads the stored
+    ``(2, nbin)`` coefficients to the bucketed bin count (padded bins have
+    df=1 and zero coefficients, so they contribute nothing) and reconstructs
+    on the old entry's own phase/scale tables.
+    """
+    four = jnp.pad(jnp.asarray(old_fourier),
+                   ((0, 0), (0, old_df.shape[0] - old_fourier.shape[1])))
+    basis = basis_from_phase(old_phase, old_scale)
+    return reconstruct_from_fourier(basis, four, old_df)
+
+
 def gp_covariance(basis, psd, df):
     """Dense GP covariance ``F diag(repeat(psd*df, 2)) F^T`` (ref ``fake_pta.py:389-420``).
 

@@ -181,3 +181,30 @@ def evaluate(spectrum: str, f, **kwargs):
             f"unknown spectrum {spectrum!r}; registered: {sorted(SPECTRA)}"
         )
     return SPECTRA[spectrum](f, **kwargs)
+
+
+_CPU_DEVICE = None
+
+
+def evaluate_host(spectrum: str, f, **kwargs):
+    """:func:`evaluate` to a host numpy array, computed on the local CPU backend.
+
+    PSD grids are tiny (tens of bins); evaluating them on the accelerator costs
+    a full dispatch + eventual sync — milliseconds of flat latency on a remote
+    TPU — while the local CPU backend answers in microseconds. The host result
+    feeds jitted kernels (uploaded with the consuming call) and pickles
+    directly. Falls back to the default backend when no CPU backend exists.
+    """
+    global _CPU_DEVICE
+    import jax
+
+    import numpy as np
+    if _CPU_DEVICE is None:
+        try:
+            _CPU_DEVICE = jax.devices("cpu")[0]
+        except RuntimeError:
+            _CPU_DEVICE = False
+    if _CPU_DEVICE is False:
+        return np.asarray(evaluate(spectrum, f, **kwargs))
+    with jax.default_device(_CPU_DEVICE):
+        return np.asarray(evaluate(spectrum, f, **kwargs))

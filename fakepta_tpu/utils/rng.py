@@ -9,6 +9,7 @@ so that per-(pulsar, signal, realization) streams are independent and reproducib
 
 from __future__ import annotations
 
+import functools
 import zlib
 from typing import Union
 
@@ -18,6 +19,17 @@ import numpy as np
 KeyLike = Union[int, jax.Array, None]
 
 _DEFAULT_SEED = 0
+
+
+@functools.lru_cache(maxsize=4096)
+def _int_key(seed: int) -> jax.Array:
+    """Cached ``jax.random.key`` for integer seeds.
+
+    Seeding is an eager device op (~ms of dispatch latency on a remote TPU);
+    explicit-seed APIs call it per injection, so the memo turns repeats into a
+    dict lookup. Keys are immutable, so sharing the array is safe.
+    """
+    return jax.random.key(seed)
 
 
 def set_default_seed(seed: int) -> None:
@@ -33,9 +45,9 @@ def get_default_seed() -> int:
 def as_key(seed_or_key: KeyLike) -> jax.Array:
     """Coerce an int seed / key / None (-> package default seed) into a PRNG key."""
     if seed_or_key is None:
-        return jax.random.key(_DEFAULT_SEED)
+        return _int_key(_DEFAULT_SEED)
     if isinstance(seed_or_key, (int, np.integer)):
-        return jax.random.key(int(seed_or_key))
+        return _int_key(int(seed_or_key))
     return seed_or_key
 
 
@@ -82,8 +94,37 @@ class KeyStream:
         self._count += 1
         return key
 
+    def next_spec(self, *labels):
+        """(base key, uint32 fold labels) for key derivation INSIDE a jitted
+        kernel instead of eagerly.
+
+        Each eager ``fold_in`` is a device dispatch — milliseconds of latency
+        per call on a remote TPU — while folding inside the consuming kernel is
+        free. Applying ``jax.random.fold_in`` left-to-right over the returned
+        labels yields the exact key :meth:`next` would have returned (same
+        counter bump, same fold order, same 32-bit label values).
+        """
+        folds = np.array([self._count] + [_label_to_int(l) for l in labels],
+                         dtype=np.uint32)
+        self._count += 1
+        return self._base, folds
+
     def host_rng(self, *labels) -> np.random.Generator:
         """A numpy Generator seeded from this stream, for host-side config sampling."""
         key = self.next(*labels)
         data = jax.random.key_data(key)
         return np.random.default_rng(np.asarray(data, dtype=np.uint32).ravel().tolist())
+
+
+NO_FOLDS = np.zeros((0,), dtype=np.uint32)
+
+
+def fold_key_in_kernel(key, folds):
+    """Apply a :meth:`KeyStream.next_spec` fold-label array inside a kernel.
+
+    The loop length is static (folds is a fixed-shape argument), so this traces
+    to a chain of fold_ins with no data-dependent control flow.
+    """
+    for i in range(folds.shape[0]):
+        key = jax.random.fold_in(key, folds[i])
+    return key
