@@ -446,12 +446,16 @@ class Pulsar:
         df[:nbin] = np.diff(np.concatenate([[0.0], f_psd]))
         out = (phase, scale, df, ntoa, nbin)
         # bound by bytes, not entries: one 4k-TOA x 100-bin table is ~4 MB of
-        # float64, and a 100-pulsar array holds one cache per pulsar
+        # float64, and a 100-pulsar array holds one cache per pulsar. Evict
+        # oldest-first (dicts are insertion-ordered) rather than clearing, so a
+        # working set just over budget still keeps its hottest entries instead
+        # of thrashing every insert.
         entry_bytes = phase.nbytes + scale.nbytes + df.nbytes
         self._phase_cache_bytes = getattr(self, "_phase_cache_bytes", 0)
-        if self._phase_cache_bytes + entry_bytes > 8 << 20:
-            cache.clear()
-            self._phase_cache_bytes = 0
+        while cache and self._phase_cache_bytes + entry_bytes > 8 << 20:
+            old_phase, old_scale, old_df, _, _ = cache.pop(next(iter(cache)))
+            self._phase_cache_bytes -= (old_phase.nbytes + old_scale.nbytes
+                                        + old_df.nbytes)
         cache[cache_key] = out
         self._phase_cache_bytes += entry_bytes
         return out

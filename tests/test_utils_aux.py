@@ -142,3 +142,46 @@ def test_trace_writes_profile(tmp_path):
         jax.block_until_ready(jax.numpy.ones(8) * 2)
     files = list((tmp_path / "tr").rglob("*"))
     assert files, "no trace output written"
+
+
+def test_next_spec_matches_next_bit_exactly():
+    """next_spec + in-kernel folding must reproduce next()'s key, including
+    labels whose crc32 exceeds 2^31 (uint32 vs Python-int fold parity)."""
+    from fakepta_tpu.utils import rng as rng_utils
+
+    labels_sets = [("white",), ("red_noise",), ("gwb", 7), (0xDEADBEEF,)]
+    for labels in labels_sets:
+        a = rng_utils.KeyStream(42, "psr")
+        b = rng_utils.KeyStream(42, "psr")
+        want = a.next(*labels)
+        base, folds = b.next_spec(*labels)
+        got = jax.jit(rng_utils.fold_key_in_kernel)(base, folds)
+        np.testing.assert_array_equal(jax.random.key_data(want),
+                                      jax.random.key_data(got))
+        # counters advanced identically
+        np.testing.assert_array_equal(jax.random.key_data(a.next()),
+                                      jax.random.key_data(b.next()))
+
+
+def test_as_key_int_cache_consistent():
+    from fakepta_tpu.utils import rng as rng_utils
+
+    k1, k2 = rng_utils.as_key(5), rng_utils.as_key(5)
+    np.testing.assert_array_equal(jax.random.key_data(k1),
+                                  jax.random.key_data(k2))
+    np.testing.assert_array_equal(jax.random.key_data(k1),
+                                  jax.random.key_data(jax.random.key(5)))
+
+
+def test_phase_cache_invalidates_on_attribute_overwrite():
+    """copy_array-style attribute overwrites must not serve stale phase tables."""
+    toas = np.linspace(0, 5 * const.yr, 64)
+    p = Pulsar(toas, 1e-7, 1.0, 1.0, seed=0,
+               custom_model={"RN": 4, "DM": None, "Sv": None})
+    f_psd = np.arange(1, 5) / p.Tspan
+    phase1, *_ = p._padded_phase_scale(f_psd, 0.0)
+    phase1b, *_ = p._padded_phase_scale(f_psd, 0.0)
+    assert phase1 is phase1b, "second identical call should hit the cache"
+    p.toas = p.toas + 3600.0          # overwrite, as copy_array does
+    phase2, *_ = p._padded_phase_scale(f_psd, 0.0)
+    assert not np.array_equal(phase1, phase2), "stale phase table served"
