@@ -19,8 +19,6 @@ Public-API parity with the reference's ``correlated_noises.py`` (functions
 
 from __future__ import annotations
 
-from typing import Sequence
-
 import jax
 import jax.numpy as jnp
 import numpy as np

@@ -29,7 +29,7 @@ vectors to the device pipeline.
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict
 
 import numpy as np
 

@@ -272,8 +272,6 @@ def test_add_planet_with_derived_semimajor_axis():
 def test_monopole_orf_float32_cholesky_no_nan():
     """Regression: the all-ones monopole ORF is exactly singular; the Cholesky
     must be float64-safe so float32 pipelines get finite correlated draws."""
-    import jax
-
     psrs = _array(4, ntoa=30)
     cn.add_common_correlated_noise(psrs, orf="monopole", spectrum="powerlaw",
                                    log10_A=-14.0, gamma=3.0, components=5, seed=3)

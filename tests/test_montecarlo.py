@@ -7,7 +7,6 @@ import pytest
 from fakepta_tpu import constants as const
 from fakepta_tpu.batch import PulsarBatch, fourier_basis_norm
 from fakepta_tpu.fake_pta import Pulsar
-from fakepta_tpu.ops import gwb as gwb_ops
 from fakepta_tpu import spectrum as spectrum_lib
 from fakepta_tpu.parallel.mesh import make_mesh
 from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
