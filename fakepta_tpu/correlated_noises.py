@@ -159,6 +159,96 @@ def _k_gwb_reinject_acc(cur, phase, scale, coeffs, n, inv_sqrt_df, df,
     return jnp.asarray(cur) + (delta - old)[: cur.shape[0]], fourier
 
 
+# Batched variants: when every pulsar shares the (ntoa, nbin) bucket — the
+# common case for fabricated arrays — the whole-array injection is ONE kernel
+# over stacked tables, and results scatter back as zero-op _LazyRow views.
+
+@jax.jit
+def _k_gwb_inject_acc_batched(cur, phase, scale, coeffs, inv_sqrt_df, df):
+    def one(cur_g, phase_g, scale_g, n):
+        delta, fourier = _gwb_delta(phase_g, scale_g, coeffs, n, inv_sqrt_df, df)
+        return cur_g + delta[: cur_g.shape[0]], fourier
+    return jax.vmap(one)(cur, phase, scale, jnp.arange(cur.shape[0]))
+
+
+@jax.jit
+def _k_gwb_reinject_acc_batched(cur, phase, scale, coeffs, inv_sqrt_df, df,
+                                old_phase, old_scale, old_fourier, old_df):
+    def one(cur_g, phase_g, scale_g, of_g, op_g, os_g, n):
+        delta, fourier = _gwb_delta(phase_g, scale_g, coeffs, n, inv_sqrt_df, df)
+        old = fourier_ops.reconstruct_old_padded(op_g, os_g, of_g, old_df)
+        return cur_g + (delta - old)[: cur_g.shape[0]], fourier
+    return jax.vmap(one, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+        cur, phase, scale, old_fourier, old_phase, old_scale,
+        jnp.arange(cur.shape[0]))
+
+
+def _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf, coeffs,
+                       inv_sqrt_df):
+    """Whole-array GWB injection as ONE kernel, when shapes are uniform.
+
+    Returns the per-pulsar stored-fourier values (lazy rows of one device
+    block) after updating every pulsar's residuals — or None when the array
+    is not uniformly bucketed (ragged TOA counts, mixed re-injection state,
+    joint-covariance entries), in which case the caller falls back to the
+    per-pulsar fused kernels. Residual updates and stored coefficients are
+    handed out as zero-op _LazyRow views; nothing synchronizes.
+    """
+    from .fake_pta import _LazyRow, _RowBlock, _as_device
+
+    if len({len(p.toas) for p in psrs}) != 1:
+        return None
+    olds = [p.signal_model.get(signal_name) for p in psrs]
+    if any(o is not None and "fourier" not in o for o in olds):
+        return None                      # joint-covariance entries: slow path
+    has_old = [o is not None for o in olds]
+    if any(has_old) and not all(has_old):
+        return None
+
+    tables = [p._padded_phase_scale(f_psd, idx, freqf, None) for p in psrs]
+    phase = np.stack([t[0] for t in tables])
+    scale = np.stack([t[1] for t in tables])
+    df_pad = tables[0][2]
+
+    def stack_rows(vals):
+        if all(isinstance(v, _LazyRow) for v in vals):
+            b = vals[0].block
+            if (b.dev.shape[0] == len(vals)
+                    and all(v.block is b and v.g == g
+                            for g, v in enumerate(vals))):
+                return b.dev             # shared block, zero device ops
+        return jnp.stack([_as_device(v) if isinstance(v, _LazyRow)
+                          else jnp.asarray(v) for v in vals])
+
+    cur = stack_rows([p._res_dev if p._res_dev is not None else p._res_host
+                      for p in psrs])
+    if all(has_old):
+        o0 = olds[0]
+        old_f = np.asarray(o0["f"], dtype=np.float64)
+        if not all(np.array_equal(np.asarray(o["f"], dtype=np.float64), old_f)
+                   and o["idx"] == o0["idx"]
+                   and o.get("freqf", 1400.0) == o0.get("freqf", 1400.0)
+                   and np.shape(o["fourier"]) == np.shape(o0["fourier"])
+                   for o in olds):
+            return None
+        old_tabs = [p._padded_phase_scale(old_f, o0["idx"],
+                                          o0.get("freqf", 1400.0), None)
+                    for p in psrs]
+        old_four = stack_rows([o["fourier"] for o in olds])
+        new_stack, four_stack = _k_gwb_reinject_acc_batched(
+            cur, phase, scale, coeffs, inv_sqrt_df, df_pad,
+            np.stack([t[0] for t in old_tabs]),
+            np.stack([t[1] for t in old_tabs]), old_four, old_tabs[0][2])
+    else:
+        new_stack, four_stack = _k_gwb_inject_acc_batched(
+            cur, phase, scale, coeffs, inv_sqrt_df, df_pad)
+
+    holder, fholder = _RowBlock(new_stack), _RowBlock(four_stack)
+    for g, p in enumerate(psrs):
+        p.residuals = _LazyRow(holder, g)
+    return [_LazyRow(fholder, g) for g in range(len(psrs))]
+
+
 def _array_tspan(psrs):
     return (max(psr.toas.max() for psr in psrs)
             - min(psr.toas.min() for psr in psrs))
@@ -214,35 +304,45 @@ def add_common_correlated_noise(psrs, orf="hd", spectrum="powerlaw", name="gw",
     coeffs = _k_gwb_draw(key, folds, chol, psd_gwb)
     inv_sqrt_df = 1.0 / np.sqrt(df)
 
+    psrs = list(psrs)
+    four_vals = _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf,
+                                   coeffs, inv_sqrt_df)
+    if four_vals is None:
+        # non-uniform array: per-pulsar fused kernels (one dispatch each)
+        from .fake_pta import _as_device
+        four_vals = []
+        for n, psr in enumerate(psrs):
+            old = psr.signal_model.get(signal_name)
+            if old is not None and "fourier" not in old:
+                # joint-covariance entries store the realization itself
+                psr._accumulate(-psr._reconstruct_signal_dev([signal_name]))
+                old = None
+            phase, scale, df_pad, ntoa, nbin = psr._padded_phase_scale(
+                f_psd, idx, freqf, None)
+            cur = psr._res_current()
+            if old is None:
+                new, fourier = _k_gwb_inject_acc(
+                    cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad)
+            else:
+                # the OLD entry's stored freqf/idx scaling reconstructs what
+                # was actually injected, whatever this call's scaling is
+                old_f = np.asarray(old["f"], dtype=np.float64)
+                old_phase, old_scale, old_df, _, _ = psr._padded_phase_scale(
+                    old_f, old["idx"], old.get("freqf", 1400.0), None)
+                new, fourier = _k_gwb_reinject_acc(
+                    cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad,
+                    old_phase, old_scale, _as_device(old["fourier"]), old_df)
+            psr.residuals = new
+            four_vals.append(fourier)
+
     for n, psr in enumerate(psrs):
-        old = psr.signal_model.get(signal_name)
-        if old is not None and "fourier" not in old:
-            # joint-covariance entries store the realization itself (rare path)
-            psr._accumulate(-psr._reconstruct_signal_dev([signal_name]))
-            old = None
-        phase, scale, df_pad, ntoa, nbin = psr._padded_phase_scale(
-            f_psd, idx, freqf, None)
-        cur = psr._res_current()
-        if old is None:
-            new, fourier = _k_gwb_inject_acc(
-                cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad)
-        else:
-            # the OLD entry's stored freqf/idx scaling reconstructs what was
-            # actually injected, whatever this call's scaling is
-            old_f = np.asarray(old["f"], dtype=np.float64)
-            old_phase, old_scale, old_df, _, _ = psr._padded_phase_scale(
-                old_f, old["idx"], old.get("freqf", 1400.0), None)
-            new, fourier = _k_gwb_reinject_acc(
-                cur, phase, scale, coeffs, n, inv_sqrt_df, df_pad,
-                old_phase, old_scale, old["fourier"], old_df)
-        psr.residuals = new
         psr.signal_model[signal_name] = {
             "orf": orf,
             "spectrum": spectrum,
             "hmap": h_map,
             "f": f_psd,
             "psd": psd_gwb,
-            "fourier": fourier,
+            "fourier": four_vals[n],
             "nbin": components,
             "idx": idx,
             "freqf": freqf,

@@ -121,9 +121,103 @@ def _k_scatter_add(a, idx, delta):
     return jnp.asarray(a).at[idx].add(delta)
 
 
+class _RowBlock:
+    """A batched (G, ...) device result shared by G pulsars.
+
+    Array-level injections compute every pulsar's result in ONE kernel; rows
+    are handed out as :class:`_LazyRow` views so the scatter-back costs zero
+    device ops. The host copy is materialized once for the whole block on the
+    first row read (one transfer, shared by all rows).
+    """
+
+    __slots__ = ("dev", "_host")
+
+    def __init__(self, dev):
+        self.dev = dev
+        self._host = None
+
+    def host(self):
+        if self._host is None:
+            self._host = np.asarray(self.dev)
+        return self._host
+
+
+class _LazyRow:
+    """One row of a :class:`_RowBlock`: device view on demand, host via numpy.
+
+    ``np.asarray(row)`` materializes the whole parent block once and shares it;
+    ``row.device()`` is a cheap device slice (one op, paid only if this pulsar
+    is individually touched again).
+    """
+
+    __slots__ = ("block", "g")
+
+    def __init__(self, block, g):
+        self.block = block
+        self.g = g
+
+    def device(self):
+        return self.block.dev[self.g]
+
+    def __array__(self, dtype=None, copy=None):
+        out = np.asarray(self.block.host()[self.g])
+        if dtype is not None:
+            out = out.astype(dtype, copy=False)
+        if copy:
+            out = np.array(out)
+        return out
+
+    # array-like surface (no device sync): signal_model consumers inspect
+    # shapes/dtypes; indexing and arithmetic materialize the host row
+    @property
+    def shape(self):
+        return tuple(self.block.dev.shape[1:])
+
+    @property
+    def dtype(self):
+        return self.block.dev.dtype
+
+    @property
+    def ndim(self):
+        return self.block.dev.ndim - 1
+
+    def __len__(self):
+        return self.block.dev.shape[1]
+
+    def __getitem__(self, item):
+        return np.asarray(self)[item]
+
+    def __mul__(self, other):
+        return np.asarray(self) * other
+
+    __rmul__ = __mul__
+
+    def __add__(self, other):
+        return np.asarray(self) + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return np.asarray(self) - other
+
+    def __rsub__(self, other):
+        return other - np.asarray(self)
+
+    def __neg__(self):
+        return -np.asarray(self)
+
+    def __repr__(self):
+        return f"_LazyRow(shape={self.shape}, dtype={self.dtype})"
+
+
+def _as_device(arr):
+    """Unwrap a _LazyRow to its device row; pass real arrays through."""
+    return arr.device() if isinstance(arr, _LazyRow) else arr
+
+
 def _host_tree(obj):
     """Recursively materialize device arrays to host numpy (pickle contract)."""
-    if isinstance(obj, jax.Array):
+    if isinstance(obj, (jax.Array, _LazyRow)):
         return np.asarray(obj)
     if isinstance(obj, dict):
         return {k: _host_tree(v) for k, v in obj.items()}
@@ -238,7 +332,10 @@ class Pulsar:
 
     @residuals.setter
     def residuals(self, value):
-        if isinstance(value, jax.Array):
+        if isinstance(value, (jax.Array, _LazyRow)):
+            # a _LazyRow (array-level injections) stays lazy until someone
+            # needs this pulsar individually: host reads share the parent
+            # block's single transfer, device use pays one slice op
             self._res_dev = value
             self._res_host = None
         else:
@@ -247,6 +344,8 @@ class Pulsar:
 
     def _res_current(self):
         """Whichever residual buffer is authoritative, without forcing a sync."""
+        if isinstance(self._res_dev, _LazyRow):
+            self._res_dev = self._res_dev.device()
         return self._res_dev if self._res_dev is not None else self._res_host
 
     def _accumulate(self, delta, idx=None):
@@ -685,12 +784,12 @@ class Pulsar:
             if mask is None:
                 new, fourier = _k_gp_reinject_acc(
                     cur, phase, scale, psd_pad, df_pad, key, folds,
-                    old_phase, old_scale, _subtract["fourier"], old_df,
+                    old_phase, old_scale, _as_device(_subtract["fourier"]), old_df,
                     nbin=nbin)
             else:
                 new, fourier = _k_gp_reinject_scatter(
                     cur, np.flatnonzero(mask), phase, scale, psd_pad, df_pad,
-                    key, folds, old_phase, old_scale, _subtract["fourier"],
+                    key, folds, old_phase, old_scale, _as_device(_subtract["fourier"]),
                     old_df, nbin=nbin)
         self.residuals = new
 
@@ -895,7 +994,7 @@ class Pulsar:
         f_psd = np.asarray(entry["f"], dtype=np.float64)
         phase, scale, df_pad, ntoa, nbin = self._padded_phase_scale(
             f_psd, entry["idx"], freqf, mask)
-        four = jnp.pad(jnp.asarray(entry["fourier"]),
+        four = jnp.pad(jnp.asarray(_as_device(entry["fourier"])),
                        ((0, 0), (0, len(df_pad) - nbin)))
         out = _k_reconstruct(phase, scale, four, df_pad)
         return out[:ntoa]
