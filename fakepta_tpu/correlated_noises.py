@@ -194,15 +194,12 @@ def _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf, coeffs,
     per-pulsar fused kernels. Residual updates and stored coefficients are
     handed out as zero-op _LazyRow views; nothing synchronizes.
     """
-    from .fake_pta import _LazyRow, _RowBlock, _as_device
+    from .fake_pta import (_LazyRow, _RowBlock, _batchable_olds, _stack_rows)
 
     if len({len(p.toas) for p in psrs}) != 1:
         return None
-    olds = [p.signal_model.get(signal_name) for p in psrs]
-    if any(o is not None and "fourier" not in o for o in olds):
-        return None                      # joint-covariance entries: slow path
-    has_old = [o is not None for o in olds]
-    if any(has_old) and not all(has_old):
+    olds = _batchable_olds(psrs, signal_name)
+    if olds is None:
         return None
 
     tables = [p._padded_phase_scale(f_psd, idx, freqf, None) for p in psrs]
@@ -210,31 +207,15 @@ def _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf, coeffs,
     scale = np.stack([t[1] for t in tables])
     df_pad = tables[0][2]
 
-    def stack_rows(vals):
-        if all(isinstance(v, _LazyRow) for v in vals):
-            b = vals[0].block
-            if (b.dev.shape[0] == len(vals)
-                    and all(v.block is b and v.g == g
-                            for g, v in enumerate(vals))):
-                return b.dev             # shared block, zero device ops
-        return jnp.stack([_as_device(v) if isinstance(v, _LazyRow)
-                          else jnp.asarray(v) for v in vals])
-
-    cur = stack_rows([p._res_dev if p._res_dev is not None else p._res_host
-                      for p in psrs])
-    if all(has_old):
+    cur = _stack_rows([p._res_dev if p._res_dev is not None else p._res_host
+                       for p in psrs])
+    if olds:
         o0 = olds[0]
         old_f = np.asarray(o0["f"], dtype=np.float64)
-        if not all(np.array_equal(np.asarray(o["f"], dtype=np.float64), old_f)
-                   and o["idx"] == o0["idx"]
-                   and o.get("freqf", 1400.0) == o0.get("freqf", 1400.0)
-                   and np.shape(o["fourier"]) == np.shape(o0["fourier"])
-                   for o in olds):
-            return None
         old_tabs = [p._padded_phase_scale(old_f, o0["idx"],
                                           o0.get("freqf", 1400.0), None)
                     for p in psrs]
-        old_four = stack_rows([o["fourier"] for o in olds])
+        old_four = _stack_rows([o["fourier"] for o in olds])
         new_stack, four_stack = _k_gwb_reinject_acc_batched(
             cur, phase, scale, coeffs, inv_sqrt_df, df_pad,
             np.stack([t[0] for t in old_tabs]),

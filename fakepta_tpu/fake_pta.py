@@ -93,6 +93,31 @@ def _k_gp_reinject_scatter(cur, idx, phase, scale, psd, df, key, folds,
     return new, fourier[:, :nbin]
 
 
+# Batched variants for uniformly-bucketed arrays (add_noise_array): the whole
+# array's draws, re-injection subtraction and accumulation are ONE kernel over
+# stacked per-pulsar tables; results scatter back as zero-op _LazyRow views.
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_inject_acc_batched(cur, phase, scale, psd, df, keys, folds, nbin):
+    def one(cur_g, phase_g, scale_g, psd_g, key_g, folds_g):
+        delta, fourier = _gp_draw_delta(phase_g, scale_g, psd_g, df, key_g,
+                                        folds_g)
+        return cur_g + delta[: cur_g.shape[0]], fourier[:, :nbin]
+    return jax.vmap(one)(cur, phase, scale, psd, keys, folds)
+
+
+@partial(jax.jit, static_argnames=("nbin",))
+def _k_gp_reinject_acc_batched(cur, phase, scale, psd, df, keys, folds,
+                               old_phase, old_scale, old_fourier, old_df, nbin):
+    def one(cur_g, phase_g, scale_g, psd_g, key_g, folds_g, op_g, os_g, of_g):
+        delta, fourier = _gp_draw_delta(phase_g, scale_g, psd_g, df, key_g,
+                                        folds_g)
+        old = fourier_ops.reconstruct_old_padded(op_g, os_g, of_g, old_df)
+        return cur_g + (delta - old)[: cur_g.shape[0]], fourier[:, :nbin]
+    return jax.vmap(one)(cur, phase, scale, psd, keys, folds,
+                         old_phase, old_scale, old_fourier)
+
+
 @jax.jit
 def _k_white_acc(cur, key, folds, toaerrs, efac, equad):
     k = rng_utils.fold_key_in_kernel(key, folds)
@@ -213,6 +238,49 @@ class _LazyRow:
 def _as_device(arr):
     """Unwrap a _LazyRow to its device row; pass real arrays through."""
     return arr.device() if isinstance(arr, _LazyRow) else arr
+
+
+def _stack_rows(vals):
+    """Stack per-pulsar values into a (G, ...) device block, cheaply.
+
+    When every value is a _LazyRow of the same block in row order — i.e. they
+    came from a previous batched injection — the parent block is reused with
+    zero device ops. Otherwise one jnp.stack dispatch.
+    """
+    if all(isinstance(v, _LazyRow) for v in vals):
+        b = vals[0].block
+        if (b.dev.shape[0] == len(vals)
+                and all(v.block is b and v.g == g for g, v in enumerate(vals))):
+            return b.dev
+    return jnp.stack([_as_device(v) if isinstance(v, _LazyRow)
+                      else jnp.asarray(v) for v in vals])
+
+
+def _batchable_olds(psrs, name):
+    """Stored `name` entries if uniformly batchable for re-injection.
+
+    Returns ``[]`` when no pulsar has the entry (fresh injection), the list of
+    entries when all do with identical (f, idx, freqf, fourier-shape), or
+    ``None`` when the state is mixed or holds joint-covariance entries — the
+    caller then falls back to the per-pulsar fused path.
+    """
+    olds = [p.signal_model.get(name) for p in psrs]
+    if any(o is not None and "fourier" not in o for o in olds):
+        return None
+    has = [o is not None for o in olds]
+    if not any(has):
+        return []
+    if not all(has):
+        return None
+    o0 = olds[0]
+    f0 = np.asarray(o0["f"], dtype=np.float64)
+    if all(np.array_equal(np.asarray(o["f"], dtype=np.float64), f0)
+           and o["idx"] == o0["idx"]
+           and o.get("freqf", 1400.0) == o0.get("freqf", 1400.0)
+           and np.shape(o["fourier"]) == np.shape(o0["fourier"])
+           for o in olds):
+        return olds
+    return None
 
 
 def _host_tree(obj):
@@ -646,7 +714,10 @@ class Pulsar:
     def _resolve_psd(self, signal, spectrum, f_psd, kwargs):
         """Shared PSD resolution for the GP injectors (ref ``fake_pta.py:269-279``)."""
         if spectrum == "custom":
-            return np.asarray(kwargs["custom_psd"], dtype=np.float64), {}
+            custom = kwargs["custom_psd"]
+            if isinstance(custom, jax.Array):
+                return custom, {}      # stays on device — no forced host sync
+            return np.asarray(custom, dtype=np.float64), {}
         if spectrum not in spectrum_lib.SPECTRA:
             raise KeyError(f"unknown spectrum {spectrum!r}")
         if not kwargs:
@@ -1146,6 +1217,131 @@ def make_fake_array(npsrs=25, Tobs=None, ntoas=None, gaps=True, toaerr=None,
                       log10_A=host.uniform(-17.0, -13.0), gamma=host.uniform(1.0, 5.0))
         psrs.append(psr)
     return psrs
+
+
+_GP_ARRAY_SIGNALS = {
+    "red_noise": ("RN", 0.0, "add_red_noise"),
+    "dm_gp": ("DM", 2.0, "add_dm_noise"),
+    "chrom_gp": ("Sv", 4.0, "add_chromatic_noise"),
+}
+
+
+def add_noise_array(psrs, signal="red_noise", spectrum="powerlaw", f_psd=None,
+                    seed=None, **kwargs):
+    """Inject per-pulsar-independent GP noise across a whole array in one kernel.
+
+    Array-level counterpart of ``add_red_noise`` / ``add_dm_noise`` /
+    ``add_chromatic_noise`` — a TPU-first extension; the reference can only
+    loop pulsars (``examples/make_fake_array.py:41-45``). Per-pulsar semantics
+    are identical: independent draws, per-pulsar noisedict hyperparameter
+    resolution when no kwargs are given, re-injection subtracts the prior
+    realization. A uniformly-bucketed array (same TOA count, Tspan and bin
+    count — fabricated arrays and replayed datasets) pays ~2 device dispatches
+    total instead of several per pulsar; ragged arrays transparently fall back
+    to the per-pulsar fused path.
+
+    Seeding: with ``seed=None`` each pulsar consumes its own key stream, so the
+    draws are the SAME coefficients a per-pulsar loop would produce (residuals
+    agree to float32 round-off; the batched projection reduces in a different
+    order). With an explicit ``seed``, pulsar ``g`` draws from
+    ``fold_in(key(seed), g)`` — the per-pulsar methods would hand every pulsar
+    the *same* key (and therefore identical draws), which is never what an
+    array injection wants.
+    """
+    psrs = list(psrs)
+    if signal not in _GP_ARRAY_SIGNALS:
+        raise KeyError(f"signal must be one of {sorted(_GP_ARRAY_SIGNALS)}, "
+                       f"got {signal!r}")
+    model_key, idx, method = _GP_ARRAY_SIGNALS[signal]
+    if not psrs:
+        return
+
+    def fallback():
+        for g, p in enumerate(psrs):
+            s = None if seed is None else rng_utils.fold(rng_utils.as_key(seed), g)
+            getattr(p, method)(spectrum=spectrum, f_psd=f_psd, seed=s, **kwargs)
+
+    comps = {p.custom_model.get(model_key) for p in psrs}
+    if len(comps) != 1:
+        return fallback()
+    ncomp = comps.pop()
+    if ncomp is None:
+        return          # disabled for the whole array (per-pulsar parity)
+    if len({len(p.toas) for p in psrs}) != 1:
+        return fallback()
+    if f_psd is None:
+        if len({float(p.Tspan) for p in psrs}) != 1:
+            return fallback()
+        f_shared = np.arange(1, ncomp + 1) / psrs[0].Tspan
+    else:
+        f_shared = np.asarray(f_psd, dtype=np.float64)
+    olds = _batchable_olds(psrs, signal)
+    if olds is None:
+        return fallback()
+
+    # resolve + validate every pulsar BEFORE any state mutation
+    resolved_list, psd_rows = [], []
+    for p in psrs:
+        psd, resolved = p._resolve_psd(signal, spectrum, f_shared, dict(kwargs))
+        if len(psd) != len(f_shared):
+            raise ValueError('"psd" and "f_psd" must have the same length')
+        psd_rows.append(psd)
+        resolved_list.append(resolved)
+
+    tables = [p._padded_phase_scale(f_shared, idx, 1400.0, None) for p in psrs]
+    phase = np.stack([t[0] for t in tables])
+    scale = np.stack([t[1] for t in tables])
+    df_pad = tables[0][2]
+    nbin = tables[0][4]
+    if any(isinstance(r, jax.Array) for r in psd_rows):
+        # device-resident custom PSDs stay on device: stack + pad is two ops,
+        # not one host sync per pulsar
+        stack = jnp.stack([jnp.asarray(r) for r in psd_rows])
+        psd_pad = jnp.pad(stack, ((0, 0), (0, len(df_pad) - stack.shape[1])))
+    else:
+        psd_pad = np.stack([pad_1d(np.asarray(r, dtype=np.float64),
+                                   len(df_pad)) for r in psd_rows])
+    cur = _stack_rows([p._res_dev if p._res_dev is not None else p._res_host
+                       for p in psrs])
+    if seed is None:
+        pairs = [p._keys.next_spec(signal) for p in psrs]
+        keys = jnp.stack([k for k, _ in pairs])
+        folds = np.stack([f for _, f in pairs])
+    else:
+        base = rng_utils.as_key(seed)
+        keys = jnp.stack([base] * len(psrs))
+        folds = np.arange(len(psrs), dtype=np.uint32)[:, None]
+
+    if olds:
+        o0 = olds[0]
+        old_f = np.asarray(o0["f"], dtype=np.float64)
+        old_tabs = [p._padded_phase_scale(old_f, o0["idx"],
+                                          o0.get("freqf", 1400.0), None)
+                    for p in psrs]
+        old_four = _stack_rows([o["fourier"] for o in olds])
+        new_stack, four_stack = _k_gp_reinject_acc_batched(
+            cur, phase, scale, psd_pad, df_pad, keys, folds,
+            np.stack([t[0] for t in old_tabs]),
+            np.stack([t[1] for t in old_tabs]), old_four, old_tabs[0][2],
+            nbin=nbin)
+    else:
+        new_stack, four_stack = _k_gp_inject_acc_batched(
+            cur, phase, scale, psd_pad, df_pad, keys, folds, nbin=nbin)
+
+    holder, fholder = _RowBlock(new_stack), _RowBlock(four_stack)
+    for g, p in enumerate(psrs):
+        if resolved_list[g]:
+            p.update_noisedict(f"{p.name}_{signal}", resolved_list[g])
+        p.residuals = _LazyRow(holder, g)
+        p.signal_model[signal] = {
+            "spectrum": spectrum,
+            "f": f_shared,
+            "psd": psd_rows[g],
+            "fourier": _LazyRow(fholder, g),
+            "nbin": nbin,
+            "idx": idx,
+            "freqf": 1400,
+        }
 
 
 def plot_pta(psrs, plot_name=True, show=True):

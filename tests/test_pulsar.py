@@ -352,3 +352,60 @@ def test_package_exposes_reference_layout():
 
     assert hasattr(fakepta_tpu, "fake_pta")
     assert fakepta_tpu.fake_pta.Pulsar is Pulsar
+
+
+def test_add_noise_array_matches_per_pulsar_loop():
+    """Batched array injection draws the same coefficients each pulsar's own
+    stream would produce in a loop (float32 round-off on the projection)."""
+    from fakepta_tpu.fake_pta import add_noise_array
+
+    toas = np.linspace(0, 10 * const.yr, 120)
+    mk = lambda: [Pulsar(toas, 1e-7, 1.0 + 0.1 * k, 0.3 * k + 0.2, seed=10 + k)
+                  for k in range(5)]
+    a, b = mk(), mk()
+    add_noise_array(a, signal="red_noise", spectrum="powerlaw",
+                    log10_A=-14.0, gamma=3.0)
+    for p in b:
+        p.add_red_noise(spectrum="powerlaw", log10_A=-14.0, gamma=3.0)
+    for pa, pb in zip(a, b):
+        da, db = np.asarray(pa.residuals), np.asarray(pb.residuals)
+        assert np.abs(da - db).max() < 1e-6 * np.abs(db).max()
+        np.testing.assert_allclose(
+            np.asarray(pa.signal_model["red_noise"]["fourier"]),
+            np.asarray(pb.signal_model["red_noise"]["fourier"]), rtol=1e-6)
+
+
+def test_add_noise_array_reinjection_and_ragged_fallback():
+    from fakepta_tpu.fake_pta import add_noise_array
+
+    toas = np.linspace(0, 10 * const.yr, 120)
+    psrs = [Pulsar(toas, 1e-7, 1.0 + 0.1 * k, 0.2 * k, seed=k) for k in range(4)]
+    psrs[2] = Pulsar(np.linspace(0, 10 * const.yr, 90), 1e-7, 1.2, 0.4, seed=9)
+    for seed in (3, 4):           # ragged: per-pulsar fallback, then re-inject
+        add_noise_array(psrs, signal="red_noise", spectrum="powerlaw",
+                        log10_A=-14.0, gamma=3.0, seed=seed)
+    uniform = [Pulsar(toas, 1e-7, 1.0 + 0.1 * k, 0.2 * k, seed=k)
+               for k in range(4)]
+    for seed in (3, 4):           # uniform: batched, then batched re-inject
+        add_noise_array(uniform, signal="red_noise", spectrum="powerlaw",
+                        log10_A=-14.0, gamma=3.0, seed=seed)
+    for p in psrs + uniform:
+        rec = p.reconstruct_signal(["red_noise"])
+        res = np.asarray(p.residuals)
+        assert np.abs(rec - res).max() < 1e-5 * np.abs(res).max()
+    # explicit seed folds by array index: draws differ across pulsars
+    r0 = np.asarray(uniform[0].residuals)
+    r1 = np.asarray(uniform[1].residuals)
+    assert not np.allclose(r0, r1)
+
+
+def test_add_noise_array_respects_disabled_model():
+    from fakepta_tpu.fake_pta import add_noise_array
+
+    toas = np.linspace(0, 10 * const.yr, 64)
+    psrs = [Pulsar(toas, 1e-7, 1.0, 0.3, seed=0,
+                   custom_model={"RN": 4, "DM": None, "Sv": None})]
+    add_noise_array(psrs, signal="dm_gp", spectrum="powerlaw",
+                    log10_A=-13.5, gamma=3.0, seed=1)
+    assert "dm_gp" not in psrs[0].signal_model
+    assert np.all(np.asarray(psrs[0].residuals) == 0.0)
