@@ -1,0 +1,109 @@
+"""Null-vs-injected GWB detection statistic over Monte-Carlo ensembles.
+
+The point of simulating PTA datasets (the reference's use case; BASELINE.md
+config 5 is literally "null vs injected") is calibrating detection statistics:
+how well does an angular-correlation statistic separate an array WITH an
+HD-correlated background from one with uncorrelated noise only?
+
+This script runs both ensembles through the sharded device engine
+(:class:`fakepta_tpu.parallel.montecarlo.EnsembleSimulator`), projects each
+realization's binned correlation curve onto the Hellings-Downs template
+(a matched-filter statistic), and reports the separation of the two
+distributions:
+
+    python examples/detection_statistic.py                  # defaults
+    python examples/detection_statistic.py --npsr 100 --nreal 10000
+    python examples/detection_statistic.py --platform cpu   # no TPU needed
+
+Prints one JSON line with the two distribution summaries and the detection
+significance (mean shift of the injected distribution in units of the null's
+standard deviation), plus the false-alarm/detection rates at the null's 95th
+percentile.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def hd_template(bin_centers):
+    """Hellings-Downs curve on the statistic's angular bins (ref :62-71)."""
+    x = (1.0 - np.cos(bin_centers)) / 2.0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        hd = 1.5 * x * np.log(x) - 0.25 * x + 0.5
+    return np.where(x > 0, hd, 0.5)
+
+
+def matched_filter(curves, autos, centers):
+    """Project each realization's binned curve onto the HD template.
+
+    ``curves`` are raw binned pair correlations (seconds^2); normalizing by the
+    ensemble-mean autocorrelation makes the statistic dimensionless and
+    comparable between null and injected runs.
+    """
+    t = hd_template(centers)
+    t = t / np.linalg.norm(t)
+    return (curves @ t) / np.maximum(autos.mean(), 1e-300)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npsr", type=int, default=40)
+    ap.add_argument("--ntoa", type=int, default=260)
+    ap.add_argument("--nreal", type=int, default=2000)
+    ap.add_argument("--chunk", type=int, default=1000)
+    # default amplitude gives a visible separation (~2 sigma at 40 psr/1k
+    # realizations); the astrophysically-favored 2e-15 needs the full
+    # noise-weighted optimal statistic (or a much bigger array) to stand out
+    ap.add_argument("--log10-A", type=float, default=-14.0)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
+                                  tspan_years=15.0, toaerr=1e-7,
+                                  n_red=30, n_dm=30, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=args.log10_A,
+                                           gamma=13 / 3))
+    mesh = make_mesh(jax.devices())
+
+    runs = {}
+    for name, gwb in (("null", None), ("injected", GWBConfig(psd=psd, orf="hd"))):
+        include = ("white", "red", "dm") + (("gwb",) if gwb else ())
+        sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh)
+        out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk)
+        runs[name] = matched_filter(out["curves"], out["autos"],
+                                    out["bin_centers"])
+
+    null, inj = runs["null"], runs["injected"]
+    thresh = float(np.percentile(null, 95.0))
+    significance = float((inj.mean() - null.mean()) / max(null.std(), 1e-300))
+    print(json.dumps({
+        "npsr": args.npsr, "nreal": args.nreal,
+        "log10_A": round(args.log10_A, 3),
+        "null_mean": float(null.mean()), "null_std": float(null.std()),
+        "injected_mean": float(inj.mean()), "injected_std": float(inj.std()),
+        "detection_significance_sigma": round(significance, 2),
+        "null_95pct_threshold": thresh,
+        "detection_rate_at_5pct_false_alarm": round(
+            float((inj > thresh).mean()), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -12,13 +12,18 @@ REPO = Path(__file__).resolve().parents[1]
 EXAMPLES = REPO / "examples"
 
 
-def _run(args, tmp_path):
-    out = tmp_path / "out.pkl"
+def _repo_env():
     # The package is not necessarily pip-installed (fresh checkout): put the
     # repo root on the subprocess's PYTHONPATH so `import fakepta_tpu` resolves.
     env = dict(os.environ)
     env["PYTHONPATH"] = str(REPO) + (
         os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    return env
+
+
+def _run(args, tmp_path):
+    out = tmp_path / "out.pkl"
+    env = _repo_env()
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / "make_fake_array.py"), *args,
          "--platform", "cpu", "--out", str(out)],
@@ -63,3 +68,18 @@ def test_example_data_schema():
     for entry in models.values():
         assert set(entry) == {"RN", "DM", "Sv"}
         assert all(v is None or isinstance(v, int) for v in entry.values())
+
+
+def test_detection_statistic_example_runs(tmp_path):
+    """Null-vs-injected example: runs as shipped, prints valid JSON, and the
+    injected distribution sits above the null."""
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / "detection_statistic.py"),
+         "--platform", "cpu", "--npsr", "12", "--ntoa", "96",
+         "--nreal", "200", "--chunk", "100", "--log10-A", "-13.5"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["detection_significance_sigma"] > 1.0
+    assert 0.0 <= row["detection_rate_at_5pct_false_alarm"] <= 1.0
