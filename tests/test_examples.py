@@ -76,7 +76,7 @@ def test_detection_statistic_example_runs(tmp_path):
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / "detection_statistic.py"),
          "--platform", "cpu", "--npsr", "12", "--ntoa", "96",
-         "--nreal", "200", "--chunk", "100", "--log10-A", "-13.5"],
+         "--nreal", "200", "--chunk", "100", "--log10-A", "-13.0"],
         capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
         env=_repo_env())
     assert proc.returncode == 0, proc.stderr[-2000:]

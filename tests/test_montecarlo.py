@@ -419,3 +419,53 @@ def test_system_noise_band_masked_and_scaled():
     want = float((np.asarray(batch.sys_psd)[0, 0]
                   * np.asarray(batch.df_own)[0]).sum()) * frac
     np.testing.assert_allclose(auto, want, rtol=0.25)
+
+
+def test_ensemble_gwb_mean_curve_matches_analytic_amplitude(small_batch):
+    """Quantitative oracle: for a GWB-only ensemble the mean binned correlation
+    must equal bin-mean(ORF) * sum(psd * df) in absolute amplitude (the
+    normalized common basis has unit mean power per component), not merely
+    correlate with the HD shape."""
+    cfg = _gwb_cfg(small_batch, log10_A=-13.0)
+    sim = EnsembleSimulator(small_batch, gwb=cfg, include=("gwb",),
+                            mesh=make_mesh(jax.devices()[:1]), nbins=8)
+    nreal = 1500
+    out = sim.run(nreal, seed=23, chunk=500)
+
+    pos = np.asarray(small_batch.pos, dtype=np.float64)
+    x = (1 - np.clip(pos @ pos.T, -1, 1)) / 2
+    with np.errstate(divide="ignore", invalid="ignore"):
+        orf = np.where(x > 0, 1.5 * x * np.log(x) - 0.25 * x + 0.5, 1.0)
+    edges = np.linspace(0, np.pi, 9)
+    ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
+    bins = np.clip(np.digitize(ang, edges) - 1, 0, 7)
+    off = ~np.eye(small_batch.npsr, dtype=bool)
+
+    tspan = float(small_batch.tspan_common)
+    f = np.arange(1, 9) / tspan
+    df = np.diff(np.concatenate([[0.0], f]))
+    total_power = float((np.asarray(cfg.psd) * df).sum())
+
+    mean = out["curves"].mean(0)
+    sem = out["curves"].std(0) / np.sqrt(nreal)
+    for n in range(8):
+        m = off & (bins == n)
+        if not m.any():
+            continue
+        want = orf[m].mean() * total_power
+        assert abs(mean[n] - want) < 5 * sem[n] + 0.02 * abs(want) + 1e-18, \
+            (n, mean[n], want, sem[n])
+    # autos: mean autocorrelation = total GP power (ORF diagonal = 1)
+    np.testing.assert_allclose(out["autos"].mean(), total_power, rtol=0.1)
+
+
+def test_ensemble_white_autos_match_sigma2(small_batch):
+    """White-only ensemble: mean autocorrelation equals the mean per-TOA
+    variance (exact oracle, no shape proxy)."""
+    sim = EnsembleSimulator(small_batch, gwb=None, include=("white",),
+                            mesh=make_mesh(jax.devices()[:1]))
+    out = sim.run(800, seed=29, chunk=400)
+    sigma2 = np.asarray(small_batch.sigma2)
+    mask = np.asarray(small_batch.mask)
+    want = float(sigma2[mask].mean())
+    np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.05)
