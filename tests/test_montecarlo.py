@@ -469,3 +469,54 @@ def test_ensemble_white_autos_match_sigma2(small_batch):
     mask = np.asarray(small_batch.mask)
     want = float(sigma2[mask].mean())
     np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.05)
+
+
+def test_optimal_statistic_calibration(small_batch):
+    """Null SNR must be ~N(0,1) when pair counts are supplied, and the
+    injected-ensemble amplitude estimate must recover sum(psd*df)."""
+    from fakepta_tpu.correlated_noises import optimal_statistic
+
+    mask = np.asarray(small_batch.mask, dtype=np.float64)
+    counts = mask @ mask.T
+    pos = np.asarray(small_batch.pos)
+    cfg = _gwb_cfg(small_batch, log10_A=-13.0)
+
+    mesh = make_mesh(jax.devices()[:1])
+    null = EnsembleSimulator(small_batch, gwb=None, include=("white",),
+                             mesh=mesh).run(600, seed=31, chunk=300,
+                                            keep_corr=True)
+    os_null = optimal_statistic(null["corr"], pos, counts=counts)
+    assert abs(os_null["snr"].mean()) < 0.2
+    assert 0.6 < os_null["snr"].std() < 1.5
+
+    inj = EnsembleSimulator(small_batch, gwb=cfg, include=("gwb",),
+                            mesh=mesh).run(600, seed=37, chunk=300,
+                                           keep_corr=True)
+    tspan = float(small_batch.tspan_common)
+    f = np.arange(1, 9) / tspan
+    df = np.diff(np.concatenate([[0.0], f]))
+    total_power = float((np.asarray(cfg.psd) * df).sum())
+    os_inj = optimal_statistic(inj["corr"], pos, counts=counts)
+    np.testing.assert_allclose(os_inj["amp2"].mean(), total_power, rtol=0.2)
+    # single-matrix input works too
+    one = optimal_statistic(inj["corr"][0], pos, counts=counts)
+    assert one["amp2"].shape == (1,)
+
+
+def test_optimal_statistic_rejects_diagonal_orf_and_drops_empty_pairs():
+    from fakepta_tpu.correlated_noises import optimal_statistic
+
+    rng = np.random.default_rng(0)
+    pos = rng.standard_normal((4, 3))
+    pos /= np.linalg.norm(pos, axis=1, keepdims=True)
+    corr = rng.standard_normal((3, 4, 4))
+    with pytest.raises(ValueError, match="no weighted cross-correlation"):
+        optimal_statistic(corr, pos, orf="curn")
+    # a zero-count pair contributes zero weight, not a biased unit sample
+    sigma2 = np.ones(4)
+    counts = np.full((4, 4), 50.0)
+    counts[0, 1] = counts[1, 0] = 0.0
+    full = optimal_statistic(corr, pos, sigma2=sigma2,
+                             counts=np.full((4, 4), 50.0))
+    part = optimal_statistic(corr, pos, sigma2=sigma2, counts=counts)
+    assert part["sigma"] > full["sigma"]      # less data, wider null
