@@ -263,7 +263,8 @@ def _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf, coeffs,
     per-pulsar fused kernels. Residual updates and stored coefficients are
     handed out as zero-op _LazyRow views; nothing synchronizes.
     """
-    from .fake_pta import (_LazyRow, _RowBlock, _batchable_olds, _stack_rows)
+    from .fake_pta import (_LazyRow, _RowBlock, _batchable_olds,
+                           _stack_current, _stack_rows)
 
     if len({len(p.toas) for p in psrs}) != 1:
         return None
@@ -276,8 +277,7 @@ def _gwb_apply_batched(psrs, signal_name, f_psd, idx, freqf, coeffs,
     scale = np.stack([t[1] for t in tables])
     df_pad = tables[0][2]
 
-    cur = _stack_rows([p._res_dev if p._res_dev is not None else p._res_host
-                       for p in psrs])
+    cur = _stack_current(psrs)
     if olds:
         o0 = olds[0]
         old_f = np.asarray(o0["f"], dtype=np.float64)

@@ -119,6 +119,15 @@ def _k_gp_reinject_acc_batched(cur, phase, scale, psd, df, keys, folds,
 
 
 @jax.jit
+def _k_white_acc_batched(cur, keys, folds, toaerrs, efac, equad):
+    def one(cur_g, key_g, folds_g, te_g, ef_g, eq_g):
+        k = rng_utils.fold_key_in_kernel(key_g, folds_g)
+        sigma2 = white_ops.white_sigma2(te_g, ef_g, eq_g)
+        return cur_g + white_ops.draw_white(k, sigma2)
+    return jax.vmap(one)(cur, keys, folds, toaerrs, efac, equad)
+
+
+@jax.jit
 def _k_white_acc(cur, key, folds, toaerrs, efac, equad):
     k = rng_utils.fold_key_in_kernel(key, folds)
     sigma2 = white_ops.white_sigma2(toaerrs, efac, equad)
@@ -254,6 +263,31 @@ def _stack_rows(vals):
             return b.dev
     return jnp.stack([_as_device(v) if isinstance(v, _LazyRow)
                       else jnp.asarray(v) for v in vals])
+
+
+def _batch_keys(psrs, label, seed):
+    """(keys (G,), fold labels (G, k)) for batched per-pulsar draws.
+
+    ``seed=None`` consumes each pulsar's own key stream — the same keys a
+    per-pulsar loop would use, in the same counter order. An explicit ``seed``
+    derives pulsar ``g``'s key as ``fold_in(key(seed), g)`` inside the kernel.
+    The fold order and uint32 label dtype must match ``KeyStream.next`` — this
+    helper is the single place that encodes the contract for array-level
+    injections.
+    """
+    if seed is None:
+        pairs = [p._keys.next_spec(label) for p in psrs]
+        return (jnp.stack([k for k, _ in pairs]),
+                np.stack([f for _, f in pairs]))
+    base = rng_utils.as_key(seed)
+    return (jnp.stack([base] * len(psrs)),
+            np.arange(len(psrs), dtype=np.uint32)[:, None])
+
+
+def _stack_current(psrs):
+    """Stacked (G, T) current residuals without materializing lazy rows."""
+    return _stack_rows([p._res_dev if p._res_dev is not None else p._res_host
+                        for p in psrs])
 
 
 def _batchable_olds(psrs, name):
@@ -650,25 +684,7 @@ class Pulsar:
             key, folds = self._keys.next_spec("white")
         else:
             key, folds = rng_utils.as_key(seed), rng_utils.NO_FOLDS
-        if randomize:
-            host = self._keys.host_rng("white_randomize")
-            for k in self.noisedict:
-                if "efac" in k:
-                    self.noisedict[k] = host.uniform(0.5, 2.5)
-                if "equad" in k:
-                    self.noisedict[k] = host.uniform(-8.0, -5.0)
-                if add_ecorr and "ecorr" in k:
-                    self.noisedict[k] = host.uniform(-10.0, -7.0)
-
-        efac = np.empty(len(self.toas))
-        equad = np.empty(len(self.toas))
-        ecorr = np.full(len(self.toas), -np.inf)
-        for backend in self.backends:
-            sel = self.backend_flags == backend
-            efac[sel] = self.noisedict[f"{self.name}_{backend}_efac"]
-            equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
-            if add_ecorr:
-                ecorr[sel] = self.noisedict[f"{self.name}_{backend}_log10_ecorr"]
+        efac, equad, ecorr = self._white_params(randomize, add_ecorr)
         cur = self._res_current()
         if add_ecorr:
             epoch_idx, n_epochs, counts = self._epoch_segments()
@@ -679,6 +695,33 @@ class Pulsar:
         else:
             self.residuals = _k_white_acc(cur, key, folds, self.toaerrs, efac,
                                           equad)
+
+    def _white_params(self, randomize=False, add_ecorr=False):
+        """(efac, equad, log10_ecorr) per-TOA arrays from the noisedict.
+
+        ``randomize`` redraws the dictionary entries uniformly first, as the
+        reference does (``fake_pta.py:203-210``), consuming this pulsar's own
+        host stream.
+        """
+        if randomize:
+            host = self._keys.host_rng("white_randomize")
+            for k in self.noisedict:
+                if "efac" in k:
+                    self.noisedict[k] = host.uniform(0.5, 2.5)
+                if "equad" in k:
+                    self.noisedict[k] = host.uniform(-8.0, -5.0)
+                if add_ecorr and "ecorr" in k:
+                    self.noisedict[k] = host.uniform(-10.0, -7.0)
+        efac = np.empty(len(self.toas))
+        equad = np.empty(len(self.toas))
+        ecorr = np.full(len(self.toas), -np.inf)
+        for backend in self.backends:
+            sel = self.backend_flags == backend
+            efac[sel] = self.noisedict[f"{self.name}_{backend}_efac"]
+            equad[sel] = self.noisedict[f"{self.name}_{backend}_log10_tnequad"]
+            if add_ecorr:
+                ecorr[sel] = self.noisedict[f"{self.name}_{backend}_log10_ecorr"]
+        return efac, equad, ecorr
 
     def _epoch_segments(self, dt=1.0, backends=None):
         """Integer epoch id per TOA — what the vectorized ECORR sampler consumes.
@@ -1219,6 +1262,36 @@ def make_fake_array(npsrs=25, Tobs=None, ntoas=None, gaps=True, toaerr=None,
     return psrs
 
 
+def add_white_noise_array(psrs, add_ecorr=False, randomize=False, seed=None):
+    """Inject EFAC/EQUAD white noise across a whole array in one kernel.
+
+    Array-level counterpart of ``Pulsar.add_white_noise``. With ``seed=None``
+    each pulsar consumes its own key stream (same draws as a per-pulsar loop);
+    an explicit ``seed`` folds by array index so draws stay independent. ECORR
+    arrays and ragged TOA counts fall back to the per-pulsar fused path
+    (per-pulsar epoch structures are data-dependent).
+    """
+    psrs = list(psrs)
+    if not psrs:
+        return
+    if add_ecorr or len({len(p.toas) for p in psrs}) != 1:
+        for g, p in enumerate(psrs):
+            s = None if seed is None else rng_utils.fold(rng_utils.as_key(seed), g)
+            p.add_white_noise(add_ecorr=add_ecorr, randomize=randomize, seed=s)
+        return
+    keys, folds = _batch_keys(psrs, "white", seed)
+    params = [p._white_params(randomize, False) for p in psrs]
+    cur = _stack_current(psrs)
+    new_stack = _k_white_acc_batched(
+        cur, keys, folds,
+        np.stack([p.toaerrs for p in psrs]),
+        np.stack([ef for ef, _, _ in params]),
+        np.stack([eq for _, eq, _ in params]))
+    holder = _RowBlock(new_stack)
+    for g, p in enumerate(psrs):
+        p.residuals = _LazyRow(holder, g)
+
+
 _GP_ARRAY_SIGNALS = {
     "red_noise": ("RN", 0.0, "add_red_noise"),
     "dm_gp": ("DM", 2.0, "add_dm_noise"),
@@ -1301,16 +1374,8 @@ def add_noise_array(psrs, signal="red_noise", spectrum="powerlaw", f_psd=None,
     else:
         psd_pad = np.stack([pad_1d(np.asarray(r, dtype=np.float64),
                                    len(df_pad)) for r in psd_rows])
-    cur = _stack_rows([p._res_dev if p._res_dev is not None else p._res_host
-                       for p in psrs])
-    if seed is None:
-        pairs = [p._keys.next_spec(signal) for p in psrs]
-        keys = jnp.stack([k for k, _ in pairs])
-        folds = np.stack([f for _, f in pairs])
-    else:
-        base = rng_utils.as_key(seed)
-        keys = jnp.stack([base] * len(psrs))
-        folds = np.arange(len(psrs), dtype=np.uint32)[:, None]
+    cur = _stack_current(psrs)
+    keys, folds = _batch_keys(psrs, signal, seed)
 
     if olds:
         o0 = olds[0]

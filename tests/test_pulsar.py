@@ -409,3 +409,30 @@ def test_add_noise_array_respects_disabled_model():
                     log10_A=-13.5, gamma=3.0, seed=1)
     assert "dm_gp" not in psrs[0].signal_model
     assert np.all(np.asarray(psrs[0].residuals) == 0.0)
+
+
+def test_add_white_noise_array_matches_loop_and_falls_back():
+    from fakepta_tpu.fake_pta import add_white_noise_array
+
+    toas = np.linspace(0, 10 * const.yr, 120)
+    mk = lambda: [Pulsar(toas, 1e-6, 1.0 + 0.1 * k, 0.3 * k, seed=40 + k)
+                  for k in range(5)]
+    a, b = mk(), mk()
+    add_white_noise_array(a)
+    for p in b:
+        p.add_white_noise()
+    for pa, pb in zip(a, b):
+        np.testing.assert_allclose(np.asarray(pa.residuals),
+                                   np.asarray(pb.residuals), rtol=1e-6)
+    # explicit seed: independent draws per pulsar
+    c = mk()
+    add_white_noise_array(c, seed=3)
+    assert not np.allclose(np.asarray(c[0].residuals),
+                           np.asarray(c[1].residuals))
+    # ragged fallback keeps working and the stats are right
+    d = mk()
+    d[1] = Pulsar(np.linspace(0, 10 * const.yr, 90), 1e-6, 1.1, 0.4, seed=9)
+    add_white_noise_array(d, seed=5)
+    for p in d:
+        std = np.asarray(p.residuals).std()
+        assert 0.7e-6 < std < 1.5e-6, std
