@@ -436,3 +436,27 @@ def test_add_white_noise_array_matches_loop_and_falls_back():
     for p in d:
         std = np.asarray(p.residuals).std()
         assert 0.7e-6 < std < 1.5e-6, std
+
+
+def test_lazyrow_array_surface():
+    """signal_model['...']['fourier'] from batched injections must behave like
+    an array for user code: shape/dtype/len/indexing/arithmetic/numpy."""
+    from fakepta_tpu.fake_pta import add_noise_array
+
+    toas = np.linspace(0, 10 * const.yr, 96)
+    psrs = [Pulsar(toas, 1e-7, 1.0 + 0.1 * k, 0.2 * k, seed=k)
+            for k in range(3)]
+    add_noise_array(psrs, signal="red_noise", spectrum="powerlaw",
+                    log10_A=-14.0, gamma=3.0, seed=1)
+    f = psrs[1].signal_model["red_noise"]["fourier"]
+    assert f.shape == (2, 30) and f.ndim == 2 and len(f) == 2
+    host = np.asarray(f)
+    assert f.dtype == host.dtype
+    np.testing.assert_array_equal(f[0], host[0])
+    np.testing.assert_allclose(2.0 * f, 2.0 * host)
+    np.testing.assert_allclose(f + 1.0, host + 1.0)
+    np.testing.assert_allclose(f - 1.0, host - 1.0)
+    np.testing.assert_allclose(1.0 - f, 1.0 - host)
+    np.testing.assert_allclose(-f, -host)
+    np.testing.assert_array_equal(np.asarray(f.device()), host)
+    assert "shape=(2, 30)" in repr(f)
