@@ -59,7 +59,10 @@ def main():
     # flagship's VMEM cap forces (pick_rt returns 4 there); rt=8 the aligned
     # one. An indexing bug specific to rt<8 would otherwise reach the flagship
     # stage checked only for finiteness.
-    assert pick_rt(R, PLOC, PFULL, T, NB) == 8, "small-size pick_rt drifted"
+    # not a bare assert: stripped (-O) runs must still catch pick_rt drift
+    if pick_rt(R, PLOC, PFULL, T, NB) != 8:
+        raise SystemExit("small-size pick_rt drifted; rt=8 lane no longer "
+                         "covers the aligned layout")
     for rt in (4, 8):
         for prec, tol in (("bf16", 1e-2), ("f32", 1e-5)):
             curves, autos = binned_correlation(

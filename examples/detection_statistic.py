@@ -71,6 +71,7 @@ def main():
 
     from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.correlated_noises import optimal_statistic
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
 
@@ -81,18 +82,33 @@ def main():
     psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=args.log10_A,
                                            gamma=13 / 3))
     mesh = make_mesh(jax.devices())
+    pos = np.asarray(batch.pos)
+    mask = np.asarray(batch.mask, dtype=np.float64)
+    counts = mask @ mask.T
 
-    runs = {}
+    runs, corrs = {}, {}
     for name, gwb in (("null", None), ("injected", GWBConfig(psd=psd, orf="hd"))):
         include = ("white", "red", "dm") + (("gwb",) if gwb else ())
         sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh)
-        out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk)
+        out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
+                      keep_corr=True)
         runs[name] = matched_filter(out["curves"], out["autos"],
                                     out["bin_centers"])
+        corrs[name] = out["corr"]
 
     null, inj = runs["null"], runs["injected"]
     thresh = float(np.percentile(null, 95.0))
     significance = float((inj.mean() - null.mean()) / max(null.std(), 1e-300))
+    # the noise-weighted optimal statistic, with sigma calibrated EMPIRICALLY
+    # from the matched null ensemble via null_amp2 (the analytic white-noise
+    # sigma is miscalibrated under red noise; the null run is the yardstick)
+    null_os = optimal_statistic(corrs["null"], pos, counts=counts)["amp2"]
+    os = optimal_statistic(corrs["injected"], pos, counts=counts,
+                           null_amp2=null_os)
+    inj_os = os["amp2"]
+    sigma_emp = float(os["sigma"])
+    os_significance = float((inj_os.mean() - null_os.mean())
+                            / max(sigma_emp, 1e-300))
     print(json.dumps({
         "npsr": args.npsr, "nreal": args.nreal,
         "log10_A": round(args.log10_A, 3),
@@ -102,6 +118,9 @@ def main():
         "null_95pct_threshold": thresh,
         "detection_rate_at_5pct_false_alarm": round(
             float((inj > thresh).mean()), 3),
+        "os_mean_amp2": float(inj_os.mean()),
+        "os_null_sigma_empirical": sigma_emp,
+        "os_detection_significance_sigma": round(os_significance, 2),
     }))
 
 

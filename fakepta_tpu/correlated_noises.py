@@ -19,6 +19,8 @@ Public-API parity with the reference's ``correlated_noises.py`` (functions
 
 from __future__ import annotations
 
+import warnings
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -72,7 +74,7 @@ def get_correlations(psrs, res):
 
 
 def optimal_statistic(corr, pos, orf="hd", sigma2=None, counts=None,
-                      h_map=None):
+                      h_map=None, null_amp2=None):
     """Noise-weighted optimal cross-correlation statistic per realization.
 
     The PTA community's standard amplitude estimator: for each realization's
@@ -95,21 +97,31 @@ def optimal_statistic(corr, pos, orf="hd", sigma2=None, counts=None,
     sigma2 : (P,) per-pulsar noise autocorrelation used in the weights;
         defaults to the ensemble-mean diagonal of ``corr`` (a null-consistent
         estimate when the cross power is weak).
-    counts : (P, P) valid-pair TOA counts (``mask @ mask.T``); defaults to 1,
-        which only rescales the SNR normalization on uniform arrays.
+    counts : (P, P) valid-pair TOA counts (``mask @ mask.T``); defaults to 1.
+        Note the default makes the *analytic* ``sigma`` (and thus ``snr``)
+        miscalibrated by ~sqrt(N_toa) and not comparable across runs with
+        different TOA counts — a warning is emitted unless an empirical
+        ``null_amp2`` calibration (which does not need counts) is supplied.
+        ``amp2`` itself is count-independent on uniform arrays.
+    null_amp2 : optional (N,) ``amp2`` sample from a matched null ensemble
+        (``gwb=None``). When given, ``sigma`` is the empirical standard
+        deviation of the null sample instead of the analytic white-noise
+        value — the unbiased calibration under red noise.
 
     Returns
     -------
     dict with ``amp2`` (R,) — estimated common cross-power, same seconds^2
-    units as ``sum(psd * df)``; ``sigma`` — its analytic null standard
-    deviation; and ``snr`` (R,) = ``amp2 / sigma``.
+    units as ``sum(psd * df)``; ``sigma`` — its null standard deviation
+    (analytic, or empirical when ``null_amp2`` is given); and ``snr``
+    (R,) = ``amp2 / sigma``.
 
-    ``sigma`` treats the per-pair samples as independent (white noise): with
-    strong per-pulsar red noise the effective sample count per pair is smaller
-    and the true null scatter is wider. The unbiased calibration is empirical —
-    run a null ensemble (``gwb=None``) through this function and use its
-    ``amp2`` distribution as the null; the device engine makes thousands of
-    null realizations cheap, which is the point of the framework.
+    The analytic ``sigma`` treats the per-pair samples as independent (white
+    noise): with strong per-pulsar red noise the effective sample count per
+    pair is smaller and the true null scatter is wider. The unbiased
+    calibration is empirical — run a null ensemble (``gwb=None``) through this
+    function and pass its ``amp2`` distribution as ``null_amp2``; the device
+    engine makes thousands of null realizations cheap, which is the point of
+    the framework.
     """
     corr = np.asarray(corr)
     if corr.ndim == 2:
@@ -136,7 +148,21 @@ def optimal_statistic(corr, pos, orf="hd", sigma2=None, counts=None,
             f"'curn' is diagonal, or no pulsar pair shares TOAs) — the "
             f"optimal statistic is undefined for it")
     amp2 = (rho * (gam * inv_var)).sum(axis=1) / denom
-    sigma_amp2 = denom ** -0.5
+    if null_amp2 is not None:
+        null_amp2 = np.asarray(null_amp2, dtype=np.float64).ravel()
+        if null_amp2.size < 2:
+            raise ValueError("null_amp2 needs at least 2 null realizations "
+                             "to estimate an empirical sigma")
+        sigma_amp2 = float(np.std(null_amp2, ddof=1))
+    else:
+        if counts is None:
+            warnings.warn(
+                "optimal_statistic without counts: the analytic sigma/snr "
+                "are off by ~sqrt(N_toa) and not comparable across TOA "
+                "counts; pass counts=mask @ mask.T (EnsembleSimulator holds "
+                "them) or calibrate empirically via null_amp2",
+                stacklevel=2)
+        sigma_amp2 = denom ** -0.5
     return {"amp2": amp2, "sigma": sigma_amp2, "snr": amp2 / sigma_amp2}
 
 

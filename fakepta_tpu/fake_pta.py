@@ -237,8 +237,54 @@ class _LazyRow:
     def __rsub__(self, other):
         return other - np.asarray(self)
 
+    def __truediv__(self, other):
+        return np.asarray(self) / other
+
+    def __rtruediv__(self, other):
+        return other / np.asarray(self)
+
+    def __pow__(self, other):
+        return np.asarray(self) ** other
+
+    def __rpow__(self, other):
+        return other ** np.asarray(self)
+
+    def __matmul__(self, other):
+        return np.asarray(self) @ other
+
+    def __rmatmul__(self, other):
+        return other @ np.asarray(self)
+
     def __neg__(self):
         return -np.asarray(self)
+
+    def __abs__(self):
+        return np.abs(np.asarray(self))
+
+    def __iter__(self):
+        return iter(np.asarray(self))
+
+    # comparisons return boolean arrays like ndarray (this also makes rows
+    # unhashable, matching ndarray semantics)
+    def __eq__(self, other):
+        return np.asarray(self) == other
+
+    def __ne__(self, other):
+        return np.asarray(self) != other
+
+    def __lt__(self, other):
+        return np.asarray(self) < other
+
+    def __le__(self, other):
+        return np.asarray(self) <= other
+
+    def __gt__(self, other):
+        return np.asarray(self) > other
+
+    def __ge__(self, other):
+        return np.asarray(self) >= other
+
+    __hash__ = None
 
     def __repr__(self):
         return f"_LazyRow(shape={self.shape}, dtype={self.dtype})"

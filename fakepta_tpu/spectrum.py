@@ -20,7 +20,9 @@ from __future__ import annotations
 import dataclasses
 from typing import Callable, Dict, Tuple
 
+import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import constants as const
 
@@ -126,8 +128,24 @@ def free_spectrum(f, log10_rho=None):
     ``f_i = i/Tspan`` (df = 1/Tspan): ``psd_i = 10^(2 log10_rho_i) * Tspan`` with
     ``Tspan`` inferred as ``1/f_1``. Extension beyond the reference set (ENTERPRISE
     offers the same model); registered so injectors accept ``spectrum='free_spectrum'``.
+
+    The inference is only valid on the standard grid ``f_i = i/Tspan``: a
+    concrete non-standard grid (custom ``f_psd`` in the facade injectors)
+    raises instead of silently rescaling every bin by the wrong ``Tspan``.
+    Traced frequencies (inside jit) skip the check — callers on the standard
+    per-pulsar grids (``PulsarBatch``, facade defaults) are pre-validated.
     """
     f = jnp.asarray(f)
+    if not isinstance(f, jax.core.Tracer):
+        f_host = np.asarray(f, dtype=np.float64)
+        expect = np.arange(1, f_host.size + 1) * f_host[0]
+        # atol=0: PTA grids are ~1e-9 Hz, far below allclose's default atol
+        if not np.allclose(f_host, expect, rtol=1e-5, atol=0.0):
+            raise ValueError(
+                "free_spectrum needs the standard grid f_i = i/Tspan (it "
+                "infers Tspan = 1/f[0]); got a non-uniform/offset grid. "
+                "Compute the PSD yourself (psd_i = 10**(2*log10_rho_i)/df_i) "
+                "and pass it via custom_psd instead")
     log10_rho = jnp.zeros_like(f) if log10_rho is None else jnp.asarray(log10_rho)
     return jnp.exp(2.0 * log10_rho * const.ln10 - jnp.log(f[0]))
 

@@ -22,14 +22,37 @@ _DEFAULT_SEED = 0
 
 
 @functools.lru_cache(maxsize=4096)
-def _int_key(seed: int) -> jax.Array:
-    """Cached ``jax.random.key`` for integer seeds.
+def _int_key_data(seed: int) -> np.ndarray:
+    """Cached *host* key data for integer seeds.
 
-    Seeding is an eager device op (~ms of dispatch latency on a remote TPU);
-    explicit-seed APIs call it per injection, so the memo turns repeats into a
-    dict lookup. Keys are immutable, so sharing the array is safe.
+    The cache stores host uint32 key data, not device keys: a cached device
+    key would pin whichever backend was live at first call, and the
+    dead-tunnel fallback switches ``jax_platforms`` to cpu mid-process —
+    stale-backend keys must not survive that. Threefry key data is
+    platform-independent, so rewrapping is exact. Computed on the local CPU
+    backend when one exists so seeding never pays an accelerator round-trip.
     """
-    return jax.random.key(seed)
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = None
+    if cpu is None:
+        return np.asarray(jax.random.key_data(jax.random.key(seed)))
+    with jax.default_device(cpu):
+        return np.asarray(jax.random.key_data(jax.random.key(seed)))
+
+
+@functools.lru_cache(maxsize=4096)
+def _wrapped_key(seed: int, backend: str) -> jax.Array:
+    # keyed on the live default backend: a platform switch MISSES the cache
+    # (fresh wrap on the new backend) instead of serving a stale device key,
+    # while repeated seeds on a stable backend stay a dict lookup — seeding
+    # is otherwise an eager device op of ~ms dispatch latency on a remote TPU
+    return jax.random.wrap_key_data(_int_key_data(seed))
+
+
+def _int_key(seed: int) -> jax.Array:
+    return _wrapped_key(seed, jax.default_backend())
 
 
 def set_default_seed(seed: int) -> None:

@@ -124,3 +124,40 @@ def test_quantise_epochs_keeps_last_group():
     eidx, nep, counts = W.quantise_epochs(times, codes, dt=86400.0)
     assert nep == 2
     np.testing.assert_array_equal(eidx, [0, 0, 1, 1])
+
+
+def test_quantise_epochs_matches_per_toa_greedy_rule(rng):
+    """The per-epoch searchsorted grouping must reproduce the reference's
+    per-TOA greedy anchor rule exactly (incl. the >= dt boundary)."""
+    ntoa = 400
+    # cluster times so epochs have 1-10 TOAs, with some exact-boundary ties
+    times = np.sort(rng.uniform(0, 200 * 86400.0, ntoa))
+    times[7] = times[6] + 86400.0            # exact >= dt tie
+    codes = rng.integers(0, 3, ntoa)
+
+    want = np.full(ntoa, -1, dtype=np.int64)
+    nxt = 0
+    for code in np.unique(codes):
+        sel = np.flatnonzero(codes == code)
+        order = sel[np.argsort(times[sel], kind="stable")]
+        t0 = times[order[0]]
+        for i in order:                       # the reference's per-TOA loop
+            if times[i] - t0 >= 86400.0:
+                t0 = times[i]
+                nxt += 1
+            want[i] = nxt
+        nxt += 1
+
+    eidx, nep, counts = W.quantise_epochs(times, codes, dt=86400.0)
+    np.testing.assert_array_equal(eidx, want)
+    assert nep == nxt
+    np.testing.assert_array_equal(counts, np.bincount(want, minlength=nep))
+
+
+def test_quantise_epochs_degenerate_dt_terminates():
+    """dt <= 0 must degrade to one-TOA epochs, not an infinite loop
+    (reachable from Pulsar.quantise_ecorr(dt=0))."""
+    times = np.array([0.0, 1.0, 1.0, 2.0])
+    eidx, nep, counts = W.quantise_epochs(times, np.zeros(4, int), dt=0.0)
+    assert nep == 4
+    np.testing.assert_array_equal(np.sort(counts), np.ones(4))

@@ -1,5 +1,7 @@
 """Batch + Monte-Carlo engine tests on the virtual 8-device CPU mesh."""
 
+import warnings
+
 import jax
 import numpy as np
 import pytest
@@ -520,3 +522,35 @@ def test_optimal_statistic_rejects_diagonal_orf_and_drops_empty_pairs():
                              counts=np.full((4, 4), 50.0))
     part = optimal_statistic(corr, pos, sigma2=sigma2, counts=counts)
     assert part["sigma"] > full["sigma"]      # less data, wider null
+
+
+def test_optimal_statistic_empirical_null_and_counts_warning():
+    from fakepta_tpu.correlated_noises import optimal_statistic
+
+    rng = np.random.default_rng(3)
+    pos = rng.standard_normal((6, 3))
+    pos /= np.linalg.norm(pos, axis=1, keepdims=True)
+    corr = rng.standard_normal((200, 6, 6)) * 1e-12
+    # positive autocorrelations: the default sigma2 is the ensemble-mean diag
+    corr[:, np.arange(6), np.arange(6)] = np.abs(
+        corr[:, np.arange(6), np.arange(6)]) + 1e-12
+    counts = np.full((6, 6), 40.0)
+
+    # omitting counts without an empirical null warns (analytic sigma is
+    # ~sqrt(N_toa) miscalibrated); supplying either silences it
+    with pytest.warns(UserWarning, match="without counts"):
+        optimal_statistic(corr, pos)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        optimal_statistic(corr, pos, counts=counts)
+
+    # empirical calibration: sigma is the null sample's std, snr rescales
+    null_amp2 = optimal_statistic(corr[:100], pos, counts=counts)["amp2"]
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")   # null_amp2 also silences the warning
+        cal = optimal_statistic(corr[100:], pos, null_amp2=null_amp2)
+    np.testing.assert_allclose(cal["sigma"], np.std(null_amp2, ddof=1),
+                               rtol=1e-12)
+    np.testing.assert_allclose(cal["snr"], cal["amp2"] / cal["sigma"])
+    with pytest.raises(ValueError, match="at least 2"):
+        optimal_statistic(corr, pos, counts=counts, null_amp2=[1.0])

@@ -458,5 +458,20 @@ def test_lazyrow_array_surface():
     np.testing.assert_allclose(f - 1.0, host - 1.0)
     np.testing.assert_allclose(1.0 - f, 1.0 - host)
     np.testing.assert_allclose(-f, -host)
+    np.testing.assert_allclose(f / 2.0, host / 2.0)
+    np.testing.assert_allclose(2.0 / (f + 3.0), 2.0 / (host + 3.0))
+    np.testing.assert_allclose(f ** 2, host ** 2)
+    np.testing.assert_allclose(2.0 ** (f * 0.1), 2.0 ** (host * 0.1))
+    np.testing.assert_allclose(abs(f), np.abs(host))
+    np.testing.assert_allclose(f @ host.T, host @ host.T)
+    np.testing.assert_allclose(host.T @ np.asarray(f), host.T @ host)
+    # iteration and comparisons behave like ndarray (elementwise booleans)
+    rows = list(f)
+    assert len(rows) == 2
+    np.testing.assert_array_equal(rows[0], host[0])
+    np.testing.assert_array_equal(f > 0.0, host > 0.0)
+    np.testing.assert_array_equal(f == host, host == host)
+    np.testing.assert_array_equal(f != host, host != host)
+    np.testing.assert_array_equal(f <= 0.0, host <= 0.0)
     np.testing.assert_array_equal(np.asarray(f.device()), host)
     assert "shape=(2, 30)" in repr(f)

@@ -75,6 +75,22 @@ def test_free_spectrum_bin_power(f):
     assert tspan > 0
 
 
+def test_free_spectrum_rejects_nonstandard_grid(f):
+    """Tspan is inferred as 1/f[0]; a non-i/Tspan grid must raise, not silently
+    rescale every bin (VERDICT r3 weak #6)."""
+    rho = np.zeros(len(f))
+    with pytest.raises(ValueError, match="standard grid"):
+        spectrum.free_spectrum(f + 0.3 * f[0], log10_rho=rho)   # offset grid
+    with pytest.raises(ValueError, match="standard grid"):
+        spectrum.free_spectrum(f ** 1.01, log10_rho=rho)        # warped grid
+    # a traced f (inside jit) skips the host check but computes identically
+    import jax
+
+    got = np.asarray(jax.jit(spectrum.free_spectrum)(f, log10_rho=rho))
+    np.testing.assert_allclose(got, np.asarray(
+        spectrum.free_spectrum(f, log10_rho=rho)), rtol=1e-10)
+
+
 def test_registry_contents_and_params():
     for name in ["powerlaw", "turnover", "t_process", "t_process_adapt", "turnover_knee", "broken_powerlaw"]:
         assert name in spectrum.SPECTRA

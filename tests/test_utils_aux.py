@@ -171,6 +171,11 @@ def test_as_key_int_cache_consistent():
                                   jax.random.key_data(k2))
     np.testing.assert_array_equal(jax.random.key_data(k1),
                                   jax.random.key_data(jax.random.key(5)))
+    # the cache stores HOST key data (a stale-backend device key would break
+    # the dead-tunnel platform switch, ADVICE r3); rewrap is exact
+    data = rng_utils._int_key_data(5)
+    assert isinstance(data, np.ndarray)
+    np.testing.assert_array_equal(data, np.asarray(jax.random.key_data(k1)))
 
 
 def test_phase_cache_invalidates_on_attribute_overwrite():
