@@ -1,5 +1,6 @@
 """Benchmark suite for the BASELINE.md configs (1-5 from BASELINE.json, plus
-6: config 4 as one device program, 7: the full-noise ECORR/system ensemble).
+6: config 4 as one device program, 7: the full-noise ECORR/system ensemble,
+8: the flagship with per-realization hyperparameter sampling).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -189,6 +190,43 @@ def config7():
             "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
 
 
+def config8():
+    """Flagship + per-realization hyperparameter sampling (NoiseSampling):
+    per-pulsar red (log10_A, gamma) and global GWB (log10_A, gamma) drawn
+    fresh every realization on device — population marginalization the
+    reference cannot express at all. Measures the sampling overhead vs
+    config 5's fixed-PSD program."""
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
+                                                 NoiseSampling)
+
+    n_dev = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    sim = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
+        noise_sample=[NoiseSampling("red", log10_A=(-17.0, -13.0),
+                                    gamma=(1.0, 5.0)),
+                      NoiseSampling("gwb", log10_A=(-15.0, -14.0),
+                                    gamma=(13 / 3, 13 / 3))])
+    nreal, chunk = 100_000, 10_000
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    return {"config": 8,
+            "metric": "hyperparameter-sampled realizations/s/chip (100 psr, "
+                      "per-psr red + GWB draws)",
+            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -249,7 +287,8 @@ def config5():
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--configs", type=int, nargs="*", default=[1, 2, 3, 4, 5, 6, 7])
+    ap.add_argument("--configs", type=int, nargs="*",
+                    default=[1, 2, 3, 4, 5, 6, 7, 8])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     args = ap.parse_args()
@@ -259,7 +298,7 @@ def main():
     import jax
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7}
+           6: config6, 7: config7, 8: config8}
     rows = []
     for c in args.configs:
         row = fns[c]()

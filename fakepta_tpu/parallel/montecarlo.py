@@ -94,6 +94,96 @@ class RoemerConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class NoiseSampling:
+    """Per-realization power-law hyperparameter sampling for a GP stage.
+
+    The parameters PTA population studies actually marginalize — noise
+    amplitudes and spectral slopes — drawn fresh for every realization
+    *inside* the device program:
+
+    - ``target='red' | 'dm' | 'chrom'``: each pulsar draws an independent
+      ``(log10_A, gamma)`` pair per realization (population marginalization
+      over per-pulsar noise uncertainty); the sampled power-law PSD replaces
+      the batch's fixed ``<target>_psd`` for that stage.
+    - ``target='gwb'``: ONE global ``(log10_A, gamma)`` pair per realization
+      (the background is common); replaces ``GWBConfig.psd``. The ORF and
+      chromatic index still come from ``GWBConfig``.
+
+    ``log10_A`` / ``gamma`` are ``(a, b)`` pairs: ``dist='uniform'`` draws
+    ``U(a, b)`` (the reference's population convention — ``make_fake_array``
+    draws log10_A ~ U(-17, -13), gamma ~ U(1, 5), ``fake_pta.py:653-667`` —
+    but per *array construction*, never per realization; the reference cannot
+    vary anything inside a loop); ``dist='normal'`` draws ``N(mean=a, std=b)``.
+    Zero-width ranges pin the parameter.
+
+    Stream discipline matches every other stage: draws fold the realization
+    key with a dedicated domain tag and (for per-pulsar targets) the *global*
+    pulsar index, so realizations are bit-identical on any mesh shape and the
+    coefficient/white/GWB streams are untouched — a run with a zero-width
+    sampling range reproduces the fixed-PSD run's statistics exactly.
+    """
+
+    target: str
+    log10_A: Tuple[float, float]
+    gamma: Tuple[float, float]
+    dist: str = "uniform"
+
+
+# domain tag for hyperparameter sampling keys (cf. 0x51 noise / 0x6B gwb /
+# 0x77 roemer-sampling); per-target subtags keep multi-target draws independent
+_HYPER_TAG = 0x9C
+_HYPER_SUBTAG = {"red": 0, "dm": 1, "chrom": 2, "gwb": 3}
+
+# domain tag for per-realization CGW source sampling
+_CGW_TAG = 0xC6
+
+
+@dataclasses.dataclass(frozen=True)
+class CGWSampling:
+    """Per-realization CGW source sampling inside the device program.
+
+    Each realization draws one circular-SMBHB source with every parameter
+    ~ U(a, b) from its ``(a, b)`` range (zero-width pins it) and evaluates the
+    full evolving waveform on device — a continuous-wave *population* search
+    prior, Monte-Carlo-marginalized at ensemble speed. The reference evaluates
+    one fixed source per ``add_cgw`` call through an external package
+    (``fake_pta.py:422-442``) and cannot vary it in any loop.
+
+    Draws are global nuisances (one source common to the array): keys fold the
+    realization key with the 0xC6 domain tag and the per-config index only —
+    never the pulsar-shard index — so streams are mesh-shape independent.
+
+    Precision: the waveform is evaluated at float32 from epochs relative to
+    ``tref`` (host-float64 subtraction). With ``tref=0`` and MJD-second epochs
+    ~4.6e9 s the f32 quantization is ~550 s => ~2e-5 rad of GW phase at
+    f_gw ~ 1e-8 Hz — negligible against the waveform, and irrelevant in the
+    usual population setup where ``phase0`` is itself sampled over (0, 2 pi).
+    Pass ``tref`` near the data span's midpoint to shrink it further (~1e-6
+    rad); ``phase0`` is then referenced at ``tref``.
+
+    ``psrterm=True`` uses the simulator's ``pdist`` means (the distance-draw
+    nuisance ``p_dist`` is 0, as in the facade's default). Note the pulsar
+    term's retarded phase is ~omega L/c ~ 1e3-1e4 rad: at f32 its absolute
+    rounding is ~2e-4 rad, so realizations reproduce across mesh shapes only
+    to ~1e-4 relative (compiler op-ordering changes the rounding). That is
+    exactly the regime where the pulsar-term phase is physically a random
+    nuisance anyway; use the construction-time ``CGWConfig`` path (host
+    float64) when exact pulsar terms matter.
+    """
+
+    costheta: Tuple[float, float] = (-1.0, 1.0)
+    phi: Tuple[float, float] = (0.0, 2.0 * np.pi)
+    cosinc: Tuple[float, float] = (-1.0, 1.0)
+    log10_mc: Tuple[float, float] = (8.5, 9.5)
+    log10_fgw: Tuple[float, float] = (-8.5, -7.5)
+    log10_h: Tuple[float, float] = (-14.5, -13.5)
+    phase0: Tuple[float, float] = (0.0, 2.0 * np.pi)
+    psi: Tuple[float, float] = (0.0, np.pi)
+    psrterm: bool = False
+    tref: float = 0.0
+
+
+@dataclasses.dataclass(frozen=True)
 class RoemerSampling:
     """Per-realization BayesEphem nuisance sampling inside the device program.
 
@@ -123,12 +213,17 @@ class RoemerSampling:
 
 def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                     include_white, include_ecorr, include_red, include_dm,
-                    include_chrom, include_sys, include_gwb):
+                    include_chrom, include_sys, include_gwb,
+                    samp_static=(), samp_params=()):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
     batch: the *local* pulsar shard. Returns (R_local, P_local, T).
+    samp_static: static tuple of (target, dist) pairs for per-realization
+    hyperparameter sampling (:class:`NoiseSampling`); samp_params the matching
+    traced (2, 2) [[A_a, A_b], [gamma_a, gamma_b]] arrays.
     """
+    from .. import spectrum as spectrum_lib
     p_local = batch.t_own.shape[0]
     pidx = lax.axis_index(PSR_AXIS)
     dtype = batch.t_own.dtype
@@ -200,6 +295,49 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             return jax.vmap(
                 lambda k: jax.random.normal(k, shape, dtype))(keys_p)
 
+        # per-realization hyperparameter sampling (NoiseSampling): sampled
+        # power-law weights replace the fixed precomputed ones for their
+        # stage. Keys live in their own 0x9C domain + per-target subtag, so
+        # the coefficient/white/GWB streams above are byte-identical whether
+        # or not sampling is on. Per-pulsar targets fold the GLOBAL index
+        # (mesh-shape independent); the 'gwb' pair is one global draw (the
+        # background is common), identical on every psr shard.
+        w_samp = {}
+        if samp_static:
+            hyper_root = jax.random.fold_in(key, _HYPER_TAG)
+            for (target, dist), params in zip(samp_static, samp_params):
+                kt = jax.random.fold_in(hyper_root, _HYPER_SUBTAG[target])
+                per_psr = target != "gwb"
+                if per_psr:
+                    kts = jax.vmap(lambda g, k=kt: jax.random.fold_in(k, g))(gidx)
+                    z = jax.vmap(lambda k: (
+                        jax.random.uniform(k, (2,), dtype) if dist == "uniform"
+                        else jax.random.normal(k, (2,), dtype)))(kts)   # (P,2)
+                else:
+                    z = (jax.random.uniform(kt, (2,), dtype)
+                         if dist == "uniform"
+                         else jax.random.normal(kt, (2,), dtype))      # (2,)
+                if dist == "uniform":
+                    vals = params[:, 0] + z * (params[:, 1] - params[:, 0])
+                else:
+                    vals = params[:, 0] + z * params[:, 1]
+                log10_A, gamma = vals[..., 0], vals[..., 1]
+                if target == "gwb":
+                    df_c = 1.0 / batch.tspan_common
+                    f = jnp.arange(1, n_gwb + 1, dtype=dtype) * df_c
+                    psd = spectrum_lib.powerlaw(f, log10_A=log10_A,
+                                                gamma=gamma)
+                    w_samp["gwb"] = jnp.sqrt(psd * df_c)               # (C,)
+                else:
+                    nbin = {"red": n_red, "dm": n_dm}.get(target)
+                    if nbin is None:
+                        nbin = batch.chrom_psd.shape[1]
+                    f = (jnp.arange(1, nbin + 1, dtype=dtype)
+                         * batch.df_own[:, None])                      # (P,N)
+                    psd = spectrum_lib.powerlaw(f, log10_A=log10_A[:, None],
+                                                gamma=gamma[:, None])
+                    w_samp[target] = jnp.sqrt(psd * batch.df_own[:, None])
+
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
             res = res + jnp.sqrt(batch.sigma2) * draw(kw, T)
@@ -211,13 +349,13 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             res = res + batch.ecorr_amp * shared
         coeffs = []
         if include_red:
-            c = draw(kr, 2, n_red) * red_w[:, None, :]
+            c = draw(kr, 2, n_red) * w_samp.get("red", red_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_dm:
-            c = draw(kd, 2, n_dm) * dm_w[:, None, :]
+            c = draw(kd, 2, n_dm) * w_samp.get("dm", dm_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_chrom:
-            c = draw(kc, 2, n_chrom) * chrom_w[:, None, :]
+            c = draw(kc, 2, n_chrom) * w_samp.get("chrom", chrom_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_sys:
             # per-(pulsar, backend-band) GP on the shared basis, masked to the
@@ -236,7 +374,7 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
             z = jax.random.normal(kg, (2, n_gwb, p_total), dtype)
             corr = z @ chol.T
             corr_local = lax.dynamic_slice_in_dim(corr, pidx * p_local, p_local, axis=2)
-            c = corr_local * gwb_w[None, :, None]                      # (2,C,P_loc)
+            c = corr_local * w_samp.get("gwb", gwb_w)[None, :, None]   # (2,C,P_loc)
             coeffs.append(jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1))
         if coeffs:
             res = res + jnp.einsum("ptk,pk->pt", gp_basis_all,
@@ -267,6 +405,38 @@ def _sampled_roemer(keys, state, scales, pos_local, tag):
         return roemer_delay_dev(state, pos_local, d_mass=d[0], d_Om=d[1],
                                 d_omega=d[2], d_inc=d[3], d_a=d[4], d_e=d[5],
                                 d_l0=d[6])
+
+    return jax.vmap(one)(keys)
+
+
+def _as_config_list(x):
+    """Coerce a single config / sequence of configs / None into a list."""
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, psrterm, tag):
+    """(R_local, P_local, T) per-realization CGW delays (shard_map body).
+
+    ``t_rel`` is this shard's (P_local, T) epochs relative to the config's
+    ``tref`` (precomputed host-f64, stored f32); ``ranges`` the (8, 2) uniform
+    parameter bounds in CGWSampling field order. The draw key folds the 0xC6
+    domain tag and the per-config index ``tag`` but never the shard index: one
+    sampled source is a global nuisance per realization.
+    """
+    from ..models.cgw import cw_delay
+
+    dtype = t_rel.dtype
+
+    def one(key):
+        kz = jax.random.fold_in(jax.random.fold_in(key, _CGW_TAG), tag)
+        z = jax.random.uniform(kz, (8,), dtype)
+        v = ranges[:, 0] + z * (ranges[:, 1] - ranges[:, 0])
+        return jax.vmap(lambda t, p, pd: cw_delay(
+            t, p, (pd[0], pd[1]), cos_gwtheta=v[0], gwphi=v[1], cos_inc=v[2],
+            log10_mc=v[3], log10_fgw=v[4], log10_h=v[5], phase0=v[6], psi=v[7],
+            psrTerm=psrterm, evolve=True))(t_rel, pos_local, pdist_local)
 
     return jax.vmap(one)(keys)
 
@@ -306,10 +476,8 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
     go through the f32-stable difference kernel with the nominal orbit
     propagated host-side in float64.
     """
-    cgw_list = [] if cgw is None else (list(cgw) if isinstance(
-        cgw, (list, tuple)) else [cgw])
-    roe_list = [] if roemer is None else (list(roemer) if isinstance(
-        roemer, (list, tuple)) else [roemer])
+    cgw_list = _as_config_list(cgw)
+    roe_list = _as_config_list(roemer)
     if not cgw_list and not roe_list:
         return None
     toas_abs = _validated_toas_abs(batch, toas_abs,
@@ -327,18 +495,29 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
         pos64 = np.asarray(batch.pos, dtype=np.float64)
         # construction-time, once: evaluate at float64 on the host CPU backend
         # (absolute MJD-second epochs ~4.6e9 s quantize at ~550 s in f32 —
-        # ~2e-5 rad of phase error the one-off f64 evaluation avoids for free)
+        # ~2e-5 rad of phase error the one-off f64 evaluation avoids for free).
+        # Sources sharing a (psrterm, amplitude-mode) signature evaluate as ONE
+        # vmapped parameter batch (cw_delay_batched) instead of a Python loop.
+        groups = {}
+        for cfg in cgw_list:
+            mode = "h" if cfg.log10_h is not None else "dist"
+            groups.setdefault((bool(cfg.psrterm), mode), []).append(cfg)
         with enable_x64(), jax.default_device(jax.devices("cpu")[0]):
-            for cfg in cgw_list:
-                delay = jax.vmap(
-                    lambda t, pos, pd, c=cfg: cgw_model.cw_delay(
-                        t, pos, (pd[0], pd[1]), cos_gwtheta=c.costheta,
-                        gwphi=c.phi, cos_inc=c.cosinc, log10_mc=c.log10_mc,
-                        log10_fgw=c.log10_fgw, log10_h=c.log10_h,
-                        log10_dist=c.log10_dist, phase0=c.phase0, psi=c.psi,
-                        psrTerm=c.psrterm, evolve=True))(
+            for (psrterm, mode), cfgs in groups.items():
+                amp = np.array([c.log10_h if mode == "h" else c.log10_dist
+                                for c in cfgs])
+                kw = {("log10_h" if mode == "h" else "log10_dist"): amp}
+                delay = cgw_model.cw_delay_batched(
                     jnp.asarray(toas_abs), jnp.asarray(pos64),
-                    jnp.asarray(pdist))
+                    jnp.asarray(pdist),
+                    cos_gwtheta=np.array([c.costheta for c in cfgs]),
+                    gwphi=np.array([c.phi for c in cfgs]),
+                    cos_inc=np.array([c.cosinc for c in cfgs]),
+                    log10_mc=np.array([c.log10_mc for c in cfgs]),
+                    log10_fgw=np.array([c.log10_fgw for c in cfgs]),
+                    phase0=np.array([c.phase0 for c in cfgs]),
+                    psi=np.array([c.psi for c in cfgs]),
+                    psrTerm=psrterm, evolve=True, **kw)
                 det = det + jnp.asarray(np.asarray(delay), dtype)
     if roe_list:
         from ..models import roemer as roemer_dev
@@ -414,8 +593,11 @@ class EnsembleSimulator:
                  nbins: int = 15, use_pallas: Optional[bool] = None,
                  pallas_precision: str = "bf16",
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
-                 toas_abs=None, pdist=None):
-        """``use_pallas`` enables the fused statistic kernel
+                 toas_abs=None, pdist=None, noise_sample=None,
+                 cgw_sample=None):
+        """``noise_sample`` takes :class:`NoiseSampling` config(s) — per-
+        realization (log10_A, gamma) draws replacing the fixed PSD of the
+        red/dm/chrom/gwb stages. ``use_pallas`` enables the fused statistic kernel
         (:mod:`fakepta_tpu.ops.pallas_kernels`); ``pallas_precision`` is
         ``'bf16'`` (default: bf16 matmul operands with f32 accumulation —
         ~4e-3 relative rounding on individual pair correlations, 2x the MXU
@@ -454,10 +636,43 @@ class EnsembleSimulator:
             self._gwb_idx = 0.0
             self._gwb_freqf = 1400.0
         include = tuple(include)
+
+        # per-realization hyperparameter sampling (NoiseSampling, single or
+        # sequence): static (target, dist) structure + tiny traced (2, 2)
+        # range arrays, validated against the stages actually in the program
+        samp_list = _as_config_list(noise_sample)
+        seen = set()
+        for cfg in samp_list:
+            if cfg.target not in _HYPER_SUBTAG:
+                raise ValueError(f"NoiseSampling target {cfg.target!r} not in "
+                                 f"{sorted(_HYPER_SUBTAG)}")
+            if cfg.target in seen:
+                raise ValueError(f"duplicate NoiseSampling target "
+                                 f"{cfg.target!r}")
+            seen.add(cfg.target)
+            if cfg.dist not in ("uniform", "normal"):
+                raise ValueError(f"NoiseSampling dist must be 'uniform' or "
+                                 f"'normal', got {cfg.dist!r}")
+            if cfg.target not in include:
+                raise ValueError(f"NoiseSampling target {cfg.target!r} needs "
+                                 f"stage {cfg.target!r} in include")
+            if cfg.target == "gwb" and gwb is None:
+                raise ValueError("NoiseSampling('gwb') needs a GWBConfig (its "
+                                 "orf/idx and psd length set the program; the "
+                                 "psd values are replaced by the draws)")
+        self._samp_static = tuple((cfg.target, cfg.dist) for cfg in samp_list)
+        self._samp_params = tuple(
+            jnp.asarray([[cfg.log10_A[0], cfg.log10_A[1]],
+                         [cfg.gamma[0], cfg.gamma[1]]], dtype)
+            for cfg in samp_list)
+        sampled = {cfg.target for cfg in samp_list}
+
         # optional stages only enter the program if their parameters are anywhere
         # nonzero — the default synthetic batch has chrom/ecorr off, so nothing
-        # is traced for them
-        has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0))
+        # is traced for them. A sampled stage is always live: its PSD comes
+        # from the per-realization draws, not the batch arrays.
+        has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0)) \
+            or "chrom" in sampled
         has_ecorr = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
         has_sys = bool(np.any(np.asarray(batch.sys_psd) > 0.0))
         self._include = (("white" in include),
@@ -487,9 +702,7 @@ class EnsembleSimulator:
         # inside the kernel. Enabled by passing the config(s) — NOT gated on
         # `include` — with all-zero-scale entries skipped entirely (nothing to
         # sample), matching the skip-zero-stage convention.
-        sample_list = [] if roemer_sample is None else (
-            list(roemer_sample) if isinstance(roemer_sample, (list, tuple))
-            else [roemer_sample])
+        sample_list = _as_config_list(roemer_sample)
         self._roe_states: Tuple = ()
         self._roe_scales: Tuple = ()
         active = [(cfg, [cfg.s_mass, cfg.s_Om, cfg.s_omega, cfg.s_inc,
@@ -507,6 +720,29 @@ class EnsembleSimulator:
                                          dtype=dtype) for cfg, _ in active)
             self._roe_scales = tuple(
                 jnp.asarray(sc, dtype) for _, sc in active)
+
+        # per-realization CGW source sampling (CGWSampling, single or a
+        # sequence — one sampled source per config): epochs relative to each
+        # config's tref precomputed host-f64 and stored f32 (see the class
+        # docstring for the phase-precision bound), parameter ranges as tiny
+        # replicated (8, 2) arrays, waveforms evaluated inside the kernel
+        cgw_s_list = _as_config_list(cgw_sample)
+        self._cgw_psrterm = tuple(bool(c.psrterm) for c in cgw_s_list)
+        self._cgw_ranges = tuple(
+            jnp.asarray([list(c.costheta), list(c.phi), list(c.cosinc),
+                         list(c.log10_mc), list(c.log10_fgw), list(c.log10_h),
+                         list(c.phase0), list(c.psi)], dtype)
+            for c in cgw_s_list)
+        if cgw_s_list:
+            toas64 = _validated_toas_abs(batch, toas_abs, "cgw_sample")
+            self._cgw_trel = tuple(
+                jnp.asarray(toas64 - c.tref, dtype) for c in cgw_s_list)
+        else:
+            self._cgw_trel = ()
+        if pdist is None:
+            pdist = np.zeros((batch.npsr, 2))
+        self._pdist = jnp.asarray(
+            np.asarray(pdist, dtype=np.float64).reshape(batch.npsr, 2), dtype)
 
         # angular bins for the correlation curve (static, from positions)
         pos = np.asarray(batch.pos, dtype=np.float64)
@@ -559,23 +795,35 @@ class EnsembleSimulator:
         has_det = self._has_det
         roe_scales = self._roe_scales
         n_roe = len(self._roe_states)
+        samp_static = self._samp_static
+        cgw_psrterm = self._cgw_psrterm
+        cgw_ranges = self._cgw_ranges
 
-        def sharded(keys, batch, chol, gwb_w, det, *roe):
+        def sharded(keys, batch, chol, gwb_w, det, samp_params, cgw_trel,
+                    cgw_pdist, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, *inc)
+                                  self._gwb_freqf, *inc,
+                                  samp_static=samp_static,
+                                  samp_params=samp_params)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
                 term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
                                        tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
+            for j, psrterm in enumerate(cgw_psrterm):
+                term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
+                                    cgw_ranges[j], psrterm, tag=j)
+                res = res + jnp.where(batch.mask, term, 0.0)
             return _correlation_rows(res)
 
         roe_specs = tuple(_orbit_state_specs() for _ in range(n_roe))
+        samp_specs = tuple(P() for _ in self._samp_params)
+        cgw_trel_specs = tuple(P(PSR_AXIS) for _ in self._cgw_trel)
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS),
-                      *roe_specs),
+                      samp_specs, cgw_trel_specs, P(PSR_AXIS), *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
         roe_args = self._roe_states
@@ -586,7 +834,8 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._det, *roe_args)   # raw pair sums
+                            self._det, self._samp_params, self._cgw_trel,
+                            self._pdist, *roe_args)
             # HIGHEST: these einsums lower to matmuls, and XLA's default TPU
             # matmul rounds f32 operands to bf16 — a free-to-avoid ~4e-3
             # relative error here (the binning is a trivial fraction of the
@@ -627,15 +876,25 @@ class EnsembleSimulator:
         has_det = self._has_det
         roe_scales = self._roe_scales
         n_roe = len(self._roe_states)
+        samp_static = self._samp_static
+        cgw_psrterm = self._cgw_psrterm
+        cgw_ranges = self._cgw_ranges
 
-        def sharded(keys, batch, chol, gwb_w, weights, det, *roe):
+        def sharded(keys, batch, chol, gwb_w, weights, det, samp_params,
+                    cgw_trel, cgw_pdist, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, *inc)
+                                  self._gwb_freqf, *inc,
+                                  samp_static=samp_static,
+                                  samp_params=samp_params)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
                 term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
                                        tag=j)
+                res = res + jnp.where(batch.mask, term, 0.0)
+            for j, psrterm in enumerate(cgw_psrterm):
+                term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
+                                    cgw_ranges[j], psrterm, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
             res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
@@ -652,6 +911,8 @@ class EnsembleSimulator:
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
                       P(None, PSR_AXIS, None), P(PSR_AXIS),
+                      tuple(P() for _ in self._samp_params),
+                      tuple(P(PSR_AXIS) for _ in self._cgw_trel), P(PSR_AXIS),
                       *(tuple(_orbit_state_specs()
                               for _ in range(n_roe)))),
             out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
@@ -666,7 +927,8 @@ class EnsembleSimulator:
                 offset + jnp.arange(nreal))
             curves, autos = shmapped(keys, self.batch, self._chol, self._gwb_w,
                                      self._stat_weights, self._det,
-                                     *self._roe_states)
+                                     self._samp_params, self._cgw_trel,
+                                     self._pdist, *self._roe_states)
             # same packed single-transfer contract as the XLA step
             return pack_stats(curves, autos)
 
