@@ -33,7 +33,10 @@ def _int_key_data(seed: int) -> np.ndarray:
     backend when one exists so seeding never pays an accelerator round-trip.
     """
     try:
-        cpu = jax.devices("cpu")[0]
+        # local_devices, not devices: in a multi-process program the global
+        # list starts with process 0's devices, which other processes cannot
+        # fetch key data from
+        cpu = jax.local_devices(backend="cpu")[0]
     except RuntimeError:
         cpu = None
     if cpu is None:

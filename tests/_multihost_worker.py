@@ -1,0 +1,94 @@
+"""Worker process for the 2-process multi-host test (not a pytest module).
+
+Usage: python _multihost_worker.py <port> <process_id> <num_processes> <outdir>
+
+Joins the distributed runtime via ``initialize_multihost`` (4 virtual CPU
+devices per process), runs the full sharded ensemble program over the GLOBAL
+mesh with realization AND pulsar sharding spanning both processes, writes
+checkpoints (process 0 only, by design), and prints one JSON result line.
+
+The simulation configuration lives here, importable by the test, so the
+worker and the in-process single-host oracle can never drift apart.
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+# single source of truth for the worker AND test_multihost.py's oracle
+SIM = dict(npsr=8, ntoa=64, tspan_years=10.0, toaerr=1e-7, n_red=8, n_dm=8,
+           seed=1)
+GWB = dict(log10_A=-13.5, gamma=13 / 3, ncomp=8)
+RUN = dict(nreal=16, seed=3, chunk=8)
+PSR_SHARDS = 2
+
+
+def build_sim(mesh):
+    """The shared simulator (batch + GWB config) on the given mesh."""
+    import numpy as np
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    batch = PulsarBatch.synthetic(**SIM)
+    f = np.arange(1, GWB["ncomp"] + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=GWB["log10_A"],
+                                           gamma=GWB["gamma"]))
+    return EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                             mesh=mesh)
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from fakepta_tpu.parallel.mesh import initialize_multihost, make_mesh
+
+    port, pid, nproc, outdir = (sys.argv[1], int(sys.argv[2]),
+                                int(sys.argv[3]), pathlib.Path(sys.argv[4]))
+    initialize_multihost(f"localhost:{port}", num_processes=nproc,
+                         process_id=pid)
+    assert jax.process_count() == nproc
+
+    # global mesh: 'real' x 'psr' both span the two processes' devices
+    sim = build_sim(make_mesh(jax.devices(), psr_shards=PSR_SHARDS))
+
+    # per-process private checkpoint dir: only process 0 may create files
+    # (run() gates saves on jax.process_index())
+    my_dir = outdir / f"proc{pid}"
+    my_dir.mkdir(parents=True, exist_ok=True)
+    seen = []
+
+    def progress(done, total):
+        seen.append(sorted(p.name for p in my_dir.iterdir()))
+
+    out = sim.run(RUN["nreal"], seed=RUN["seed"], chunk=RUN["chunk"],
+                  checkpoint=str(my_dir / "ck"), progress=progress)
+
+    print(json.dumps({
+        "process": pid,
+        "nproc": jax.process_count(),
+        "ndev": len(jax.devices()),
+        "curves_sum": float(out["curves"].sum()),
+        "curves_row0": np.asarray(out["curves"][0]).tolist(),
+        "autos": np.asarray(out["autos"]).tolist(),
+        "ckpt_files_mid_run": seen,
+    }), flush=True)
+
+
+if __name__ == "__main__":
+    # env/config must precede the first jax backend use, and must NOT run on
+    # import (the test imports this module for the shared config)
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    # CPU cross-process collectives need an explicit implementation (gloo
+    # ships with jaxlib); real TPU pods use ICI/DCN and skip this knob
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    main()
