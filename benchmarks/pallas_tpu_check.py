@@ -63,19 +63,22 @@ def main():
     if pick_rt(R, PLOC, PFULL, T, NB) != 8:
         raise SystemExit("small-size pick_rt drifted; rt=8 lane no longer "
                          "covers the aligned layout")
-    for rt in (4, 8):
-        for prec, tol in (("bf16", 1e-2), ("f32", 1e-5)):
-            curves, autos = binned_correlation(
-                jnp.asarray(res_l), jnp.asarray(res_f), jnp.asarray(w),
-                nbins=NB, rt=rt, precision=prec)
-            got = np.concatenate([np.asarray(curves),
-                                  np.asarray(autos)[:, None]], axis=1)
-            scale = float(np.abs(want).max())
-            err = float(np.abs(got - want).max())
-            passed = bool(err <= tol * scale)
-            ok &= passed
-            print(json.dumps({"check": f"kernel_parity_{prec}_rt{rt}_mosaic",
-                              "passed": passed, "max_rel_err": err / scale}))
+    for mxu in (False, True):
+        for rt in (4, 8):
+            for prec, tol in (("bf16", 1e-2), ("f32", 1e-5)):
+                curves, autos = binned_correlation(
+                    jnp.asarray(res_l), jnp.asarray(res_f), jnp.asarray(w),
+                    nbins=NB, rt=rt, precision=prec, mxu_binning=mxu)
+                got = np.concatenate([np.asarray(curves),
+                                      np.asarray(autos)[:, None]], axis=1)
+                scale = float(np.abs(want).max())
+                err = float(np.abs(got - want).max())
+                passed = bool(err <= tol * scale)
+                ok &= passed
+                tag = "mxu" if mxu else "vpu"
+                print(json.dumps(
+                    {"check": f"kernel_parity_{prec}_rt{rt}_{tag}_mosaic",
+                     "passed": passed, "max_rel_err": err / scale}))
 
     # 1b. end-to-end simulator parity, XLA vs fused, at the generation-path
     # tolerance (default-precision matmuls bound both runs at ~bf16 rounding).
@@ -107,8 +110,12 @@ def main():
     nreal, chunk = 10_000, 10_000
     results = {}
     for name, kw in (("xla", dict(use_pallas=False)),
-                     ("pallas_bf16", dict(use_pallas=True,
-                                          pallas_precision="bf16"))):
+                     ("pallas_bf16_vpu", dict(use_pallas=True,
+                                              pallas_precision="bf16",
+                                              pallas_mxu_binning=False)),
+                     ("pallas_bf16_mxu", dict(use_pallas=True,
+                                              pallas_precision="bf16",
+                                              pallas_mxu_binning=True))):
         sim = EnsembleSimulator(flag, gwb=cfg, mesh=mesh, **kw)
         sim.run(chunk, seed=9, chunk=chunk)          # compile + warm
         t0 = time.perf_counter()
@@ -122,9 +129,56 @@ def main():
         print(json.dumps({"check": f"flagship_{name}",
                           "real_per_s_per_chip": round(results[name], 2)}))
     print(json.dumps({"check": "flagship_speedup_fused_vs_xla",
-                      "ratio": round(results["pallas_bf16"] / results["xla"],
-                                     3)}))
+                      "vpu_binning": round(results["pallas_bf16_vpu"]
+                                           / results["xla"], 3),
+                      "mxu_binning": round(results["pallas_bf16_mxu"]
+                                           / results["xla"], 3)}))
+    if "--crossover" in sys.argv:
+        crossover(mesh, gwb)
     sys.exit(0)
+
+
+def crossover(mesh, gwb):
+    """HBM-lean crossover sweep (VERDICT r3 weak #2): find the pulsar count
+    where the fused kernel overtakes XLA.
+
+    The XLA path materializes the (chunk, P, P) correlation tensor in HBM, so
+    its chunk must SHRINK as P grows (fixed ~4 GB correlation budget here);
+    the fused path keeps each block in VMEM and holds its chunk. Prints one
+    JSON line per (P, path) with the chunk used and realizations/s/chip.
+    """
+    import jax
+
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator
+
+    corr_budget = 4 << 30
+    for npsr in (100, 200, 400, 600):
+        batch = PulsarBatch.synthetic(npsr=npsr, ntoa=780, tspan_years=15.0,
+                                      toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+        cfg = gwb(batch, ncomp=30, log10_A=np.log10(2e-15))
+        chunk_xla = max(512, min(10_000, corr_budget // (npsr * npsr * 4)))
+        chunk_xla -= chunk_xla % 8
+        for name, chunk, kw in (
+                ("xla", chunk_xla, dict(use_pallas=False)),
+                ("pallas_bf16_mxu", 10_000, dict(use_pallas=True,
+                                                 pallas_precision="bf16",
+                                                 pallas_mxu_binning=True))):
+            try:
+                sim = EnsembleSimulator(batch, gwb=cfg, mesh=mesh, **kw)
+                nreal = 2 * chunk
+                sim.run(chunk, seed=9, chunk=chunk)
+                t0 = time.perf_counter()
+                sim.run(nreal, seed=1, chunk=chunk)
+                t = time.perf_counter() - t0
+                rate = nreal / t / len(jax.devices())
+                print(json.dumps({"check": "crossover", "npsr": npsr,
+                                  "path": name, "chunk": chunk,
+                                  "real_per_s_per_chip": round(rate, 2)}))
+            except Exception as e:    # OOM at large P is itself a datapoint
+                print(json.dumps({"check": "crossover", "npsr": npsr,
+                                  "path": name, "chunk": chunk,
+                                  "error": str(e)[:200]}))
 
 
 if __name__ == "__main__":

@@ -980,11 +980,35 @@ class Pulsar:
                   "phase0": phase0, "psi": psi, "psrterm": psrterm}
         slot = self.signal_model.setdefault("cgw", {})
         slot[str(len(slot))] = record
-        delay = cgw_model.cw_delay(
-            self.toas, self.pos, self.pdist, cos_gwtheta=costheta, gwphi=phi,
-            cos_inc=cosinc, log10_mc=log10_mc, log10_fgw=log10_fgw, evolve=True,
-            log10_h=log10_h, phase0=phase0, psi=psi, psrTerm=psrterm)
+        delay = self._cw_delay_host64(record)
         self._accumulate(delay)
+
+    def _cw_delay_host64(self, rec):
+        """Evaluate one CGW waveform at host float64, whatever the device mode.
+
+        Absolute MJD-second epochs (~4.6e9 s) quantize at ~550 s in float32 —
+        ~2e-5 rad of GW phase. The engine's construction path already
+        evaluates CGWs once at f64 on the local CPU backend
+        (:func:`parallel.montecarlo._build_deterministic`); the facade does
+        the same here so its precision does not depend on jax_enable_x64 or
+        the accelerator's dtype. Falls back to the default device when no CPU
+        backend exists.
+        """
+        from jax import enable_x64
+
+        kw = dict(cos_gwtheta=rec["costheta"], gwphi=rec["phi"],
+                  cos_inc=rec["cosinc"], log10_mc=rec["log10_mc"],
+                  log10_fgw=rec["log10_fgw"], log10_h=rec["log10_h"],
+                  phase0=rec["phase0"], psi=rec["psi"],
+                  psrTerm=rec["psrterm"], evolve=True)
+        toas = np.asarray(self.toas, dtype=np.float64)
+        pos = np.asarray(self.pos, dtype=np.float64)
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError:
+            return np.asarray(cgw_model.cw_delay(toas, pos, self.pdist, **kw))
+        with enable_x64(), jax.default_device(cpu):
+            return np.asarray(cgw_model.cw_delay(toas, pos, self.pdist, **kw))
 
     def add_deterministic(self, waveform, **kwargs):
         """Inject any user waveform ``waveform(toas=..., **kwargs)`` (ref :444-455).
@@ -1111,6 +1135,10 @@ class Pulsar:
         """
         if signals is None:
             signals = list(self.signal_model)
+        elif isinstance(signals, str):
+            # a bare name must not be iterated as characters (the reference
+            # silently no-ops on reconstruct_signal('red_noise'))
+            signals = [signals]
         # public API returns writable host numpy (reference contract); the device
         # accumulation lives in _reconstruct_signal_dev for the injectors
         return np.array(self._reconstruct_signal_dev(signals, freqf))
@@ -1121,14 +1149,11 @@ class Pulsar:
         sig = jnp.zeros(len(self.toas))
         for signal in signals:
             if signal == "cgw":
-                for record in self.signal_model["cgw"].values():
-                    sig = sig + cgw_model.cw_delay(
-                        self.toas, self.pos, self.pdist,
-                        cos_gwtheta=record["costheta"], gwphi=record["phi"],
-                        cos_inc=record["cosinc"], log10_mc=record["log10_mc"],
-                        log10_fgw=record["log10_fgw"], evolve=True,
-                        log10_h=record["log10_h"], phase0=record["phase0"],
-                        psi=record["psi"], psrTerm=record["psrterm"])
+                # absent entries contribute zero, like the GP branches below
+                for record in self.signal_model.get("cgw", {}).values():
+                    # same host-f64 evaluation as add_cgw, so remove_signal
+                    # subtracts exactly what was injected
+                    sig = sig + jnp.asarray(self._cw_delay_host64(record))
             elif signal in self._waveforms:
                 for record in self.signal_model[signal].values():
                     sig = sig + jnp.asarray(
@@ -1163,6 +1188,8 @@ class Pulsar:
         """Subtract a signal's realization and forget it (ref ``fake_pta.py:557-567``)."""
         if signals is None:
             signals = list(self.signal_model)
+        elif isinstance(signals, str):
+            signals = [signals]       # see reconstruct_signal
         self._accumulate(-self._reconstruct_signal_dev(signals, freqf=freqf))
         for signal in signals:
             self.signal_model.pop(signal, None)

@@ -591,7 +591,7 @@ class EnsembleSimulator:
                  mesh=None, include=("white", "ecorr", "red", "dm", "chrom",
                                      "sys", "gwb", "det"),
                  nbins: int = 15, use_pallas: Optional[bool] = None,
-                 pallas_precision: str = "bf16",
+                 pallas_precision: str = "bf16", pallas_mxu_binning: bool = True,
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
                  cgw_sample=None):
@@ -784,6 +784,7 @@ class EnsembleSimulator:
             raise ValueError(f"pallas_precision must be 'bf16' or 'f32', "
                              f"got {pallas_precision!r}")
         self._pallas_precision = pallas_precision
+        self._pallas_mxu_binning = bool(pallas_mxu_binning)
 
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
@@ -903,7 +904,8 @@ class EnsembleSimulator:
                          res.shape[2], nbins)
             curves_p, autos_p = binned_correlation(
                 res, res_full, weights, nbins=nbins, rt=rt, interpret=interpret,
-                precision=self._pallas_precision)
+                precision=self._pallas_precision,
+                mxu_binning=self._pallas_mxu_binning)
             # the only other collective: reduce partial bin sums over psr shards
             return (lax.psum(curves_p, PSR_AXIS), lax.psum(autos_p, PSR_AXIS))
 

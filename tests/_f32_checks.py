@@ -1,0 +1,100 @@
+"""Float32-default checks (not a pytest module) — run with x64 DISABLED.
+
+The main suite runs under jax_enable_x64=True so numpy f64 oracles compare
+exactly; that hides f32-only regressions in the device-default mode real TPUs
+run in. This script exercises the precision-sensitive public paths at strict
+float32 and prints one JSON line of measurements for test_f32_lane.py to
+assert on. Usage: python _f32_checks.py
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    assert not jax.config.jax_enable_x64, "this lane must run with x64 off"
+
+    from fakepta_tpu import constants as const
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.correlated_noises import optimal_statistic
+    from fakepta_tpu.fake_pta import Pulsar
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
+                                                 NoiseSampling)
+
+    out = {}
+
+    # 1. log-space PSD evaluation must not flush to zero at f32 (naive
+    # products pass through ~1e-42 intermediates)
+    psd = np.asarray(spectrum_lib.powerlaw(
+        np.arange(1, 31) / (15 * const.yr), log10_A=-18.0, gamma=13 / 3))
+    out["psd_min_positive"] = bool(np.all(psd > 0) and np.all(np.isfinite(psd)))
+
+    # 2. facade injection + GP reconstruction round-trip at device f32
+    toas = 53000.0 * 86400.0 + np.linspace(0, 10 * const.yr, 300)
+    p = Pulsar(toas, 1e-6, 1.0, 1.0, seed=7)
+    p.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0, seed=1)
+    p.add_dm_noise(spectrum="powerlaw", log10_A=-13.6, gamma=3.0, seed=2)
+    rec = p.reconstruct_signal()
+    res = np.asarray(p.residuals)
+    out["reconstruct_rel_err"] = float(
+        np.abs(rec - res).max() / np.abs(res).max())
+    p.add_white_noise(seed=3)
+    out["white_std"] = float(np.asarray(p.residuals).std())
+
+    # 3. facade add_cgw is routed through host float64: at f32 device mode the
+    # injected delay must still match the f64 oracle to f32 ROUNDING (~1e-7),
+    # not the ~2e-5 absolute-epoch quantization of an on-device evaluation
+    q = Pulsar(toas, 1e-6, 1.1, 0.4, seed=9, pdist=(1.0, 0.0))
+    cgw_kw = dict(costheta=0.2, phi=1.0, cosinc=0.3, log10_mc=9.2,
+                  log10_fgw=-8.0, log10_h=-13.6, phase0=0.9, psi=0.4)
+    q.add_cgw(psrterm=True, **cgw_kw)
+    oracle = np.load(sys.argv[1])["cgw"] if len(sys.argv) > 1 else None
+    got = np.asarray(q.residuals)
+    if oracle is not None:
+        out["cgw_rel_err_vs_f64_oracle"] = float(
+            np.abs(got - oracle).max() / np.abs(oracle).max())
+    # remove must invert add to f32 rounding of the residual buffer
+    q.remove_signal("cgw")
+    out["cgw_remove_residue_rel"] = float(
+        np.abs(np.asarray(q.residuals)).max() / np.abs(got).max())
+
+    # 4. ensemble GWB statistics at f32: amplitude recovery through the full
+    # sharded program (sqrt(psd) weights ~1e-7 stress f32 underflow paths)
+    batch = PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                  toaerr=1e-7, n_red=8, n_dm=8, seed=1)
+    f = np.arange(1, 9) / float(batch.tspan_common)
+    gwb_psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-13.2, gamma=13 / 3))
+    sim = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=gwb_psd, orf="hd"), include=("white", "gwb"),
+        mesh=make_mesh(jax.devices()),
+        noise_sample=NoiseSampling("gwb", log10_A=(-13.2, -13.2),
+                                   gamma=(13 / 3, 13 / 3)))
+    run = sim.run(600, seed=31, chunk=300, keep_corr=True)
+    mask = np.asarray(batch.mask, np.float64)
+    os_ = optimal_statistic(run["corr"], np.asarray(batch.pos),
+                            counts=mask @ mask.T)
+    df = np.diff(np.concatenate([[0.0], f]))
+    out["gwb_amp2_ratio"] = float(os_["amp2"].mean() / (gwb_psd * df).sum())
+    out["curves_finite"] = bool(np.all(np.isfinite(run["curves"])))
+
+    print(json.dumps(out), flush=True)
+
+
+if __name__ == "__main__":
+    os.environ.setdefault(
+        "XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", False)   # the point of this lane
+    main()

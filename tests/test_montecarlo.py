@@ -370,17 +370,19 @@ def test_pick_rt_respects_vmem_budget():
     assert pick_rt(8, 512, 1024, 8192, 15, budget_bytes=1 << 20) == 1
 
 
-def test_pallas_fused_multichip_psum():
+@pytest.mark.parametrize("mxu", [True, False])
+def test_pallas_fused_multichip_psum(mxu):
     """Fused path on the 8-device mesh (2 psr shards): psum over shards must
-    reproduce the single-device fused statistics."""
+    reproduce the single-device fused statistics — with both the MXU-matmul
+    and the legacy VPU-reduction binning variants."""
     batch = PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0, toaerr=1e-7,
                                   n_red=4, n_dm=4, seed=1)
     gwb = _gwb_cfg(batch)
     f1 = EnsembleSimulator(batch, gwb=gwb, mesh=make_mesh(jax.devices()[:1]),
-                           use_pallas=True)
+                           use_pallas=True, pallas_mxu_binning=mxu)
     f8 = EnsembleSimulator(batch, gwb=gwb,
                            mesh=make_mesh(jax.devices(), psr_shards=2),
-                           use_pallas=True)
+                           use_pallas=True, pallas_mxu_binning=mxu)
     o1 = f1.run(8, seed=2, chunk=8)
     o8 = f8.run(8, seed=2, chunk=8)
     # global-pulsar-index key folding: the two meshes draw identical noise, so

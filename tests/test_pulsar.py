@@ -182,6 +182,23 @@ def test_remove_signal(psr):
     np.testing.assert_allclose(psr.residuals, dm, rtol=1e-8, atol=1e-18)
 
 
+def test_remove_and_reconstruct_accept_bare_names(psr):
+    """A bare signal name must not be iterated as characters (silent no-op),
+    and cgw inject -> remove must invert exactly (both evaluate at host f64);
+    reconstructing an absent cgw yields zeros like the GP branches."""
+    psr.add_cgw(costheta=0.2, phi=1.0, cosinc=0.3, log10_mc=9.2,
+                log10_fgw=-8.0, log10_h=-13.6, phase0=0.9, psi=0.4,
+                psrterm=True)
+    before = np.abs(np.asarray(psr.residuals)).max()
+    assert before > 0
+    rec = psr.reconstruct_signal("cgw")          # bare string, not a list
+    np.testing.assert_allclose(rec, np.asarray(psr.residuals))
+    psr.remove_signal("cgw")
+    assert "cgw" not in psr.signal_model
+    assert np.abs(np.asarray(psr.residuals)).max() == 0.0
+    assert np.abs(psr.reconstruct_signal("cgw")).max() == 0.0
+
+
 def test_gp_covariance_oracle(psr):
     psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0)
     cov = psr.make_time_correlated_noise_cov("red_noise")
