@@ -1,0 +1,121 @@
+"""Roofline + time-attribution for the flagship ensemble step (VERDICT r3 #2).
+
+Three measurements on the live backend:
+
+1. ``jax.profiler`` trace of steady-state chunks (load into TensorBoard or
+   xprof to attribute time to the projection matmul vs the correlation
+   contraction vs the draws);
+2. XLA cost analysis of the compiled chunk program: FLOPs, bytes accessed,
+   and the arithmetic intensity, placing the program on the v5e roofline
+   (bf16 peak 197 TF/s, f32 ~half; HBM ~819 GB/s);
+3. measured realizations/s/chip with the derived achieved-TF/s and
+   achieved-GB/s, so the binding resource is explicit.
+
+    python benchmarks/roofline.py                    # flagship config
+    python benchmarks/roofline.py --npsr 100 --chunk 10000 --trace-dir /tmp/tr
+
+Prints one JSON line per measurement.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+sys.path.insert(0, str(REPO))
+
+V5E_BF16_PEAK = 197e12          # FLOP/s per chip
+V5E_HBM_BW = 819e9              # bytes/s per chip
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npsr", type=int, default=100)
+    ap.add_argument("--ntoa", type=int, default=780)
+    ap.add_argument("--chunk", type=int, default=10_000)
+    ap.add_argument("--nreal", type=int, default=100_000)
+    ap.add_argument("--trace-dir", default=None,
+                    help="write a jax.profiler trace of 2 steady chunks here")
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    n_dev = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
+                                  tspan_years=15.0, toaerr=1e-7, n_red=30,
+                                  n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                            mesh=make_mesh(jax.devices()))
+
+    # compile + warm, then measure steady state
+    sim.run(args.chunk, seed=9, chunk=args.chunk)
+    t0 = time.perf_counter()
+    out = sim.run(args.nreal, seed=1, chunk=args.chunk)
+    elapsed = time.perf_counter() - t0
+    if not np.all(np.isfinite(out["curves"])):
+        raise SystemExit("non-finite output")
+    rate = args.nreal / elapsed / n_dev
+    print(json.dumps({"measure": "throughput",
+                      "real_per_s_per_chip": round(rate, 2),
+                      "platform": jax.devices()[0].platform}))
+
+    # XLA's own cost model of one chunk program -> roofline placement
+    import jax.random as jr
+    compiled = sim._step.lower(jr.key(1), 0, args.chunk, False).compile()
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+    flops = float(ca.get("flops", 0.0))
+    bytes_acc = float(ca.get("bytes accessed", 0.0))
+    if flops > 0:
+        chunks = args.nreal / args.chunk
+        achieved_flops = flops * chunks / elapsed / n_dev
+        achieved_bw = bytes_acc * chunks / elapsed / n_dev
+        intensity = flops / max(bytes_acc, 1.0)
+        ridge = V5E_BF16_PEAK / V5E_HBM_BW      # FLOP/byte where roofline bends
+        bound = "compute" if intensity > ridge else "memory"
+        print(json.dumps({
+            "measure": "roofline",
+            "program_flops_per_chunk": flops,
+            "program_bytes_per_chunk": bytes_acc,
+            "arithmetic_intensity_flop_per_byte": round(intensity, 2),
+            "ridge_point_flop_per_byte": round(ridge, 2),
+            "bound": bound,
+            "achieved_tflops_per_chip": round(achieved_flops / 1e12, 2),
+            "mfu_vs_bf16_peak_pct": round(
+                100 * achieved_flops / V5E_BF16_PEAK, 2),
+            "achieved_hbm_gb_per_s": round(achieved_bw / 1e9, 2),
+            "hbm_utilization_pct": round(100 * achieved_bw / V5E_HBM_BW, 2),
+        }))
+    try:
+        ma = compiled.memory_analysis()
+        total = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                 + ma.output_size_in_bytes + ma.generated_code_size_in_bytes)
+        print(json.dumps({"measure": "memory",
+                          "static_reservation_gb": round(total / 2**30, 2)}))
+    except Exception:
+        pass
+
+    if args.trace_dir:
+        with jax.profiler.trace(args.trace_dir):
+            sim.run(2 * args.chunk, seed=2, chunk=args.chunk)
+        print(json.dumps({"measure": "trace", "dir": args.trace_dir}))
+
+
+if __name__ == "__main__":
+    main()

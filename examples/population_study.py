@@ -1,0 +1,113 @@
+"""Prior-marginalized GWB detection study over device ensembles.
+
+A realistic population question: given per-pulsar noise we only know to within
+broad priors, how well does the optimal statistic separate a GWB-injected
+array from a null one? The reference cannot ask this at all — every injector
+bakes one fixed PSD per call; here `NoiseSampling` redraws the red-noise
+hyperparameters of every pulsar (and the GWB amplitude in the injected
+ensemble) for every realization inside the compiled device program.
+
+    python examples/population_study.py                    # defaults
+    python examples/population_study.py --platform cpu     # no TPU needed
+    python examples/population_study.py --cgw              # add a sampled CW
+
+Prints one JSON line: the empirically-calibrated (null-ensemble) detection
+statistics under full prior marginalization.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npsr", type=int, default=40)
+    ap.add_argument("--ntoa", type=int, default=260)
+    ap.add_argument("--nreal", type=int, default=2000)
+    ap.add_argument("--chunk", type=int, default=1000)
+    ap.add_argument("--gwb-log10-A", type=float, nargs=2, default=(-14.2, -13.8),
+                    help="uniform prior on the injected GWB amplitude")
+    ap.add_argument("--red-log10-A", type=float, nargs=2, default=(-15.0, -13.5))
+    ap.add_argument("--red-gamma", type=float, nargs=2, default=(1.0, 5.0))
+    ap.add_argument("--cgw", action="store_true",
+                    help="also sample a continuous-wave source per realization")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--platform", default=None)
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fakepta_tpu import constants as const
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.correlated_noises import optimal_statistic
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (CGWSampling,
+                                                 EnsembleSimulator, GWBConfig,
+                                                 NoiseSampling)
+
+    batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
+                                  tspan_years=15.0, toaerr=1e-7,
+                                  n_red=30, n_dm=30, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    # the GWBConfig PSD sets the frequency grid; its values are replaced by
+    # the per-realization amplitude draws
+    psd = np.asarray(spectrum_lib.powerlaw(
+        f, log10_A=np.mean(args.gwb_log10_A), gamma=13 / 3))
+    mesh = make_mesh(jax.devices())
+    pos = np.asarray(batch.pos)
+    mask = np.asarray(batch.mask, dtype=np.float64)
+    counts = mask @ mask.T
+
+    red_prior = NoiseSampling("red", log10_A=tuple(args.red_log10_A),
+                              gamma=tuple(args.red_gamma))
+    extra = {}
+    if args.cgw:
+        toas_abs = np.tile(
+            53000.0 * 86400.0 + np.linspace(0.0, 15 * const.yr, args.ntoa),
+            (args.npsr, 1))
+        extra = dict(cgw_sample=CGWSampling(tref=float(toas_abs[0].mean())),
+                     toas_abs=toas_abs)
+
+    runs = {}
+    for name, gwb, samp in (
+            ("null", None, [red_prior]),
+            ("injected", GWBConfig(psd=psd, orf="hd"),
+             [red_prior, NoiseSampling("gwb",
+                                       log10_A=tuple(args.gwb_log10_A),
+                                       gamma=(13 / 3, 13 / 3))])):
+        include = ("white", "red", "dm") + (("gwb",) if gwb else ())
+        sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh,
+                                noise_sample=samp, **extra)
+        runs[name] = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
+                             keep_corr=True)["corr"]
+
+    null_os = optimal_statistic(runs["null"], pos, counts=counts)["amp2"]
+    os = optimal_statistic(runs["injected"], pos, counts=counts,
+                           null_amp2=null_os)
+    thresh = float(np.percentile(null_os, 95.0))
+    print(json.dumps({
+        "npsr": args.npsr, "nreal": args.nreal,
+        "gwb_log10_A_prior": list(args.gwb_log10_A),
+        "red_prior": {"log10_A": list(args.red_log10_A),
+                      "gamma": list(args.red_gamma)},
+        "cgw_sampled": bool(args.cgw),
+        "null_amp2_mean": float(null_os.mean()),
+        "null_sigma_empirical": float(os["sigma"]),
+        "injected_amp2_mean": float(os["amp2"].mean()),
+        "detection_significance_sigma": round(
+            float((os["amp2"].mean() - null_os.mean()) / os["sigma"]), 2),
+        "detection_rate_at_5pct_false_alarm": round(
+            float((os["amp2"] > thresh).mean()), 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -122,26 +122,32 @@ def _padded_dims(p_local: int, p_full: int, t: int):
 
 
 def pick_rt(r_local: int, p_local: int, p_full: int, t: int, nbins: int,
-            budget_bytes: int = 12 << 20) -> int:
+            budget_bytes: int = 12 << 20, mxu_binning: bool = True) -> int:
     """Largest realization tile whose VMEM working set fits the budget.
 
     Per grid step the kernel holds (rt, PL, T) + (rt, PF, T) f32 residual
-    blocks, the (nbins+1, PL, PF) weights (same bytes flattened for the MXU
-    variant), the (rt, PL*PF) flatten scratch, and the (1, rt, LANES) output
-    in VMEM (~16 MB/core on v5e; the default budget leaves headroom for
-    Mosaic's own buffers). Grid-indexed blocks (residuals, output) are counted
-    TWICE: Mosaic double-buffers them to overlap the next step's copy-in with
-    compute. At the flagship size (PL=104, PF=128, T=896 after padding) rt=16
-    demands ~27 MB — over budget — so this returns 4 there (ADVICE r1 #1).
+    blocks, the binning weights ((nbins+1, PL, PF), sublane-padded and
+    flattened for the MXU variant), the (rt, PL*PF) flatten scratch (MXU
+    variant ONLY — budgeting it for the VPU variant would shrink its tile and
+    confound the A/B comparison the legacy kernel exists for), and the
+    (1, rt, LANES) output in VMEM (~16 MB/core on v5e; the default budget
+    leaves headroom for Mosaic's own buffers). Grid-indexed blocks
+    (residuals, output) are counted TWICE: Mosaic double-buffers them to
+    overlap the next step's copy-in with compute. At the flagship size
+    (PL=104, PF=128, T=896 after padding) rt=16 demands ~27 MB — over budget
+    — so this returns 4 there (ADVICE r1 #1).
     """
     pl_pad, pf_pad, t_pad = _padded_dims(p_local, p_full, t)
-    nb8 = (nbins + 1) + (-(nbins + 1)) % SUBLANES
-    w_bytes = 4 * nb8 * pl_pad * pf_pad
+    if mxu_binning:
+        nb = (nbins + 1) + (-(nbins + 1)) % SUBLANES
+    else:
+        nb = nbins + 1
+    w_bytes = 4 * nb * pl_pad * pf_pad
     for rt in (16, 8, 4, 2, 1):
         if r_local % rt != 0:
             continue
         res_bytes = 2 * 4 * rt * (pl_pad + pf_pad) * t_pad   # double-buffered
-        scratch_bytes = 4 * rt * pl_pad * pf_pad             # mxu flatten
+        scratch_bytes = 4 * rt * pl_pad * pf_pad if mxu_binning else 0
         if (w_bytes + res_bytes + scratch_bytes
                 + 2 * 4 * rt * LANES) <= budget_bytes:
             return rt

@@ -901,7 +901,8 @@ class EnsembleSimulator:
             r_local = res.shape[0]
             # realization tile capped by the kernel's VMEM working set
             rt = pick_rt(r_local, res.shape[1], res_full.shape[1],
-                         res.shape[2], nbins)
+                         res.shape[2], nbins,
+                         mxu_binning=self._pallas_mxu_binning)
             curves_p, autos_p = binned_correlation(
                 res, res_full, weights, nbins=nbins, rt=rt, interpret=interpret,
                 precision=self._pallas_precision,

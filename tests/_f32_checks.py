@@ -18,7 +18,10 @@ def main():
 
     import jax
 
-    assert not jax.config.jax_enable_x64, "this lane must run with x64 off"
+    # not a bare assert: a -O run must not silently measure f64 behavior and
+    # report f32 safety it never tested
+    if jax.config.jax_enable_x64:
+        raise SystemExit("the f32 lane must run with jax_enable_x64 off")
 
     from fakepta_tpu import constants as const
     from fakepta_tpu import spectrum as spectrum_lib

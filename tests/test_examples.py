@@ -83,3 +83,20 @@ def test_detection_statistic_example_runs(tmp_path):
     row = json.loads(proc.stdout.strip().splitlines()[-1])
     assert row["detection_significance_sigma"] > 1.0
     assert 0.0 <= row["detection_rate_at_5pct_false_alarm"] <= 1.0
+
+
+def test_population_study_example_runs(tmp_path):
+    """Prior-marginalized study: runs as shipped with sampled red noise + GWB
+    amplitude (and a sampled CW source), empirically-calibrated detection."""
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / "population_study.py"),
+         "--platform", "cpu", "--npsr", "10", "--ntoa", "80",
+         "--nreal", "200", "--chunk", "100", "--cgw",
+         "--gwb-log10-A", "-13.4", "-13.0"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["cgw_sampled"] is True
+    assert row["detection_significance_sigma"] > 1.0
+    assert row["injected_amp2_mean"] > row["null_amp2_mean"]

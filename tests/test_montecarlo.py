@@ -368,6 +368,10 @@ def test_pick_rt_respects_vmem_budget():
     assert pick_rt(12, 8, 8, 64, 15) == 4
     # pathological budget still returns a legal tile
     assert pick_rt(8, 512, 1024, 8192, 15, budget_bytes=1 << 20) == 1
+    # the VPU variant never allocates the flatten scratch: at sizes where the
+    # scratch is what breaks the budget it must keep the larger tile
+    for args in ((64, 8, 8, 64, 15), (10_000, 100, 100, 780, 15)):
+        assert pick_rt(*args, mxu_binning=False) >= pick_rt(*args)
 
 
 @pytest.mark.parametrize("mxu", [True, False])
