@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import dataclasses
 from functools import partial
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -43,7 +43,16 @@ from .mesh import PSR_AXIS, REAL_AXIS, make_mesh, to_host
 
 @dataclasses.dataclass(frozen=True)
 class GWBConfig:
-    """Common-signal configuration for the ensemble simulator."""
+    """Common-signal configuration for the ensemble simulator.
+
+    Pass a sequence of configs to ``EnsembleSimulator(gwb=[...])`` to inject
+    several simultaneous correlated signals (HD background + clock monopole +
+    ephemeris dipole, ...) in one program — the engine analog of layering
+    facade ``add_common_correlated_noise`` calls (ref
+    ``correlated_noises.py:111-160`` run repeatedly). Config 0 keeps the
+    single-signal key stream, so adding more signals never changes existing
+    realizations; a ``NoiseSampling('gwb')`` prior applies to config 0.
+    """
 
     psd: np.ndarray                 # (C,) PSD on the common grid n/Tspan_array
     orf: str = "hd"
@@ -211,7 +220,8 @@ class RoemerSampling:
     s_l0: float = 0.0
 
 
-def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
+def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
+                    gwb_freqfs,
                     include_white, include_ecorr, include_red, include_dm,
                     include_chrom, include_sys, include_gwb,
                     samp_static=(), samp_params=()):
@@ -219,6 +229,11 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     keys: (R_local,) per-realization keys (identical across psr shards).
     batch: the *local* pulsar shard. Returns (R_local, P_local, T).
+    chols/gwb_ws: tuples, one (P, P) Cholesky + (C_j,) weight vector per
+    common correlated signal (several GWBConfigs — e.g. an HD background
+    plus a clock monopole — ride one program; config 0 keeps the original
+    key stream, so single-signal realizations are bit-identical to before).
+    gwb_idxs/gwb_freqfs: matching static tuples.
     samp_static: static tuple of (target, dist) pairs for per-realization
     hyperparameter sampling (:class:`NoiseSampling`); samp_params the matching
     traced (2, 2) [[A_a, A_b], [gamma_a, gamma_b]] arrays.
@@ -230,7 +245,7 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
 
     n_red = batch.red_psd.shape[1]
     n_dm = batch.dm_psd.shape[1]
-    n_gwb = gwb_w.shape[0]
+    n_gwbs = tuple(w.shape[0] for w in gwb_ws)
 
     red_basis = fourier_basis_norm(batch.t_own, n_red)                 # (P,T,2,NR)
     dm_scale = (1400.0 / batch.freqs) ** 2
@@ -245,14 +260,27 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
         sys_basis = fourier_basis_norm(batch.t_own, n_sys)             # (P,T,2,NS)
         sys_w = jnp.sqrt(batch.sys_psd * batch.df_own[:, None, None])  # (P,B,NS)
         n_bands = batch.sys_psd.shape[1]
-    gwb_scale = None
-    if gwb_idx:
-        gwb_scale = (gwb_freqf / batch.freqs) ** gwb_idx
-    gwb_basis = fourier_basis_norm(batch.t_common, n_gwb, scale=gwb_scale)
+    # configs sharing (idx, freqf, ncomp) share ONE basis block: the GP
+    # projection is linear in the coefficients, so their correlated draws sum
+    # per group instead of widening the (HBM-bound) fused einsum with
+    # duplicate identical bases. Draws stay per-config — streams unchanged.
+    gwb_bases, gwb_group = [], []
+    if include_gwb:
+        seen = {}
+        for idx_j, freqf_j, n_j in zip(gwb_idxs, gwb_freqfs, n_gwbs):
+            sig = (idx_j, freqf_j, n_j)
+            if sig not in seen:
+                seen[sig] = len(gwb_bases)
+                scale = None
+                if idx_j:
+                    scale = (freqf_j / batch.freqs) ** idx_j
+                gwb_bases.append(fourier_basis_norm(batch.t_common, n_j,
+                                                    scale=scale))
+            gwb_group.append(seen[sig])
 
     red_w = jnp.sqrt(batch.red_psd * batch.df_own[:, None])            # (P,NR)
     dm_w = jnp.sqrt(batch.dm_psd * batch.df_own[:, None])              # (P,ND)
-    p_total = chol.shape[0]
+    p_total = chols[0].shape[0]
 
     T = batch.t_own.shape[1]
 
@@ -272,7 +300,8 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
     if include_chrom:
         gp_bases.append(chrom_basis.reshape(p_local, T, -1))
     if include_gwb:
-        gp_bases.append(gwb_basis.reshape(p_local, T, -1))
+        for gb in gwb_bases:             # one block per (idx, freqf, n) group
+            gp_bases.append(gb.reshape(p_local, T, -1))
     gp_basis_all = jnp.concatenate(gp_bases, axis=-1) if gp_bases else None
 
     def one(key):
@@ -323,8 +352,10 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                     vals = params[:, 0] + z * params[:, 1]
                 log10_A, gamma = vals[..., 0], vals[..., 1]
                 if target == "gwb":
+                    # the sampled pair replaces CONFIG 0's PSD (multi-GWB
+                    # runs keep configs 1+ fixed)
                     df_c = 1.0 / batch.tspan_common
-                    f = jnp.arange(1, n_gwb + 1, dtype=dtype) * df_c
+                    f = jnp.arange(1, n_gwbs[0] + 1, dtype=dtype) * df_c
                     psd = spectrum_lib.powerlaw(f, log10_A=log10_A,
                                                 gamma=gamma)
                     w_samp["gwb"] = jnp.sqrt(psd * df_c)               # (C,)
@@ -369,13 +400,24 @@ def _simulate_block(keys, batch: PulsarBatch, chol, gwb_w, gwb_idx, gwb_freqf,
                 res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
         if include_gwb:
             # identical z on every psr shard (key NOT folded with pidx): the
-            # (npsr x npsr) correlation matmul is replicated, then sliced locally
-            kg = jax.random.fold_in(key, 0x6B)
-            z = jax.random.normal(kg, (2, n_gwb, p_total), dtype)
-            corr = z @ chol.T
-            corr_local = lax.dynamic_slice_in_dim(corr, pidx * p_local, p_local, axis=2)
-            c = corr_local * w_samp.get("gwb", gwb_w)[None, :, None]   # (2,C,P_loc)
-            coeffs.append(jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1))
+            # (npsr x npsr) correlation matmul is replicated, then sliced
+            # locally. Config 0 keeps the bare 0x6B key (legacy stream);
+            # further configs fold their index on top. Coefficients of
+            # configs sharing a basis group sum (projection is linear).
+            tag = jax.random.fold_in(key, 0x6B)
+            gwb_c = [None] * len(gwb_bases)
+            for j, (chol_j, w_j) in enumerate(zip(chols, gwb_ws)):
+                kg = tag if j == 0 else jax.random.fold_in(tag, j)
+                z = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
+                corr = z @ chol_j.T
+                corr_local = lax.dynamic_slice_in_dim(
+                    corr, pidx * p_local, p_local, axis=2)
+                w_eff = w_samp.get("gwb", w_j) if j == 0 else w_j
+                c = corr_local * w_eff[None, :, None]                  # (2,C,P_loc)
+                c = jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1)
+                g = gwb_group[j]
+                gwb_c[g] = c if gwb_c[g] is None else gwb_c[g] + c
+            coeffs.extend(gwb_c)
         if coeffs:
             res = res + jnp.einsum("ptk,pk->pt", gp_basis_all,
                                    jnp.concatenate(coeffs, axis=-1))
@@ -587,7 +629,8 @@ class EnsembleSimulator:
     correlation curves (the Hellings-Downs statistic) fully on device.
     """
 
-    def __init__(self, batch: PulsarBatch, gwb: Optional[GWBConfig] = None,
+    def __init__(self, batch: PulsarBatch,
+                 gwb: Optional[Union[GWBConfig, Sequence[GWBConfig]]] = None,
                  mesh=None, include=("white", "ecorr", "red", "dm", "chrom",
                                      "sys", "gwb", "det"),
                  nbins: int = 15, use_pallas: Optional[bool] = None,
@@ -620,21 +663,34 @@ class EnsembleSimulator:
         self._n_real_shards = n_real_shards
         dtype = batch.t_own.dtype
 
-        if gwb is not None and "gwb" in include:
-            orf = gwb_ops.build_orf(gwb.orf, batch.pos, gwb.h_map)
-            # orf_cholesky factorizes in host float64 (singular ORFs NaN at f32)
-            self._chol = gwb_ops.orf_cholesky(orf).astype(dtype)
-            # the common frequency grid n/Tspan is implicit in the normalized-time
-            # basis; only the bin width enters the weights
+        # ``gwb`` accepts one GWBConfig or a sequence: several simultaneous
+        # common correlated signals (e.g. HD background + clock monopole +
+        # ephemeris dipole — the facade/reference layers them with repeated
+        # add_common_correlated_noise calls) ride the same program, each with
+        # its own ORF Cholesky, PSD weights and chromatic index
+        gwb_cfgs = _as_config_list(gwb)
+        if gwb_cfgs and "gwb" in include:
             df_common = 1.0 / batch.tspan_common
-            self._gwb_w = jnp.sqrt(jnp.asarray(gwb.psd, dtype) * df_common)
-            self._gwb_idx = gwb.idx
-            self._gwb_freqf = gwb.freqf
+            chols, ws, idxs, freqfs = [], [], [], []
+            for cfg in gwb_cfgs:
+                orf = gwb_ops.build_orf(cfg.orf, batch.pos, cfg.h_map)
+                # orf_cholesky factorizes in host float64 (singular ORFs NaN
+                # at f32)
+                chols.append(gwb_ops.orf_cholesky(orf).astype(dtype))
+                # the common frequency grid n/Tspan is implicit in the
+                # normalized-time basis; only the bin width enters the weights
+                ws.append(jnp.sqrt(jnp.asarray(cfg.psd, dtype) * df_common))
+                idxs.append(cfg.idx)
+                freqfs.append(cfg.freqf)
+            self._chol = tuple(chols)
+            self._gwb_w = tuple(ws)
+            self._gwb_idx = tuple(idxs)
+            self._gwb_freqf = tuple(freqfs)
         else:
-            self._chol = jnp.eye(batch.npsr, dtype=dtype)
-            self._gwb_w = jnp.zeros((1,), dtype)
-            self._gwb_idx = 0.0
-            self._gwb_freqf = 1400.0
+            self._chol = (jnp.eye(batch.npsr, dtype=dtype),)
+            self._gwb_w = (jnp.zeros((1,), dtype),)
+            self._gwb_idx = (0.0,)
+            self._gwb_freqf = (1400.0,)
         include = tuple(include)
 
         # per-realization hyperparameter sampling (NoiseSampling, single or
@@ -656,7 +712,7 @@ class EnsembleSimulator:
             if cfg.target not in include:
                 raise ValueError(f"NoiseSampling target {cfg.target!r} needs "
                                  f"stage {cfg.target!r} in include")
-            if cfg.target == "gwb" and gwb is None:
+            if cfg.target == "gwb" and not gwb_cfgs:
                 raise ValueError("NoiseSampling('gwb') needs a GWBConfig (its "
                                  "orf/idx and psd length set the program; the "
                                  "psd values are replaced by the draws)")
@@ -680,7 +736,7 @@ class EnsembleSimulator:
                          ("red" in include),
                          ("dm" in include), ("chrom" in include and has_chrom),
                          ("sys" in include and has_sys),
-                         ("gwb" in include and gwb is not None))
+                         ("gwb" in include and bool(gwb_cfgs)))
 
         # deterministic signals (CGW sources + BayesEphem Roemer perturbations):
         # evaluated ONCE here into a (P, T) delay block that the kernel adds to
@@ -823,7 +879,9 @@ class EnsembleSimulator:
         cgw_trel_specs = tuple(P(PSR_AXIS) for _ in self._cgw_trel)
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs, P(), P(), P(PSR_AXIS),
+            in_specs=(P(REAL_AXIS), batch_specs,
+                      tuple(P() for _ in self._chol),
+                      tuple(P() for _ in self._gwb_w), P(PSR_AXIS),
                       samp_specs, cgw_trel_specs, P(PSR_AXIS), *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
@@ -912,7 +970,9 @@ class EnsembleSimulator:
 
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs, P(), P(),
+            in_specs=(P(REAL_AXIS), batch_specs,
+                      tuple(P() for _ in self._chol),
+                      tuple(P() for _ in self._gwb_w),
                       P(None, PSR_AXIS, None), P(PSR_AXIS),
                       tuple(P() for _ in self._samp_params),
                       tuple(P(PSR_AXIS) for _ in self._cgw_trel), P(PSR_AXIS),

@@ -283,9 +283,9 @@ def test_ecorr_epoch_sampler_matches_block_covariance():
         np.arange(3000))
     specs = jax.tree_util.tree_map(lambda _: P(), batch)
     f = jax.jit(jax.shard_map(
-        lambda k, b: _simulate_block(k, b, jnp.eye(2), jnp.zeros((1,)), 0.0,
-                                     1400.0, False, True, False, False, False,
-                                     False, False),
+        lambda k, b: _simulate_block(k, b, (jnp.eye(2),), (jnp.zeros((1,)),),
+                                     (0.0,), (1400.0,), False, True, False,
+                                     False, False, False, False),
         mesh=mesh1, in_specs=(P(), specs), out_specs=P(), check_vma=False))
     res = np.asarray(f(keys, batch))                 # (3000, 2, T)
     c2 = (10.0 ** log10_c) ** 2

@@ -152,6 +152,60 @@ def test_normal_dist_and_chrom_activation(batch):
     assert np.all(np.isfinite(out["autos"])) and out["autos"].mean() > 0
 
 
+def test_multi_gwb_configs_layer_in_one_program(batch):
+    """A sequence of GWBConfigs (HD background + clock monopole) must layer:
+    the ensemble-mean binned correlation equals Gamma_hd(theta) * S_hd + S_mono
+    per analytic ORF values, and config 0's stream is unchanged by adding a
+    second signal (key-compat: existing realizations never move)."""
+    mesh = make_mesh(jax.devices())
+    tspan = float(batch.tspan_common)
+    f = np.arange(1, 9) / tspan
+    df = np.diff(np.concatenate([[0.0], f]))
+    hd_cfg = _gwb_cfg(batch, log10_A=-13.2)
+    mono_psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-13.4,
+                                                gamma=13 / 3))
+    mono_cfg = GWBConfig(psd=mono_psd, orf="monopole")
+    s_hd = float((np.asarray(hd_cfg.psd) * df).sum())
+    s_mono = float((mono_psd * df).sum())
+
+    sim = EnsembleSimulator(batch, gwb=[hd_cfg, mono_cfg],
+                            include=("gwb",), mesh=mesh)
+    out = sim.run(3000, seed=17, chunk=1500)
+
+    # analytic expectation per angular bin: HD ORF value times HD power plus
+    # the monopole power (the reference's layered-injection semantics)
+    pos = np.asarray(batch.pos, np.float64)
+    ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
+    x = (1.0 - np.cos(ang)) / 2.0
+    with np.errstate(divide="ignore", invalid="ignore"):
+        hd_orf = np.where(x > 0, 1.5 * x * np.log(x) - 0.25 * x + 0.5, 1.0)
+    edges = np.linspace(0, np.pi, 16)
+    bin_idx = np.clip(np.digitize(ang, edges) - 1, 0, 14)
+    off = ~np.eye(batch.npsr, dtype=bool)
+    mean_curve = out["curves"].mean(0)
+    for b in range(15):
+        sel = off & (bin_idx == b)
+        if not sel.any():
+            continue
+        want = hd_orf[sel].mean() * s_hd + s_mono
+        got = mean_curve[b]
+        sig = out["curves"][:, b].std() / np.sqrt(out["curves"].shape[0])
+        assert abs(got - want) < 6 * sig + 0.03 * abs(want), (b, got, want)
+
+    # config-0 stream compatibility: the single-HD run's realizations are a
+    # deterministic function of the key stream; adding the monopole must not
+    # move them (check via the pure-HD run minus the analytic mono offset is
+    # NOT required — instead run single-config and compare draw-for-draw
+    # against a two-config run where the second signal has zero power)
+    zero_cfg = GWBConfig(psd=np.zeros_like(mono_psd), orf="monopole")
+    a = EnsembleSimulator(batch, gwb=hd_cfg, include=("gwb",),
+                          mesh=mesh).run(32, seed=4, chunk=16)
+    b2 = EnsembleSimulator(batch, gwb=[hd_cfg, zero_cfg], include=("gwb",),
+                           mesh=mesh).run(32, seed=4, chunk=16)
+    np.testing.assert_allclose(b2["curves"], a["curves"], rtol=2e-5,
+                               atol=1e-7 * np.abs(a["curves"]).max())
+
+
 def test_noise_sampling_validation(batch):
     mesh = make_mesh(jax.devices()[:1])
     with pytest.raises(ValueError, match="not in"):
