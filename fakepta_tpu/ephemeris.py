@@ -137,6 +137,31 @@ class Ephemeris:
         E = kepler_newton_np(mean_anom, e_t)
         return E, a_t, e_t, Om_t, varpi_t, inc_t
 
+    def do_rotation_op_to_eq(self, vec, Om, omega, inc):
+        """Rotate an in-plane vector to the equatorial frame (ref
+        ``ephemeris.py:34-47``).
+
+        Reference-parity public API: angles in DEGREES, ``vec`` of shape
+        ``(3,)`` or ``(3, N)`` with its z-component ignored (the reference's
+        rotation matrix has a zero third column). Delegates to the same
+        batched closed-form rotation ``compute_orbit`` uses.
+        """
+        vec = np.asarray(vec, dtype=np.float64)
+        out = _rotate_orbital_to_equatorial(
+            vec[0], vec[1], np.deg2rad(Om), np.deg2rad(omega),
+            np.deg2rad(inc))
+        return np.moveaxis(out, -1, 0)
+
+    def solve_kepler_equation(self, M, e):
+        """Eccentric anomalies with ``M = E - e sin E`` (ref
+        ``ephemeris.py:49-56``).
+
+        Reference-parity public API over the vectorized fixed-iteration
+        Newton solver (the reference runs a sequential per-TOA
+        ``scipy.optimize.newton`` loop).
+        """
+        return kepler_newton_np(M, e)
+
     def compute_orbit(self, times, T, Om, omega, inc, a, e, l0, mass=None):
         """Equatorial position [light-seconds] of a body at each TOA (n_toa, 3).
 

@@ -27,6 +27,40 @@ def eph():
     return Ephemeris()
 
 
+def test_reference_parity_public_methods(eph):
+    """The reference's public helpers exist by name with its conventions:
+    do_rotation_op_to_eq (degrees, (3,)/(3,N) vec, z ignored) vs an
+    independently-transcribed rotation-matrix oracle; solve_kepler_equation
+    vs the M = E - e sin E identity (scalar-e broadcasting like the ref)."""
+    rng = np.random.default_rng(4)
+    ec = 23.43928 * np.pi / 180
+    for shape in ((3,), (3, 7)):
+        vec = rng.standard_normal(shape)
+        Om_d, om_d, inc_d = 47.3, 112.9, 3.4
+        Om, om, inc = (np.deg2rad(v) for v in (Om_d, om_d, inc_d))
+        rot = np.array([
+            [np.cos(Om) * np.cos(om) - np.sin(Om) * np.cos(inc) * np.sin(om),
+             -np.cos(Om) * np.sin(om) - np.sin(Om) * np.cos(inc) * np.cos(om),
+             0.0],
+            [np.sin(Om) * np.cos(om) + np.cos(Om) * np.cos(inc) * np.sin(om),
+             -np.sin(Om) * np.sin(om) + np.cos(Om) * np.cos(inc) * np.cos(om),
+             0.0],
+            [np.sin(inc) * np.sin(om), np.sin(inc) * np.cos(om), 0.0]])
+        rot_ec = np.array([[1.0, 0.0, 0.0],
+                           [0.0, np.cos(ec), -np.sin(ec)],
+                           [0.0, np.sin(ec), np.cos(ec)]])
+        want = rot_ec @ (rot @ vec)
+        got = eph.do_rotation_op_to_eq(vec, Om_d, om_d, inc_d)
+        np.testing.assert_allclose(got, want, rtol=1e-12, atol=1e-15)
+
+    E_true = rng.uniform(0, 2 * np.pi, 200)
+    e = 0.18
+    M = E_true - e * np.sin(E_true)
+    E = eph.solve_kepler_equation(M, e)
+    np.testing.assert_allclose(np.mod(E, 2 * np.pi),
+                               np.mod(E_true, 2 * np.pi), atol=1e-10)
+
+
 def test_planet_table(eph):
     assert eph.planet_names == ["mercury", "venus", "earth", "mars", "jupiter",
                                 "saturn", "uranus", "neptune"]
