@@ -40,6 +40,9 @@ def main():
     ap.add_argument("--nreal", type=int, default=100_000)
     ap.add_argument("--trace-dir", default=None,
                     help="write a jax.profiler trace of 2 steady chunks here")
+    ap.add_argument("--bases-bf16", action="store_true",
+                    help="store the GP projection basis in bfloat16 (half "
+                         "the projection HBM traffic; ~4e-3 operand rounding)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -61,7 +64,8 @@ def main():
     psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
                                            gamma=13 / 3))
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
-                            mesh=make_mesh(jax.devices()))
+                            mesh=make_mesh(jax.devices()),
+                            bases_dtype="bf16" if args.bases_bf16 else "f32")
 
     # compile + warm, then measure steady state
     sim.run(args.chunk, seed=9, chunk=args.chunk)

@@ -224,7 +224,7 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                     gwb_freqfs,
                     include_white, include_ecorr, include_red, include_dm,
                     include_chrom, include_sys, include_gwb,
-                    samp_static=(), samp_params=()):
+                    samp_static=(), samp_params=(), bases_bf16=False):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -303,6 +303,14 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
         for gb in gwb_bases:             # one block per (idx, freqf, n) group
             gp_bases.append(gb.reshape(p_local, T, -1))
     gp_basis_all = jnp.concatenate(gp_bases, axis=-1) if gp_bases else None
+    if bases_bf16 and gp_basis_all is not None:
+        # bf16 basis storage halves the projection's HBM reads. On TPU this
+        # costs ~nothing numerically: XLA's DEFAULT matmul precision already
+        # rounds f32 operands to bf16 for the MXU, so the kernel consumes the
+        # same bits either way (accumulation stays f32 via
+        # preferred_element_type). ~4e-3 relative operand rounding, same
+        # bound as the corr contraction tolerates.
+        gp_basis_all = gp_basis_all.astype(jnp.bfloat16)
 
     def one(key):
         # noise keys fold by GLOBAL pulsar index, so realization streams are
@@ -419,8 +427,11 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                 gwb_c[g] = c if gwb_c[g] is None else gwb_c[g] + c
             coeffs.extend(gwb_c)
         if coeffs:
-            res = res + jnp.einsum("ptk,pk->pt", gp_basis_all,
-                                   jnp.concatenate(coeffs, axis=-1))
+            c_all = jnp.concatenate(coeffs, axis=-1)
+            if bases_bf16:
+                c_all = c_all.astype(jnp.bfloat16)
+            res = res + jnp.einsum("ptk,pk->pt", gp_basis_all, c_all,
+                                   preferred_element_type=dtype)
         return jnp.where(batch.mask, res, 0.0)
 
     return jax.vmap(one)(keys)
@@ -635,6 +646,7 @@ class EnsembleSimulator:
                                      "sys", "gwb", "det"),
                  nbins: int = 15, use_pallas: Optional[bool] = None,
                  pallas_precision: str = "bf16", pallas_mxu_binning: bool = True,
+                 bases_dtype: str = "f32",
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
                  cgw_sample=None):
@@ -841,6 +853,15 @@ class EnsembleSimulator:
                              f"got {pallas_precision!r}")
         self._pallas_precision = pallas_precision
         self._pallas_mxu_binning = bool(pallas_mxu_binning)
+        if bases_dtype not in ("f32", "bf16"):
+            raise ValueError(f"bases_dtype must be 'f32' or 'bf16', got "
+                             f"{bases_dtype!r}")
+        # 'bf16' stores the concatenated GP projection basis (and the
+        # coefficient operand) in bfloat16 — half the HBM traffic of the
+        # projection einsum at the same effective MXU operand precision as
+        # XLA's TPU default (accumulation stays f32); realizations shift by
+        # the ~4e-3 operand rounding
+        self._bases_bf16 = bases_dtype == "bf16"
 
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
@@ -861,7 +882,8 @@ class EnsembleSimulator:
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc,
                                   samp_static=samp_static,
-                                  samp_params=samp_params)
+                                  samp_params=samp_params,
+                                  bases_bf16=self._bases_bf16)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
@@ -944,7 +966,8 @@ class EnsembleSimulator:
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc,
                                   samp_static=samp_static,
-                                  samp_params=samp_params)
+                                  samp_params=samp_params,
+                                  bases_bf16=self._bases_bf16)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):

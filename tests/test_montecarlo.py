@@ -404,6 +404,23 @@ def test_pallas_fused_multichip_psum(mxu):
     np.testing.assert_allclose(o8["autos"], r8["autos"], rtol=1e-2)
 
 
+def test_bf16_bases_parity_and_validation(small_batch):
+    """bases_dtype='bf16' halves the projection basis HBM footprint; the
+    statistics must sit within the documented ~4e-3 operand-rounding bound
+    of the f32-basis run (same draws, same keys)."""
+    cfg = _gwb_cfg(small_batch)
+    mesh = make_mesh(jax.devices()[:1])
+    a = EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh).run(
+        32, seed=5, chunk=16)
+    b = EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh,
+                          bases_dtype="bf16").run(32, seed=5, chunk=16)
+    scale = np.abs(a["curves"]).max()
+    assert np.abs(b["curves"] - a["curves"]).max() < 2e-2 * scale
+    np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-2)
+    with pytest.raises(ValueError, match="bases_dtype"):
+        EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh, bases_dtype="fp8")
+
+
 def test_system_noise_band_masked_and_scaled():
     """from_pulsars turns '<backend>_system_noise_<backend>' entries into masked
     GP bands: variance lands only on that backend's TOAs and matches sum(psd*df)."""
