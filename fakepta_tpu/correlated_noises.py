@@ -97,7 +97,8 @@ def optimal_statistic(corr, pos, orf="hd", sigma2=None, counts=None,
     sigma2 : (P,) per-pulsar noise autocorrelation used in the weights;
         defaults to the ensemble-mean diagonal of ``corr`` (a null-consistent
         estimate when the cross power is weak).
-    counts : (P, P) valid-pair TOA counts (``mask @ mask.T``); defaults to 1.
+    counts : (P, P) valid-pair TOA counts (``mask @ mask.T``, available
+        precomputed as ``EnsembleSimulator.pair_counts``); defaults to 1.
         Note the default makes the *analytic* ``sigma`` (and thus ``snr``)
         miscalibrated by ~sqrt(N_toa) and not comparable across runs with
         different TOA counts — a warning is emitted unless an empirical

@@ -831,7 +831,14 @@ class EnsembleSimulator:
         # from the shard_map body and matches how the fused Pallas path already
         # normalizes (measured perf-neutral: XLA was fusing the division).
         mask_np = np.asarray(batch.mask, dtype=np.float64)
-        counts_full = np.maximum(mask_np @ mask_np.T, 1.0)
+        raw_counts = mask_np @ mask_np.T
+        # public: the RAW valid-pair TOA counts optimal_statistic wants as its
+        # `counts` argument (ADVICE r3: single-source them with the engine).
+        # Unclamped on purpose — a zero count is how the statistic knows to
+        # zero-weight an empty pair; the clamp below exists only so the
+        # internal weight normalization never divides by zero.
+        self.pair_counts = raw_counts
+        counts_full = np.maximum(raw_counts, 1.0)
         bc = np.maximum(onehot.sum((0, 1)), 1.0)
         self._w_bins = jnp.asarray(
             onehot / counts_full[:, :, None] / bc, dtype)

@@ -507,9 +507,11 @@ def test_optimal_statistic_calibration(small_batch):
     cfg = _gwb_cfg(small_batch, log10_A=-13.0)
 
     mesh = make_mesh(jax.devices()[:1])
-    null = EnsembleSimulator(small_batch, gwb=None, include=("white",),
-                             mesh=mesh).run(600, seed=31, chunk=300,
-                                            keep_corr=True)
+    null_sim = EnsembleSimulator(small_batch, gwb=None, include=("white",),
+                                 mesh=mesh)
+    # the engine exposes the same (raw, unclamped) counts precomputed
+    np.testing.assert_array_equal(null_sim.pair_counts, counts)
+    null = null_sim.run(600, seed=31, chunk=300, keep_corr=True)
     os_null = optimal_statistic(null["corr"], pos, counts=counts)
     assert abs(os_null["snr"].mean()) < 0.2
     assert 0.6 < os_null["snr"].std() < 1.5
