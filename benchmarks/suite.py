@@ -1,6 +1,7 @@
 """Benchmark suite for the BASELINE.md configs (1-5 from BASELINE.json, plus
 6: config 4 as one device program, 7: the full-noise ECORR/system ensemble,
-8: the flagship with per-realization hyperparameter sampling).
+8: the flagship with per-realization hyperparameter sampling, 9: the flagship
+with a per-realization sampled CW source).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -22,6 +23,14 @@ import numpy as np
 
 REPO = Path(__file__).resolve().parents[1]
 sys.path.insert(0, str(REPO))
+
+
+def _flagship_toas_abs(batch):
+    """(npsr, ntoa) absolute MJD-second epochs matching a synthetic batch's
+    uniform-cadence grid (span derived from the batch, not re-hardcoded)."""
+    npsr, ntoa = batch.t_own.shape
+    span = float(batch.tspan_common)
+    return np.tile(53000.0 * 86400.0 + np.linspace(0.0, span, ntoa), (npsr, 1))
 
 
 def _timeit(fn, repeats=3):
@@ -112,7 +121,6 @@ def config6():
     realizations — no per-pulsar host loop anywhere."""
     import jax
 
-    from fakepta_tpu import constants as const
     from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
@@ -126,8 +134,7 @@ def config6():
     f = np.arange(1, 31) / float(batch.tspan_common)
     psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
                                            gamma=13 / 3))
-    toas_abs = np.tile(53000.0 * 86400.0
-                       + np.linspace(0.0, 15 * const.yr, ntoa), (npsr, 1))
+    toas_abs = _flagship_toas_abs(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"),
         include=("white", "dm", "gwb", "det"),
@@ -227,6 +234,43 @@ def config8():
             "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
 
 
+def config9():
+    """Flagship + per-realization CW-source sampling (CGWSampling): every
+    realization draws a full circular-SMBHB source (sky, chirp mass,
+    frequency, strain, phase, polarization) and evaluates the evolving
+    waveform on device, on top of the HD GWB + white + red + DM program —
+    the continuous-wave population workload the reference cannot express."""
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (CGWSampling,
+                                                 EnsembleSimulator, GWBConfig)
+
+    n_dev = len(jax.devices())
+    npsr, ntoa = 100, 780
+    batch = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    toas_abs = _flagship_toas_abs(batch)
+    sim = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
+        cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
+        toas_abs=toas_abs)
+    nreal, chunk = 40_000, 4000
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    return {"config": 9,
+            "metric": "CW-population realizations/s/chip (100 psr, sampled "
+                      "SMBHB source per realization)",
+            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -288,7 +332,7 @@ def config5():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
-                    default=[1, 2, 3, 4, 5, 6, 7, 8])
+                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     args = ap.parse_args()
@@ -298,7 +342,7 @@ def main():
     import jax
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8}
+           6: config6, 7: config7, 8: config8, 9: config9}
     rows = []
     for c in args.configs:
         row = fns[c]()
