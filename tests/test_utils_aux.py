@@ -69,6 +69,48 @@ def test_checkpoint_resume_is_identical(sim, tmp_path):
     assert not ck.exists()   # removed on success
 
 
+def test_checkpoint_resume_identical_with_sampling(tmp_path):
+    """Resume identity must hold for per-realization sampling too: sampled
+    hyperparameters and CW sources derive from fold_in(base, absolute_index),
+    so the resumed stream replays the exact draws of an uninterrupted run."""
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.parallel.montecarlo import (CGWSampling, GWBConfig,
+                                                 NoiseSampling)
+
+    batch = PulsarBatch.synthetic(npsr=4, ntoa=48, tspan_years=10.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=3)
+    f = np.arange(1, 5) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-13.5, gamma=13 / 3))
+    toas_abs = np.tile(53000.0 * 86400.0
+                       + np.linspace(0, 10 * const.yr, 48), (4, 1))
+    s = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"),
+        mesh=make_mesh(jax.devices()[:1]),
+        noise_sample=[NoiseSampling("red", log10_A=(-14.5, -13.5),
+                                    gamma=(2.0, 5.0)),
+                      NoiseSampling("gwb", log10_A=(-14.0, -13.2),
+                                    gamma=(13 / 3, 13 / 3))],
+        cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
+        toas_abs=toas_abs)
+    ck = tmp_path / "mc.npz"
+    full = s.run(24, seed=5, chunk=8)
+
+    class Stop(Exception):
+        pass
+
+    def boom(done, nreal):
+        if done >= 16:
+            raise Stop
+
+    with pytest.raises(Stop):
+        s.run(24, seed=5, chunk=8, checkpoint=ck, progress=boom)
+    assert ck.exists(), "interruption must leave a checkpoint behind"
+    resumed = s.run(24, seed=5, chunk=8, checkpoint=ck)
+    np.testing.assert_array_equal(resumed["curves"], full["curves"])
+    np.testing.assert_array_equal(resumed["autos"], full["autos"])
+    assert not ck.exists()   # removed on success
+
+
 def test_checkpoint_mismatched_run_rejected(sim, tmp_path):
     ck = tmp_path / "mc.npz"
     class Stop(Exception):
