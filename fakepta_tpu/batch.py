@@ -295,6 +295,38 @@ def padded_abs_toas(psrs: Sequence) -> np.ndarray:
     return toas_pad
 
 
+def padded_toaerr2(psrs: Sequence) -> np.ndarray:
+    """(npsr, max_toa) raw squared TOA errors [s^2], zero-padded.
+
+    Companion to :meth:`PulsarBatch.from_pulsars` for per-realization white
+    sampling (``WhiteSampling``): the batch's ``sigma2`` bakes the noisedict's
+    efac/equad in (``fake_pta.py:214-217`` semantics), while the sampler needs
+    the raw errors the drawn efac multiplies.
+    """
+    err2, _ = stack_ragged(
+        [np.asarray(p.toaerrs, dtype=np.float64) ** 2 for p in psrs])
+    return err2
+
+
+def padded_backend_ids(psrs: Sequence):
+    """((npsr, max_toa) int32 backend index, n_backends) from backend flags.
+
+    Backend names index into each pulsar's own sorted unique flag set (the
+    sampler draws per (pulsar, backend), so ids need not align across
+    pulsars); padding TOAs get id 0. ``n_backends`` is the max count over the
+    array — the static draw width ``WhiteSampling`` needs.
+    """
+    ids = []
+    n_backends = 1
+    for p in psrs:
+        flags = np.asarray(p.backend_flags)
+        uniq, idx = np.unique(flags, return_inverse=True)
+        n_backends = max(n_backends, len(uniq))
+        ids.append(idx.astype(np.int32))
+    bid, _ = stack_ragged(ids)
+    return bid.astype(np.int32), n_backends
+
+
 def padded_pdist(psrs: Sequence) -> np.ndarray:
     """(npsr, 2) pulsar-distance (mean, sigma) pairs in kpc.
 

@@ -104,38 +104,51 @@ class RoemerConfig:
 
 @dataclasses.dataclass(frozen=True)
 class NoiseSampling:
-    """Per-realization power-law hyperparameter sampling for a GP stage.
+    """Per-realization spectrum hyperparameter sampling for a GP stage.
 
     The parameters PTA population studies actually marginalize — noise
-    amplitudes and spectral slopes — drawn fresh for every realization
-    *inside* the device program:
+    amplitudes, spectral slopes, turnover frequencies, per-bin free-spectrum
+    powers — drawn fresh for every realization *inside* the device program:
 
-    - ``target='red' | 'dm' | 'chrom'``: each pulsar draws an independent
-      ``(log10_A, gamma)`` pair per realization (population marginalization
-      over per-pulsar noise uncertainty); the sampled power-law PSD replaces
-      the batch's fixed ``<target>_psd`` for that stage.
-    - ``target='gwb'``: ONE global ``(log10_A, gamma)`` pair per realization
-      (the background is common); replaces ``GWBConfig.psd``. The ORF and
-      chromatic index still come from ``GWBConfig``.
+    - ``target='red' | 'dm' | 'chrom'``: each pulsar draws independent
+      hyperparameters per realization (population marginalization over
+      per-pulsar noise uncertainty); the sampled PSD replaces the batch's
+      fixed ``<target>_psd`` for that stage.
+    - ``target='gwb'``: ONE global draw per realization (the background is
+      common); replaces ``GWBConfig.psd``. The ORF and chromatic index still
+      come from ``GWBConfig``.
 
-    ``log10_A`` / ``gamma`` are ``(a, b)`` pairs: ``dist='uniform'`` draws
+    ``spectrum`` names any registered PSD model (the same registry every
+    facade injector resolves, honoring the reference's plugin contract
+    ``fake_pta.py:272-277`` per realization); ``params`` maps hyperparameter
+    names to ``(a, b)`` ranges. Parameters not sampled keep the model's
+    defaults. ``log10_A`` / ``gamma`` remain as convenience kwargs for the
+    power-law case (merged into ``params``). Per-frequency parameters
+    (``log10_rho``, ``alphas``) draw one independent value per bin.
+
+    Ranges follow the ``(a, b)`` convention: ``dist='uniform'`` draws
     ``U(a, b)`` (the reference's population convention — ``make_fake_array``
     draws log10_A ~ U(-17, -13), gamma ~ U(1, 5), ``fake_pta.py:653-667`` —
     but per *array construction*, never per realization; the reference cannot
     vary anything inside a loop); ``dist='normal'`` draws ``N(mean=a, std=b)``.
-    Zero-width ranges pin the parameter.
+    Zero-width ranges pin the parameter. ``dist`` may also be a mapping
+    ``{param: 'uniform'|'normal'}`` (unlisted params default to uniform).
 
     Stream discipline matches every other stage: draws fold the realization
     key with a dedicated domain tag and (for per-pulsar targets) the *global*
     pulsar index, so realizations are bit-identical on any mesh shape and the
     coefficient/white/GWB streams are untouched — a run with a zero-width
-    sampling range reproduces the fixed-PSD run's statistics exactly.
+    sampling range reproduces the fixed-PSD run's statistics exactly. The
+    all-uniform power-law case keeps the original (log10_A, gamma) draw
+    layout, so existing realizations never move.
     """
 
     target: str
-    log10_A: Tuple[float, float]
-    gamma: Tuple[float, float]
-    dist: str = "uniform"
+    log10_A: Optional[Tuple[float, float]] = None
+    gamma: Optional[Tuple[float, float]] = None
+    dist: Union[str, dict] = "uniform"
+    spectrum: str = "powerlaw"
+    params: Optional[dict] = None
 
 
 # domain tag for hyperparameter sampling keys (cf. 0x51 noise / 0x6B gwb /
@@ -145,6 +158,49 @@ _HYPER_SUBTAG = {"red": 0, "dm": 1, "chrom": 2, "gwb": 3}
 
 # domain tag for per-realization CGW source sampling
 _CGW_TAG = 0xC6
+
+# domain tag for per-realization white-noise/ECORR hyperparameter sampling
+_WHITE_TAG = 0xE1
+
+
+@dataclasses.dataclass(frozen=True)
+class WhiteSampling:
+    """Per-realization white-noise/ECORR hyperparameter sampling.
+
+    Each realization draws an independent ``(efac, log10_tnequad[,
+    log10_ecorr])`` triple per (pulsar, backend) *inside* the device program
+    and rebuilds the white variance ``sigma^2 = efac^2 toaerr^2 +
+    10^(2 log10_tnequad)`` from the raw TOA errors — the population prior the
+    reference's ``randomize=True`` draws once per *injection call* on the host
+    (``fake_pta.py:203-210``: efac ~ U(0.5, 2.5), log10_tnequad ~ U(-8, -5),
+    log10_ecorr ~ U(-10, -7) — the defaults here), never per realization.
+
+    ``(a, b)`` ranges follow :class:`NoiseSampling`'s convention:
+    ``dist='uniform'`` draws U(a, b), ``dist='normal'`` draws N(mean=a,
+    std=b); zero-width pins the parameter. A range of ``None`` pins the
+    parameter at its neutral value instead: efac=1, no EQUAD contribution,
+    and (for ecorr) the batch's fixed ``ecorr_amp``. When ``log10_ecorr`` is
+    sampled, the drawn per-backend amplitude replaces ``ecorr_amp`` wherever
+    the batch has ECORR active (padding TOAs and single-TOA epochs stay
+    excluded, matching the facade and reference ``fake_pta.py:223-224``).
+
+    The sampled variance replaces the batch's fixed ``sigma2`` for the white
+    stage; the raw squared TOA errors and the (pulsar, backend) partition come
+    from ``EnsembleSimulator(toaerr2=..., backend_id=...)`` (see
+    :func:`fakepta_tpu.batch.padded_toaerr2` /
+    :func:`~fakepta_tpu.batch.padded_backend_ids`).
+
+    Stream discipline matches every other sampled stage: draws fold the
+    realization key with the 0xE1 domain tag and the *global* pulsar index, so
+    realizations are mesh-shape independent and the white/ECORR coefficient
+    streams (``kw``/``ke``) are untouched — zero-width ranges matching the
+    batch's fixed values reproduce the fixed run exactly.
+    """
+
+    efac: Optional[Tuple[float, float]] = (0.5, 2.5)
+    log10_tnequad: Optional[Tuple[float, float]] = (-8.0, -5.0)
+    log10_ecorr: Optional[Tuple[float, float]] = None
+    dist: str = "uniform"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -170,8 +226,27 @@ class CGWSampling:
     Pass ``tref`` near the data span's midpoint to shrink it further (~1e-6
     rad); ``phase0`` is then referenced at ``tref``.
 
-    ``psrterm=True`` uses the simulator's ``pdist`` means (the distance-draw
-    nuisance ``p_dist`` is 0, as in the facade's default). Note the pulsar
+    Amplitude modes: ``log10_h`` samples the strain directly (the default);
+    giving a ``log10_dist`` range instead samples the luminosity distance in
+    log10(Mpc) — the physical population prior. ``log10_dist`` takes
+    precedence here (``log10_h`` carries a default range, so its mere
+    presence cannot signal intent — the opposite of the fixed
+    ``CGWConfig``/``cw_delay`` contract, where both default to None and an
+    explicit ``log10_h`` wins). Pass ``log10_h=None`` to make the choice
+    explicit.
+
+    ``dist`` selects the draw family per parameter: one string for all, or a
+    mapping ``{param: 'uniform'|'normal'}`` (unlisted default to uniform).
+    ``'uniform'`` reads the ``(a, b)`` range as U(a, b); ``'normal'`` as
+    N(mean=a, std=b). The all-uniform case keeps the original draw layout,
+    so existing realizations never move.
+
+    ``psrterm=True`` uses the simulator's ``pdist`` means; with
+    ``sample_pdist=True`` each pulsar additionally draws its distance
+    nuisance ``p_dist ~ N(0, 1)`` (in units of its ``pdist`` sigma, the
+    convention the pulsar term's ``pdist=(mean, sigma)`` contract implies,
+    ref ``fake_pta.py:436-441``) per realization — keys fold the global
+    pulsar index, so streams stay mesh-shape independent. Note the pulsar
     term's retarded phase is ~omega L/c ~ 1e3-1e4 rad: at f32 its absolute
     rounding is ~2e-4 rad, so realizations reproduce across mesh shapes only
     to ~1e-4 relative (compiler op-ordering changes the rounding). That is
@@ -185,10 +260,13 @@ class CGWSampling:
     cosinc: Tuple[float, float] = (-1.0, 1.0)
     log10_mc: Tuple[float, float] = (8.5, 9.5)
     log10_fgw: Tuple[float, float] = (-8.5, -7.5)
-    log10_h: Tuple[float, float] = (-14.5, -13.5)
+    log10_h: Optional[Tuple[float, float]] = (-14.5, -13.5)
+    log10_dist: Optional[Tuple[float, float]] = None
     phase0: Tuple[float, float] = (0.0, 2.0 * np.pi)
     psi: Tuple[float, float] = (0.0, np.pi)
     psrterm: bool = False
+    sample_pdist: bool = False
+    dist: Union[str, dict] = "uniform"
     tref: float = 0.0
 
 
@@ -224,7 +302,9 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                     gwb_freqfs,
                     include_white, include_ecorr, include_red, include_dm,
                     include_chrom, include_sys, include_gwb,
-                    samp_static=(), samp_params=(), bases_bf16=False):
+                    samp_static=(), samp_params=(), bases_bf16=False,
+                    white_static=None, white_params=None, white_toaerr2=None,
+                    white_bid=None, white_nb=1):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -237,6 +317,11 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
     samp_static: static tuple of (target, dist) pairs for per-realization
     hyperparameter sampling (:class:`NoiseSampling`); samp_params the matching
     traced (2, 2) [[A_a, A_b], [gamma_a, gamma_b]] arrays.
+    white_static: static (sample_efac, sample_equad, sample_ecorr, dist) for
+    per-realization white sampling (:class:`WhiteSampling`); white_params the
+    traced (3, 2) range array, white_toaerr2/white_bid the local (P, T) raw
+    squared TOA errors and int32 backend partition, white_nb the static
+    backend count.
     """
     from .. import spectrum as spectrum_lib
     p_local = batch.t_own.shape[0]
@@ -333,59 +418,124 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                 lambda k: jax.random.normal(k, shape, dtype))(keys_p)
 
         # per-realization hyperparameter sampling (NoiseSampling): sampled
-        # power-law weights replace the fixed precomputed ones for their
+        # spectrum weights replace the fixed precomputed ones for their
         # stage. Keys live in their own 0x9C domain + per-target subtag, so
         # the coefficient/white/GWB streams above are byte-identical whether
         # or not sampling is on. Per-pulsar targets fold the GLOBAL index
-        # (mesh-shape independent); the 'gwb' pair is one global draw (the
-        # background is common), identical on every psr shard.
+        # (mesh-shape independent); 'gwb' draws are global (the background is
+        # common), identical on every psr shard. The all-uniform scalar draw
+        # rides ONE uniform vector in declaration order (the legacy
+        # (log10_A, gamma) layout), normal scalars a sibling subkey, per-bin
+        # parameters (free-spectrum rho, t-process alphas) their own per-bin
+        # subkeys — so the power-law stream is unchanged from before the
+        # generalization.
         w_samp = {}
         if samp_static:
             hyper_root = jax.random.fold_in(key, _HYPER_TAG)
-            for (target, dist), params in zip(samp_static, samp_params):
+            for (target, spectrum, names, per_bin, dists), params in zip(
+                    samp_static, samp_params):
                 kt = jax.random.fold_in(hyper_root, _HYPER_SUBTAG[target])
                 per_psr = target != "gwb"
-                if per_psr:
-                    kts = jax.vmap(lambda g, k=kt: jax.random.fold_in(k, g))(gidx)
-                    z = jax.vmap(lambda k: (
-                        jax.random.uniform(k, (2,), dtype) if dist == "uniform"
-                        else jax.random.normal(k, (2,), dtype)))(kts)   # (P,2)
-                else:
-                    z = (jax.random.uniform(kt, (2,), dtype)
-                         if dist == "uniform"
-                         else jax.random.normal(kt, (2,), dtype))      # (2,)
-                if dist == "uniform":
-                    vals = params[:, 0] + z * (params[:, 1] - params[:, 0])
-                else:
-                    vals = params[:, 0] + z * params[:, 1]
-                log10_A, gamma = vals[..., 0], vals[..., 1]
                 if target == "gwb":
-                    # the sampled pair replaces CONFIG 0's PSD (multi-GWB
-                    # runs keep configs 1+ fixed)
-                    df_c = 1.0 / batch.tspan_common
-                    f = jnp.arange(1, n_gwbs[0] + 1, dtype=dtype) * df_c
-                    psd = spectrum_lib.powerlaw(f, log10_A=log10_A,
-                                                gamma=gamma)
-                    w_samp["gwb"] = jnp.sqrt(psd * df_c)               # (C,)
+                    nbin = n_gwbs[0]
                 else:
                     nbin = {"red": n_red, "dm": n_dm}.get(target)
                     if nbin is None:
                         nbin = batch.chrom_psd.shape[1]
-                    f = (jnp.arange(1, nbin + 1, dtype=dtype)
-                         * batch.df_own[:, None])                      # (P,N)
-                    psd = spectrum_lib.powerlaw(f, log10_A=log10_A[:, None],
-                                                gamma=gamma[:, None])
-                    w_samp[target] = jnp.sqrt(psd * batch.df_own[:, None])
+                n_scalar = sum(1 for pb in per_bin if not pb)
+                any_norm = any(d == "normal" for pb, d in zip(per_bin, dists)
+                               if not pb)
+
+                def draw_cfg(k, nbin=nbin, names=names, per_bin=per_bin,
+                             dists=dists, params=params, n_scalar=n_scalar,
+                             any_norm=any_norm):
+                    """name -> sampled value for ONE key: scalars (), bins (N,)."""
+                    u = (jax.random.uniform(k, (n_scalar,), dtype)
+                         if n_scalar else None)
+                    g = (jax.random.normal(jax.random.fold_in(k, 1),
+                                           (n_scalar,), dtype)
+                         if any_norm else None)
+                    out = {}
+                    zi = 0
+                    for i, (name, pb) in enumerate(zip(names, per_bin)):
+                        a, b = params[i, 0], params[i, 1]
+                        if pb:
+                            kb = jax.random.fold_in(k, 16 + i)
+                            z = (jax.random.uniform(kb, (nbin,), dtype)
+                                 if dists[i] == "uniform"
+                                 else jax.random.normal(kb, (nbin,), dtype))
+                        else:
+                            z = u[zi] if dists[i] == "uniform" else g[zi]
+                            zi += 1
+                        out[name] = a + z * ((b - a) if dists[i] == "uniform"
+                                             else b)
+                    return out
+
+                if per_psr:
+                    kts = jax.vmap(
+                        lambda g, k=kt: jax.random.fold_in(k, g))(gidx)
+                    vals = jax.vmap(draw_cfg)(kts)  # (P,) scalars, (P,N) bins
+                    df = batch.df_own[:, None]                          # (P,1)
+                    kwargs = {n: (vals[n] if pb else vals[n][:, None])
+                              for n, pb in zip(names, per_bin)}
+                else:
+                    vals = draw_cfg(kt)
+                    df = 1.0 / batch.tspan_common
+                    kwargs = vals
+                if spectrum == "free_spectrum":
+                    # psd * df = 10^(2 rho) by definition: the weights are
+                    # 10^rho directly — no Tspan inference (whose f[0] probe
+                    # would read the wrong axis on the (P, N) grid here).
+                    # log10_rho is per-bin, so shapes are already (.., N)
+                    w_samp[target] = 10.0 ** kwargs["log10_rho"]
+                else:
+                    f = jnp.arange(1, nbin + 1, dtype=dtype) * df
+                    psd = spectrum_lib.evaluate(spectrum, f, **kwargs)
+                    w_samp[target] = jnp.sqrt(psd * df)
+
+        # per-realization white/ECORR hyperparameter sampling (WhiteSampling):
+        # the drawn per-(pulsar, backend) values rebuild sigma2/ecorr_amp from
+        # the raw TOA errors, replacing the batch's fixed arrays. Keys live in
+        # their own 0xE1 domain folded with the GLOBAL pulsar index, so the
+        # white/ECORR coefficient streams (kw/ke) below are byte-identical
+        # whether or not sampling is on, and streams are mesh-shape invariant.
+        sigma2_eff = batch.sigma2
+        ecorr_eff = batch.ecorr_amp
+        if white_static is not None and (include_white or include_ecorr):
+            s_efac, s_equad, s_ecorr, wdist = white_static
+            wroot = jax.random.fold_in(key, _WHITE_TAG)
+            kp = jax.vmap(lambda g: jax.random.fold_in(wroot, g))(gidx)
+            z = jax.vmap(lambda k: (
+                jax.random.uniform(k, (white_nb, 3), dtype)
+                if wdist == "uniform"
+                else jax.random.normal(k, (white_nb, 3), dtype)))(kp)  # (P,B,3)
+
+            def wval(i):
+                a, b = white_params[i, 0], white_params[i, 1]
+                v = a + z[..., i] * ((b - a) if wdist == "uniform" else b)
+                return jnp.take_along_axis(v, white_bid, axis=1)       # (P,T)
+
+            if include_white:
+                sigma2_eff = white_toaerr2
+                if s_efac:
+                    sigma2_eff = wval(0) ** 2 * sigma2_eff
+                if s_equad:
+                    sigma2_eff = sigma2_eff + 10.0 ** (2.0 * wval(1))
+            if s_ecorr:
+                # the where-gate keeps padding TOAs and single-TOA epochs
+                # excluded exactly as the fixed path resolved them
+                ecorr_eff = jnp.where(batch.ecorr_amp > 0.0,
+                                      10.0 ** wval(2), 0.0)
 
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
-            res = res + jnp.sqrt(batch.sigma2) * draw(kw, T)
+            res = res + jnp.sqrt(sigma2_eff) * draw(kw, T)
         if include_ecorr:
             # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
             # ONE shared normal per epoch: no per-block Cholesky (the reference
             # draws a dense MVN per block, fake_pta.py:219-228)
             shared = jnp.take_along_axis(draw(ke, T), batch.epoch_idx, axis=1)
-            res = res + batch.ecorr_amp * shared
+            res = res + ecorr_eff * shared
         coeffs = []
         if include_red:
             c = draw(kr, 2, n_red) * w_samp.get("red", red_w)[:, None, :]
@@ -416,8 +566,11 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             gwb_c = [None] * len(gwb_bases)
             for j, (chol_j, w_j) in enumerate(zip(chols, gwb_ws)):
                 kg = tag if j == 0 else jax.random.fold_in(tag, j)
-                z = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
-                corr = z @ chol_j.T
+                # NB: not named `z` — the white-sampling closure `wval` above
+                # captures its `z` by reference; shadowing it here would make
+                # any later wval call silently read GWB normals
+                zg = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
+                corr = zg @ chol_j.T
                 corr_local = lax.dynamic_slice_in_dim(
                     corr, pidx * p_local, p_local, axis=2)
                 w_eff = w_samp.get("gwb", w_j) if j == 0 else w_j
@@ -469,27 +622,106 @@ def _as_config_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]
 
 
-def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, psrterm, tag):
+# spectrum hyperparameters that are per-frequency-bin vectors, not scalars;
+# NoiseSampling draws one independent value per bin for these
+_PER_BIN_PARAMS = ("log10_rho", "alphas", "alphas_adapt")
+
+
+def _resolve_noise_sampling(cfg: NoiseSampling):
+    """Validate one NoiseSampling config against the spectrum registry.
+
+    Returns ``(static, ranges)``: the static kernel descriptor
+    ``(target, spectrum, names, per_bin flags, dist per param)`` plus the
+    ``(n_params, 2)`` host range rows in draw order.
+    """
+    from .. import spectrum as spectrum_lib
+
+    if cfg.spectrum not in spectrum_lib.SPECTRA:
+        raise ValueError(f"NoiseSampling spectrum {cfg.spectrum!r} is not "
+                         f"registered; known: {sorted(spectrum_lib.SPECTRA)}")
+    reg = spectrum_lib.SPECTRA[cfg.spectrum]
+    ranges = {}
+    if cfg.log10_A is not None:
+        ranges["log10_A"] = tuple(cfg.log10_A)
+    if cfg.gamma is not None:
+        ranges["gamma"] = tuple(cfg.gamma)
+    if cfg.params:
+        ranges.update({k: tuple(v) for k, v in cfg.params.items()})
+    if not ranges:
+        raise ValueError(f"NoiseSampling({cfg.target!r}) has no parameters "
+                         f"to sample: give log10_A/gamma or params ranges")
+    unknown = [k for k in ranges if k not in reg.params]
+    if unknown:
+        raise ValueError(f"params {unknown} are not hyperparameters of "
+                         f"{cfg.spectrum!r} (has {list(reg.params)})")
+    if "nfreq" in ranges:
+        # t_process_adapt's nfreq is a bin INDEX selecting where alphas_adapt
+        # applies, not a continuous hyperparameter: a drawn nfreq either
+        # breaks broadcasting against the per-bin alphas_adapt draw or (alone)
+        # is silently ignored by the model. Pin it via functools.partial on a
+        # re-registered spectrum instead.
+        raise ValueError("'nfreq' (a bin index) cannot be sampled; register "
+                         "a partial spectrum with nfreq bound instead")
+    names = tuple(ranges)
+    per_bin = tuple(n in _PER_BIN_PARAMS for n in names)
+    if isinstance(cfg.dist, str):
+        dists = {n: cfg.dist for n in names}
+    else:
+        bad = [k for k in cfg.dist if k not in names]
+        if bad:
+            raise ValueError(f"dist mapping names {bad} are not sampled "
+                             f"parameters {list(names)}")
+        dists = {n: cfg.dist.get(n, "uniform") for n in names}
+    for d in dists.values():
+        if d not in ("uniform", "normal"):
+            raise ValueError(f"NoiseSampling dist must be 'uniform' or "
+                             f"'normal', got {d!r}")
+    static = (cfg.target, cfg.spectrum, names, per_bin,
+              tuple(dists[n] for n in names))
+    return static, [list(ranges[n]) for n in names]
+
+
+def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
     """(R_local, P_local, T) per-realization CGW delays (shard_map body).
 
     ``t_rel`` is this shard's (P_local, T) epochs relative to the config's
-    ``tref`` (precomputed host-f64, stored f32); ``ranges`` the (8, 2) uniform
-    parameter bounds in CGWSampling field order. The draw key folds the 0xC6
-    domain tag and the per-config index ``tag`` but never the shard index: one
-    sampled source is a global nuisance per realization.
+    ``tref`` (precomputed host-f64, stored f32); ``ranges`` the (8, 2)
+    parameter bounds in CGWSampling field order (row 5 = the amplitude,
+    ``log10_h`` or ``log10_dist`` per the mode); ``static`` the resolved
+    ``(psrterm, mode, dists, sample_pdist)`` descriptor. Source draws fold
+    the 0xC6 domain tag and the per-config index ``tag`` but never the shard
+    index: one sampled source is a global nuisance per realization. The
+    per-pulsar ``p_dist`` nuisance (subkey 2) folds the GLOBAL pulsar index,
+    so streams stay mesh-shape independent.
     """
     from ..models.cgw import cw_delay
 
+    psrterm, mode, dists, sample_pdist = static
     dtype = t_rel.dtype
+    p_local = t_rel.shape[0]
+    norm_mask = np.array([d == "normal" for d in dists])
+    gidx = lax.axis_index(PSR_AXIS) * p_local + jnp.arange(p_local)
 
     def one(key):
         kz = jax.random.fold_in(jax.random.fold_in(key, _CGW_TAG), tag)
-        z = jax.random.uniform(kz, (8,), dtype)
-        v = ranges[:, 0] + z * (ranges[:, 1] - ranges[:, 0])
-        return jax.vmap(lambda t, p, pd: cw_delay(
-            t, p, (pd[0], pd[1]), cos_gwtheta=v[0], gwphi=v[1], cos_inc=v[2],
-            log10_mc=v[3], log10_fgw=v[4], log10_h=v[5], phase0=v[6], psi=v[7],
-            psrTerm=psrterm, evolve=True))(t_rel, pos_local, pdist_local)
+        u = jax.random.uniform(kz, (8,), dtype)
+        v = ranges[:, 0] + u * (ranges[:, 1] - ranges[:, 0])
+        if norm_mask.any():
+            g = jax.random.normal(jax.random.fold_in(kz, 1), (8,), dtype)
+            v = jnp.where(jnp.asarray(norm_mask),
+                          ranges[:, 0] + g * ranges[:, 1], v)
+        if sample_pdist:
+            kpd = jax.random.fold_in(kz, 2)
+            pd = jax.vmap(lambda gi: jax.random.normal(
+                jax.random.fold_in(kpd, gi), (), dtype))(gidx)
+        else:
+            pd = jnp.zeros((p_local,), dtype)
+        amp_kw = {("log10_h" if mode == "h" else "log10_dist"): v[5]}
+        return jax.vmap(lambda t, p, pdm, pz: cw_delay(
+            t, p, (pdm[0], pdm[1]), cos_gwtheta=v[0], gwphi=v[1], cos_inc=v[2],
+            log10_mc=v[3], log10_fgw=v[4], phase0=v[6], psi=v[7],
+            psrTerm=psrterm, evolve=True, p_dist=pz,
+            **amp_kw))(t_rel, pos_local, pdist_local, pd)
 
     return jax.vmap(one)(keys)
 
@@ -649,7 +881,8 @@ class EnsembleSimulator:
                  bases_dtype: str = "f32",
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
-                 cgw_sample=None):
+                 cgw_sample=None, white_sample=None, toaerr2=None,
+                 backend_id=None):
         """``noise_sample`` takes :class:`NoiseSampling` config(s) — per-
         realization (log10_A, gamma) draws replacing the fixed PSD of the
         red/dm/chrom/gwb stages. ``use_pallas`` enables the fused statistic kernel
@@ -710,6 +943,7 @@ class EnsembleSimulator:
         # range arrays, validated against the stages actually in the program
         samp_list = _as_config_list(noise_sample)
         seen = set()
+        samp_static, samp_params = [], []
         for cfg in samp_list:
             if cfg.target not in _HYPER_SUBTAG:
                 raise ValueError(f"NoiseSampling target {cfg.target!r} not in "
@@ -718,9 +952,6 @@ class EnsembleSimulator:
                 raise ValueError(f"duplicate NoiseSampling target "
                                  f"{cfg.target!r}")
             seen.add(cfg.target)
-            if cfg.dist not in ("uniform", "normal"):
-                raise ValueError(f"NoiseSampling dist must be 'uniform' or "
-                                 f"'normal', got {cfg.dist!r}")
             if cfg.target not in include:
                 raise ValueError(f"NoiseSampling target {cfg.target!r} needs "
                                  f"stage {cfg.target!r} in include")
@@ -728,12 +959,76 @@ class EnsembleSimulator:
                 raise ValueError("NoiseSampling('gwb') needs a GWBConfig (its "
                                  "orf/idx and psd length set the program; the "
                                  "psd values are replaced by the draws)")
-        self._samp_static = tuple((cfg.target, cfg.dist) for cfg in samp_list)
-        self._samp_params = tuple(
-            jnp.asarray([[cfg.log10_A[0], cfg.log10_A[1]],
-                         [cfg.gamma[0], cfg.gamma[1]]], dtype)
-            for cfg in samp_list)
+            static, rows = _resolve_noise_sampling(cfg)
+            samp_static.append(static)
+            samp_params.append(jnp.asarray(rows, dtype))
+        self._samp_static = tuple(samp_static)
+        self._samp_params = tuple(samp_params)
         sampled = {cfg.target for cfg in samp_list}
+
+        # per-realization white/ECORR hyperparameter sampling (WhiteSampling):
+        # static sample flags + a tiny traced (3, 2) range array; the raw
+        # squared TOA errors and (pulsar, backend) partition ride the program
+        # as (P, T) arrays sharded like the batch
+        self._white_static = None
+        if white_sample is not None:
+            ws = white_sample
+            if not isinstance(ws, WhiteSampling):
+                raise TypeError(f"white_sample must be a WhiteSampling, got "
+                                f"{type(ws).__name__}")
+            if ws.dist not in ("uniform", "normal"):
+                raise ValueError(f"WhiteSampling dist must be 'uniform' or "
+                                 f"'normal', got {ws.dist!r}")
+            if (ws.efac is None and ws.log10_tnequad is None
+                    and ws.log10_ecorr is None):
+                # all-None would sample nothing yet still swap the batch's
+                # noisedict-derived sigma2 for raw toaerr^2 — silent statistics
+                # change with zero randomization
+                raise ValueError("WhiteSampling has no parameters to sample: "
+                                 "give an efac/log10_tnequad/log10_ecorr range")
+            if "white" not in include:
+                raise ValueError("WhiteSampling needs stage 'white' in include")
+            if ws.log10_ecorr is not None and not (
+                    "ecorr" in include
+                    and bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))):
+                raise ValueError(
+                    "WhiteSampling.log10_ecorr needs a live ECORR stage: build "
+                    "the batch with ecorr=True (epochs + nonzero ecorr_amp) "
+                    "and keep 'ecorr' in include")
+            if toaerr2 is None:
+                # the synthetic/default case: the batch's fixed white variance
+                # IS the raw toaerr^2 (efac=1, no EQUAD baked in). Replayed
+                # arrays with noisedict efac/equad should pass the raw errors
+                # explicitly (batch.padded_toaerr2)
+                toaerr2 = np.asarray(batch.sigma2)
+            toaerr2 = np.asarray(toaerr2, dtype=np.float64)
+            if toaerr2.shape != batch.t_own.shape:
+                raise ValueError(f"toaerr2 shape {toaerr2.shape} != batch "
+                                 f"{batch.t_own.shape}")
+            if backend_id is None:
+                backend_id = np.zeros(batch.t_own.shape, dtype=np.int32)
+            backend_id = np.asarray(backend_id, dtype=np.int32)
+            if backend_id.shape != batch.t_own.shape:
+                raise ValueError(f"backend_id shape {backend_id.shape} != "
+                                 f"batch {batch.t_own.shape}")
+            self._white_nb = int(backend_id.max()) + 1
+            self._white_static = (ws.efac is not None,
+                                  ws.log10_tnequad is not None,
+                                  ws.log10_ecorr is not None, ws.dist)
+            rows = [list(ws.efac or (1.0, 1.0)),
+                    list(ws.log10_tnequad or (-8.0, -8.0)),
+                    list(ws.log10_ecorr or (-8.0, -8.0))]
+            self._white_params = jnp.asarray(rows, dtype)
+        else:
+            self._white_nb = 1
+            self._white_params = jnp.zeros((3, 2), dtype)
+            # never read when white_static is None: (P, 1) broadcast-shaped
+            # dummies keep the shard_map argument list static without parking
+            # two full (P, T) arrays in device memory
+            toaerr2 = np.zeros((batch.npsr, 1))
+            backend_id = np.zeros((batch.npsr, 1), dtype=np.int32)
+        self._white_toaerr2 = jnp.asarray(toaerr2, dtype)
+        self._white_bid = jnp.asarray(backend_id)
 
         # optional stages only enter the program if their parameters are anywhere
         # nonzero — the default synthetic batch has chrom/ecorr off, so nothing
@@ -795,12 +1090,48 @@ class EnsembleSimulator:
         # docstring for the phase-precision bound), parameter ranges as tiny
         # replicated (8, 2) arrays, waveforms evaluated inside the kernel
         cgw_s_list = _as_config_list(cgw_sample)
-        self._cgw_psrterm = tuple(bool(c.psrterm) for c in cgw_s_list)
-        self._cgw_ranges = tuple(
-            jnp.asarray([list(c.costheta), list(c.phi), list(c.cosinc),
-                         list(c.log10_mc), list(c.log10_fgw), list(c.log10_h),
-                         list(c.phase0), list(c.psi)], dtype)
-            for c in cgw_s_list)
+        cgw_static, cgw_ranges = [], []
+        for c in cgw_s_list:
+            mode = "dist" if c.log10_dist is not None else "h"
+            amp = c.log10_dist if mode == "dist" else c.log10_h
+            if amp is None:
+                raise ValueError("CGWSampling needs a log10_h or log10_dist "
+                                 "amplitude range")
+            names = ("costheta", "phi", "cosinc", "log10_mc", "log10_fgw",
+                     "log10_dist" if mode == "dist" else "log10_h",
+                     "phase0", "psi")
+            if isinstance(c.dist, str):
+                dmap = {n: c.dist for n in names}
+            else:
+                bad = [k for k in c.dist if k not in names]
+                if bad:
+                    raise ValueError(f"CGWSampling dist mapping names {bad} "
+                                     f"are not sampled parameters {list(names)}")
+                dmap = {n: c.dist.get(n, "uniform") for n in names}
+            for d in dmap.values():
+                if d not in ("uniform", "normal"):
+                    raise ValueError(f"CGWSampling dist must be 'uniform' or "
+                                     f"'normal', got {d!r}")
+            if c.sample_pdist and not c.psrterm:
+                raise ValueError("CGWSampling(sample_pdist=True) needs "
+                                 "psrterm=True (the distance nuisance only "
+                                 "enters through the pulsar term)")
+            if c.sample_pdist and (pdist is None
+                                   or not np.any(np.asarray(pdist)[..., -1])):
+                import warnings
+                warnings.warn("CGWSampling(sample_pdist=True) with all-zero "
+                              "pdist sigmas draws a nuisance that cannot move "
+                              "anything; pass pdist=(mean, sigma) pairs",
+                              stacklevel=2)
+            cgw_static.append((bool(c.psrterm), mode,
+                               tuple(dmap[n] for n in names),
+                               bool(c.sample_pdist)))
+            cgw_ranges.append(jnp.asarray(
+                [list(c.costheta), list(c.phi), list(c.cosinc),
+                 list(c.log10_mc), list(c.log10_fgw), list(amp),
+                 list(c.phase0), list(c.psi)], dtype))
+        self._cgw_static = tuple(cgw_static)
+        self._cgw_ranges = tuple(cgw_ranges)
         if cgw_s_list:
             toas64 = _validated_toas_abs(batch, toas_abs, "cgw_sample")
             self._cgw_trel = tuple(
@@ -881,25 +1212,32 @@ class EnsembleSimulator:
         roe_scales = self._roe_scales
         n_roe = len(self._roe_states)
         samp_static = self._samp_static
-        cgw_psrterm = self._cgw_psrterm
+        cgw_static = self._cgw_static
         cgw_ranges = self._cgw_ranges
 
-        def sharded(keys, batch, chol, gwb_w, det, samp_params, cgw_trel,
-                    cgw_pdist, *roe):
+        white_static = self._white_static
+        white_nb = self._white_nb
+
+        def sharded(keys, batch, chol, gwb_w, det, samp_params, white_params,
+                    white_toaerr2, white_bid, cgw_trel, cgw_pdist, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc,
                                   samp_static=samp_static,
                                   samp_params=samp_params,
-                                  bases_bf16=self._bases_bf16)
+                                  bases_bf16=self._bases_bf16,
+                                  white_static=white_static,
+                                  white_params=white_params,
+                                  white_toaerr2=white_toaerr2,
+                                  white_bid=white_bid, white_nb=white_nb)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
                 term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
                                        tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            for j, psrterm in enumerate(cgw_psrterm):
+            for j, stat in enumerate(cgw_static):
                 term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
-                                    cgw_ranges[j], psrterm, tag=j)
+                                    cgw_ranges[j], stat, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
             return _correlation_rows(res)
 
@@ -911,7 +1249,8 @@ class EnsembleSimulator:
             in_specs=(P(REAL_AXIS), batch_specs,
                       tuple(P() for _ in self._chol),
                       tuple(P() for _ in self._gwb_w), P(PSR_AXIS),
-                      samp_specs, cgw_trel_specs, P(PSR_AXIS), *roe_specs),
+                      samp_specs, P(), P(PSR_AXIS), P(PSR_AXIS),
+                      cgw_trel_specs, P(PSR_AXIS), *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
         roe_args = self._roe_states
@@ -922,8 +1261,9 @@ class EnsembleSimulator:
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
-                            self._det, self._samp_params, self._cgw_trel,
-                            self._pdist, *roe_args)
+                            self._det, self._samp_params, self._white_params,
+                            self._white_toaerr2, self._white_bid,
+                            self._cgw_trel, self._pdist, *roe_args)
             # HIGHEST: these einsums lower to matmuls, and XLA's default TPU
             # matmul rounds f32 operands to bf16 — a free-to-avoid ~4e-3
             # relative error here (the binning is a trivial fraction of the
@@ -965,25 +1305,33 @@ class EnsembleSimulator:
         roe_scales = self._roe_scales
         n_roe = len(self._roe_states)
         samp_static = self._samp_static
-        cgw_psrterm = self._cgw_psrterm
+        cgw_static = self._cgw_static
         cgw_ranges = self._cgw_ranges
 
+        white_static = self._white_static
+        white_nb = self._white_nb
+
         def sharded(keys, batch, chol, gwb_w, weights, det, samp_params,
+                    white_params, white_toaerr2, white_bid,
                     cgw_trel, cgw_pdist, *roe):
             res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
                                   self._gwb_freqf, *inc,
                                   samp_static=samp_static,
                                   samp_params=samp_params,
-                                  bases_bf16=self._bases_bf16)
+                                  bases_bf16=self._bases_bf16,
+                                  white_static=white_static,
+                                  white_params=white_params,
+                                  white_toaerr2=white_toaerr2,
+                                  white_bid=white_bid, white_nb=white_nb)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
                 term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
                                        tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            for j, psrterm in enumerate(cgw_psrterm):
+            for j, stat in enumerate(cgw_static):
                 term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
-                                    cgw_ranges[j], psrterm, tag=j)
+                                    cgw_ranges[j], stat, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
             res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
@@ -1005,6 +1353,7 @@ class EnsembleSimulator:
                       tuple(P() for _ in self._gwb_w),
                       P(None, PSR_AXIS, None), P(PSR_AXIS),
                       tuple(P() for _ in self._samp_params),
+                      P(), P(PSR_AXIS), P(PSR_AXIS),
                       tuple(P(PSR_AXIS) for _ in self._cgw_trel), P(PSR_AXIS),
                       *(tuple(_orbit_state_specs()
                               for _ in range(n_roe)))),
@@ -1020,7 +1369,9 @@ class EnsembleSimulator:
                 offset + jnp.arange(nreal))
             curves, autos = shmapped(keys, self.batch, self._chol, self._gwb_w,
                                      self._stat_weights, self._det,
-                                     self._samp_params, self._cgw_trel,
+                                     self._samp_params, self._white_params,
+                                     self._white_toaerr2, self._white_bid,
+                                     self._cgw_trel,
                                      self._pdist, *self._roe_states)
             # same packed single-transfer contract as the XLA step
             return pack_stats(curves, autos)

@@ -1,0 +1,175 @@
+"""Per-realization white-noise/ECORR hyperparameter sampling (WhiteSampling).
+
+The reference's ``randomize=True`` draws one (efac, log10_tnequad,
+log10_ecorr) set per *injection call* on the host (``fake_pta.py:203-210``);
+per-realization population marginalization over the white-noise dictionary
+exists only in this engine. These tests pin: exact reduction to the fixed
+program at pinned values, the analytic uniform-mixture variance (EFAC/EQUAD
+and ECORR), mesh-shape-independent streams, and config validation.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from fakepta_tpu.batch import (PulsarBatch, padded_backend_ids,
+                               padded_toaerr2)
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, WhiteSampling
+
+
+@pytest.fixture
+def batch():
+    return PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                 toaerr=1e-7, n_red=8, n_dm=8, seed=1)
+
+
+def _epoch_psrs(npsr=8, n_epochs=24, per_epoch=4, toaerr=1e-7):
+    """Facade pulsars with clean 4-TOA epochs and two backends (the ECORR +
+    backend-partition regime of suite config 7)."""
+    from fakepta_tpu.fake_pta import Pulsar
+
+    day = 86400.0
+    toas = np.concatenate([k * 30 * day + np.arange(per_epoch) * 600.0
+                           for k in range(n_epochs)])
+    psrs = []
+    for k in range(npsr):
+        p = Pulsar(toas, toaerr, np.arccos(1 - 2 * (k + 0.5) / npsr),
+                   2.39996 * k % (2 * np.pi), seed=k,
+                   backends=["A.1400", "B.600"],
+                   custom_model={"RN": None, "DM": None, "Sv": None})
+        for backend in p.backends:
+            p.noisedict[f"{p.name}_{backend}_log10_ecorr"] = -6.5
+        psrs.append(p)
+    return psrs
+
+
+def test_pinned_white_sampling_reproduces_fixed_run(batch):
+    """efac pinned at 1 with EQUAD off rebuilds exactly the synthetic batch's
+    sigma2 = toaerr^2, and the white draw stream (kw) is untouched by the
+    sampler's own 0xE1 domain — the fixed run reproduces to f32 roundoff
+    (the extra pinned multiply reorders the compiler's fusion, so not
+    bitwise)."""
+    mesh = make_mesh(jax.devices()[:1])
+    fixed = EnsembleSimulator(batch, include=("white",), mesh=mesh)
+    sampled = EnsembleSimulator(
+        batch, include=("white",), mesh=mesh,
+        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None))
+    a = fixed.run(64, seed=5, chunk=32)
+    b = sampled.run(64, seed=5, chunk=32)
+    np.testing.assert_allclose(b["curves"], a["curves"], rtol=2e-4,
+                               atol=2e-4 * np.abs(a["curves"]).max())
+    np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-4)
+
+
+def test_efac_equad_uniform_mixture_variance(batch):
+    """autos (count-normalized mean square residual) must match the analytic
+    mixture: E[efac^2] toaerr^2 + E[10^(2q)] with
+    E[efac^2] = (b^3 - a^3)/(3 (b - a)) and
+    E[10^(2q)] = (10^(2qb) - 10^(2qa)) / (2 ln10 (qb - qa))."""
+    a, b = 0.5, 2.5
+    qa, qb = -8.0, -5.0
+    mesh = make_mesh(jax.devices())
+    sim = EnsembleSimulator(
+        batch, include=("white",), mesh=mesh,
+        white_sample=WhiteSampling(efac=(a, b), log10_tnequad=(qa, qb)))
+    out = sim.run(2400, seed=7, chunk=800)
+    e_efac2 = (b**3 - a**3) / (3.0 * (b - a))
+    e_equad = (10.0 ** (2 * qb) - 10.0 ** (2 * qa)) / (
+        2 * np.log(10.0) * (qb - qa))
+    want = e_efac2 * 1e-14 + e_equad
+    np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.1)
+
+
+def test_normal_dist_efac_variance(batch):
+    """dist='normal': efac ~ N(mu, s) gives E[efac^2] = mu^2 + s^2."""
+    mu, s = 1.5, 0.2
+    mesh = make_mesh(jax.devices())
+    sim = EnsembleSimulator(
+        batch, include=("white",), mesh=mesh,
+        white_sample=WhiteSampling(efac=(mu, s), log10_tnequad=None,
+                                   dist="normal"))
+    out = sim.run(2000, seed=9, chunk=500)
+    np.testing.assert_allclose(out["autos"].mean(), (mu**2 + s**2) * 1e-14,
+                               rtol=0.05)
+
+
+def test_sampled_ecorr_mixture_variance():
+    """Sampled per-backend log10_ecorr on a real epoch structure: every epoch
+    has 4 TOAs (none excluded), so the per-TOA variance adds E[10^(2e)] on
+    top of the pinned efac=1 white floor."""
+    psrs = _epoch_psrs()
+    batch = PulsarBatch.from_pulsars(psrs, n_red=8, n_dm=8, ecorr=True)
+    assert bool(np.all(np.asarray(batch.ecorr_amp)[np.asarray(batch.mask)] > 0))
+    bid, nb = padded_backend_ids(psrs)
+    assert nb == 2
+    mesh = make_mesh(jax.devices())
+    ea, eb = -7.0, -6.0
+    sim = EnsembleSimulator(
+        batch, include=("white", "ecorr"), mesh=mesh,
+        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None,
+                                   log10_ecorr=(ea, eb)),
+        toaerr2=padded_toaerr2(psrs), backend_id=bid)
+    out = sim.run(2400, seed=11, chunk=800)
+    e_ecorr = (10.0 ** (2 * eb) - 10.0 ** (2 * ea)) / (
+        2 * np.log(10.0) * (eb - ea))
+    want = 1e-14 + e_ecorr
+    np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.1)
+
+
+def test_white_sampling_mesh_shape_invariance(batch):
+    """Draws fold the global pulsar index: every mesh shape must produce
+    identical realizations."""
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces an 8-device CPU mesh"
+    ws = WhiteSampling(efac=(0.5, 2.5), log10_tnequad=(-8.0, -5.0))
+    ref = EnsembleSimulator(batch, include=("white",), mesh=make_mesh(devs[:1]),
+                            white_sample=ws).run(32, seed=3, chunk=16)
+    for shards in (2, 4, 8):
+        mesh = make_mesh(devs, psr_shards=shards)
+        got = EnsembleSimulator(batch, include=("white",), mesh=mesh,
+                                white_sample=ws).run(32, seed=3, chunk=16)
+        np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
+                                   atol=1e-7 * np.abs(ref["curves"]).max())
+        np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
+
+
+def test_white_sampling_leaves_other_streams_untouched(batch):
+    """Adding white sampling must not move the GP/GWB realizations: with the
+    white stage excluded from the statistic inputs (red only), sampled and
+    fixed runs agree exactly."""
+    mesh = make_mesh(jax.devices()[:1])
+    fixed = EnsembleSimulator(batch, include=("white", "red"), mesh=mesh)
+    sampled = EnsembleSimulator(
+        batch, include=("white", "red"), mesh=mesh,
+        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None))
+    a = fixed.run(48, seed=13, chunk=24)
+    b = sampled.run(48, seed=13, chunk=24)
+    np.testing.assert_allclose(b["curves"], a["curves"], rtol=2e-4,
+                               atol=2e-4 * np.abs(a["curves"]).max())
+
+
+def test_white_sampling_validation(batch):
+    mesh = make_mesh(jax.devices()[:1])
+    with pytest.raises(ValueError, match="needs stage 'white'"):
+        EnsembleSimulator(batch, include=("red",), mesh=mesh,
+                          white_sample=WhiteSampling())
+    with pytest.raises(ValueError, match="dist"):
+        EnsembleSimulator(batch, include=("white",), mesh=mesh,
+                          white_sample=WhiteSampling(dist="lognormal"))
+    with pytest.raises(ValueError, match="ECORR"):
+        # synthetic batch has no ECORR epochs at all
+        EnsembleSimulator(batch, include=("white", "ecorr"), mesh=mesh,
+                          white_sample=WhiteSampling(log10_ecorr=(-7, -6)))
+    with pytest.raises(ValueError, match="no parameters"):
+        # all-None would swap sigma2 for raw toaerr^2 while sampling nothing
+        EnsembleSimulator(batch, include=("white",), mesh=mesh,
+                          white_sample=WhiteSampling(
+                              efac=None, log10_tnequad=None))
+    with pytest.raises(TypeError, match="WhiteSampling"):
+        EnsembleSimulator(batch, include=("white",), mesh=mesh,
+                          white_sample={"efac": (0.5, 2.5)})
+    with pytest.raises(ValueError, match="toaerr2 shape"):
+        EnsembleSimulator(batch, include=("white",), mesh=mesh,
+                          white_sample=WhiteSampling(),
+                          toaerr2=np.ones((2, 2)))
