@@ -255,19 +255,22 @@ class CGWSampling:
     float64) when exact pulsar terms matter.
     """
 
+    # field order: the original fields keep their round-4 positions (appending
+    # the new ones at the end) so positional construction cannot silently
+    # rebind — e.g. an old call's phase0 range landing in log10_dist
     costheta: Tuple[float, float] = (-1.0, 1.0)
     phi: Tuple[float, float] = (0.0, 2.0 * np.pi)
     cosinc: Tuple[float, float] = (-1.0, 1.0)
     log10_mc: Tuple[float, float] = (8.5, 9.5)
     log10_fgw: Tuple[float, float] = (-8.5, -7.5)
     log10_h: Optional[Tuple[float, float]] = (-14.5, -13.5)
-    log10_dist: Optional[Tuple[float, float]] = None
     phase0: Tuple[float, float] = (0.0, 2.0 * np.pi)
     psi: Tuple[float, float] = (0.0, np.pi)
     psrterm: bool = False
+    tref: float = 0.0
+    log10_dist: Optional[Tuple[float, float]] = None
     sample_pdist: bool = False
     dist: Union[str, dict] = "uniform"
-    tref: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -627,6 +630,27 @@ def _as_config_list(x):
 _PER_BIN_PARAMS = ("log10_rho", "alphas", "alphas_adapt")
 
 
+def _resolve_dists(dist, names, label):
+    """Normalize a str-or-mapping ``dist`` spec to one value per name.
+
+    Shared by :class:`NoiseSampling` and :class:`CGWSampling` so the two
+    cannot drift (same expansion, unknown-name check, family check).
+    """
+    if isinstance(dist, str):
+        dmap = {n: dist for n in names}
+    else:
+        bad = [k for k in dist if k not in names]
+        if bad:
+            raise ValueError(f"{label} dist mapping names {bad} are not "
+                             f"sampled parameters {list(names)}")
+        dmap = {n: dist.get(n, "uniform") for n in names}
+    for d in dmap.values():
+        if d not in ("uniform", "normal"):
+            raise ValueError(f"{label} dist must be 'uniform' or 'normal', "
+                             f"got {d!r}")
+    return tuple(dmap[n] for n in names)
+
+
 def _resolve_noise_sampling(cfg: NoiseSampling):
     """Validate one NoiseSampling config against the spectrum registry.
 
@@ -664,20 +688,8 @@ def _resolve_noise_sampling(cfg: NoiseSampling):
                          "a partial spectrum with nfreq bound instead")
     names = tuple(ranges)
     per_bin = tuple(n in _PER_BIN_PARAMS for n in names)
-    if isinstance(cfg.dist, str):
-        dists = {n: cfg.dist for n in names}
-    else:
-        bad = [k for k in cfg.dist if k not in names]
-        if bad:
-            raise ValueError(f"dist mapping names {bad} are not sampled "
-                             f"parameters {list(names)}")
-        dists = {n: cfg.dist.get(n, "uniform") for n in names}
-    for d in dists.values():
-        if d not in ("uniform", "normal"):
-            raise ValueError(f"NoiseSampling dist must be 'uniform' or "
-                             f"'normal', got {d!r}")
     static = (cfg.target, cfg.spectrum, names, per_bin,
-              tuple(dists[n] for n in names))
+              _resolve_dists(cfg.dist, names, "NoiseSampling"))
     return static, [list(ranges[n]) for n in names]
 
 
@@ -997,9 +1009,18 @@ class EnsembleSimulator:
                     "and keep 'ecorr' in include")
             if toaerr2 is None:
                 # the synthetic/default case: the batch's fixed white variance
-                # IS the raw toaerr^2 (efac=1, no EQUAD baked in). Replayed
-                # arrays with noisedict efac/equad should pass the raw errors
-                # explicitly (batch.padded_toaerr2)
+                # IS the raw toaerr^2 (efac=1, no EQUAD baked in). A
+                # from_pulsars batch with noisedict efac/equad baked into
+                # sigma2 would silently double-apply them here — the batch
+                # carries no provenance to detect that, so warn and point at
+                # the explicit path (batch.padded_toaerr2)
+                import warnings
+                warnings.warn(
+                    "WhiteSampling with no explicit toaerr2: treating "
+                    "batch.sigma2 as the raw toaerr^2 (exact for synthetic "
+                    "batches; WRONG if the batch baked noisedict efac/equad "
+                    "into sigma2 — pass toaerr2=padded_toaerr2(psrs))",
+                    stacklevel=2)
                 toaerr2 = np.asarray(batch.sigma2)
             toaerr2 = np.asarray(toaerr2, dtype=np.float64)
             if toaerr2.shape != batch.t_own.shape:
@@ -1100,18 +1121,7 @@ class EnsembleSimulator:
             names = ("costheta", "phi", "cosinc", "log10_mc", "log10_fgw",
                      "log10_dist" if mode == "dist" else "log10_h",
                      "phase0", "psi")
-            if isinstance(c.dist, str):
-                dmap = {n: c.dist for n in names}
-            else:
-                bad = [k for k in c.dist if k not in names]
-                if bad:
-                    raise ValueError(f"CGWSampling dist mapping names {bad} "
-                                     f"are not sampled parameters {list(names)}")
-                dmap = {n: c.dist.get(n, "uniform") for n in names}
-            for d in dmap.values():
-                if d not in ("uniform", "normal"):
-                    raise ValueError(f"CGWSampling dist must be 'uniform' or "
-                                     f"'normal', got {d!r}")
+            dists = _resolve_dists(c.dist, names, "CGWSampling")
             if c.sample_pdist and not c.psrterm:
                 raise ValueError("CGWSampling(sample_pdist=True) needs "
                                  "psrterm=True (the distance nuisance only "
@@ -1123,8 +1133,7 @@ class EnsembleSimulator:
                               "pdist sigmas draws a nuisance that cannot move "
                               "anything; pass pdist=(mean, sigma) pairs",
                               stacklevel=2)
-            cgw_static.append((bool(c.psrterm), mode,
-                               tuple(dmap[n] for n in names),
+            cgw_static.append((bool(c.psrterm), mode, dists,
                                bool(c.sample_pdist)))
             cgw_ranges.append(jnp.asarray(
                 [list(c.costheta), list(c.phi), list(c.cosinc),

@@ -24,6 +24,12 @@ def batch():
                                  toaerr=1e-7, n_red=8, n_dm=8, seed=1)
 
 
+def _err2(batch):
+    """Synthetic batches: sigma2 IS the raw toaerr^2 (explicit so the
+    provenance warning stays meaningful for from_pulsars batches)."""
+    return np.asarray(batch.sigma2)
+
+
 def _epoch_psrs(npsr=8, n_epochs=24, per_epoch=4, toaerr=1e-7):
     """Facade pulsars with clean 4-TOA epochs and two backends (the ECORR +
     backend-partition regime of suite config 7)."""
@@ -54,7 +60,8 @@ def test_pinned_white_sampling_reproduces_fixed_run(batch):
     fixed = EnsembleSimulator(batch, include=("white",), mesh=mesh)
     sampled = EnsembleSimulator(
         batch, include=("white",), mesh=mesh,
-        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None))
+        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None),
+        toaerr2=_err2(batch))
     a = fixed.run(64, seed=5, chunk=32)
     b = sampled.run(64, seed=5, chunk=32)
     np.testing.assert_allclose(b["curves"], a["curves"], rtol=2e-4,
@@ -62,6 +69,7 @@ def test_pinned_white_sampling_reproduces_fixed_run(batch):
     np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_efac_equad_uniform_mixture_variance(batch):
     """autos (count-normalized mean square residual) must match the analytic
     mixture: E[efac^2] toaerr^2 + E[10^(2q)] with
@@ -72,7 +80,8 @@ def test_efac_equad_uniform_mixture_variance(batch):
     mesh = make_mesh(jax.devices())
     sim = EnsembleSimulator(
         batch, include=("white",), mesh=mesh,
-        white_sample=WhiteSampling(efac=(a, b), log10_tnequad=(qa, qb)))
+        white_sample=WhiteSampling(efac=(a, b), log10_tnequad=(qa, qb)),
+        toaerr2=_err2(batch))
     out = sim.run(2400, seed=7, chunk=800)
     e_efac2 = (b**3 - a**3) / (3.0 * (b - a))
     e_equad = (10.0 ** (2 * qb) - 10.0 ** (2 * qa)) / (
@@ -81,6 +90,7 @@ def test_efac_equad_uniform_mixture_variance(batch):
     np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.1)
 
 
+@pytest.mark.slow
 def test_normal_dist_efac_variance(batch):
     """dist='normal': efac ~ N(mu, s) gives E[efac^2] = mu^2 + s^2."""
     mu, s = 1.5, 0.2
@@ -88,12 +98,14 @@ def test_normal_dist_efac_variance(batch):
     sim = EnsembleSimulator(
         batch, include=("white",), mesh=mesh,
         white_sample=WhiteSampling(efac=(mu, s), log10_tnequad=None,
-                                   dist="normal"))
+                                   dist="normal"),
+        toaerr2=_err2(batch))
     out = sim.run(2000, seed=9, chunk=500)
     np.testing.assert_allclose(out["autos"].mean(), (mu**2 + s**2) * 1e-14,
                                rtol=0.05)
 
 
+@pytest.mark.slow
 def test_sampled_ecorr_mixture_variance():
     """Sampled per-backend log10_ecorr on a real epoch structure: every epoch
     has 4 TOAs (none excluded), so the per-TOA variance adds E[10^(2e)] on
@@ -117,6 +129,7 @@ def test_sampled_ecorr_mixture_variance():
     np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.1)
 
 
+@pytest.mark.slow
 def test_white_sampling_mesh_shape_invariance(batch):
     """Draws fold the global pulsar index: every mesh shape must produce
     identical realizations."""
@@ -124,11 +137,13 @@ def test_white_sampling_mesh_shape_invariance(batch):
     assert len(devs) >= 8, "conftest forces an 8-device CPU mesh"
     ws = WhiteSampling(efac=(0.5, 2.5), log10_tnequad=(-8.0, -5.0))
     ref = EnsembleSimulator(batch, include=("white",), mesh=make_mesh(devs[:1]),
-                            white_sample=ws).run(32, seed=3, chunk=16)
+                            white_sample=ws,
+                            toaerr2=_err2(batch)).run(32, seed=3, chunk=16)
     for shards in (2, 4, 8):
         mesh = make_mesh(devs, psr_shards=shards)
         got = EnsembleSimulator(batch, include=("white",), mesh=mesh,
-                                white_sample=ws).run(32, seed=3, chunk=16)
+                                white_sample=ws,
+                                toaerr2=_err2(batch)).run(32, seed=3, chunk=16)
         np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
                                    atol=1e-7 * np.abs(ref["curves"]).max())
         np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
@@ -142,11 +157,21 @@ def test_white_sampling_leaves_other_streams_untouched(batch):
     fixed = EnsembleSimulator(batch, include=("white", "red"), mesh=mesh)
     sampled = EnsembleSimulator(
         batch, include=("white", "red"), mesh=mesh,
-        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None))
+        white_sample=WhiteSampling(efac=(1.0, 1.0), log10_tnequad=None),
+        toaerr2=_err2(batch))
     a = fixed.run(48, seed=13, chunk=24)
     b = sampled.run(48, seed=13, chunk=24)
     np.testing.assert_allclose(b["curves"], a["curves"], rtol=2e-4,
                                atol=2e-4 * np.abs(a["curves"]).max())
+
+
+def test_white_sampling_default_toaerr2_warns(batch):
+    """Defaulting toaerr2 to batch.sigma2 assumes no baked-in efac/equad —
+    undetectable from the batch, so it must warn."""
+    with pytest.warns(UserWarning, match="toaerr2"):
+        EnsembleSimulator(batch, include=("white",),
+                          mesh=make_mesh(jax.devices()[:1]),
+                          white_sample=WhiteSampling())
 
 
 def test_white_sampling_validation(batch):
