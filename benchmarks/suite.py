@@ -1,7 +1,8 @@
 """Benchmark suite for the BASELINE.md configs (1-5 from BASELINE.json, plus
 6: config 4 as one device program, 7: the full-noise ECORR/system ensemble,
 8: the flagship with per-realization hyperparameter sampling, 9: the flagship
-with a per-realization sampled CW source).
+with a per-realization sampled CW source, 10: the 256-pulsar scale-out,
+11: the flagship with per-realization white-noise sampling).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -32,6 +33,20 @@ def _flagship_toas_abs(batch):
     span = float(batch.tspan_common)
     return np.tile(53000.0 * 86400.0 + np.linspace(0.0, span, ntoa), (npsr, 1))
 
+
+
+# global measurement-protocol scale (set by --nreal-scale): CPU stand-in runs
+# shrink the realization counts 10x so a full labeled sweep stays tractable;
+# rates are steady-state per chunk, so the scaled protocol measures the same
+# quantity with more timer noise. Rows carry the scale so BASELINE.md entries
+# are self-describing.
+_NREAL_SCALE = 1.0
+
+
+def _scaled(nreal, chunk):
+    n = max(chunk, int(round(nreal * _NREAL_SCALE)))
+    n -= n % chunk
+    return max(n, chunk), chunk
 
 def _timeit(fn, repeats=3):
     fn()                                   # warm (compile)
@@ -140,7 +155,7 @@ def config6():
         include=("white", "dm", "gwb", "det"),
         roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
         toas_abs=toas_abs, mesh=make_mesh(jax.devices()))
-    nreal, chunk = 40_000, 4000          # chunks pipeline; steady-state rate
+    nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -186,7 +201,7 @@ def config7():
                                      ecorr=True)
     sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()),
                             include=("white", "ecorr", "red", "dm", "sys"))
-    nreal, chunk = 40_000, 4000          # chunks pipeline; steady-state rate
+    nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -223,7 +238,7 @@ def config8():
                                     gamma=(1.0, 5.0)),
                       NoiseSampling("gwb", log10_A=(-15.0, -14.0),
                                     gamma=(13 / 3, 13 / 3))])
-    nreal, chunk = 100_000, 10_000
+    nreal, chunk = _scaled(100_000, 10_000)
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -260,7 +275,7 @@ def config9():
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
         cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
         toas_abs=toas_abs)
-    nreal, chunk = 40_000, 4000
+    nreal, chunk = _scaled(40_000, 4000)
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -268,6 +283,86 @@ def config9():
     return {"config": 9,
             "metric": "CW-population realizations/s/chip (100 psr, sampled "
                       "SMBHB source per realization)",
+            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+
+
+def config10():
+    """Scale-out: 256-pulsar HD GWB ensemble (VERDICT r4 #8). The regime where
+    the (R, P, P) correlation tensor pressures HBM: with_corr=False keeps it a
+    fusible intermediate, and the fused Pallas path's HBM-lean claim becomes
+    testable. Reports the compiled chunk program's memory reservation."""
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+    n_dev = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=256, ntoa=780, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                            mesh=make_mesh(jax.devices()))
+    nreal, chunk = _scaled(16_000, 2000)
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    row = {"config": 10,
+           "metric": "scale-out realizations/s/chip (256 psr, HD GWB)",
+           "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+    # THIS program's static reservation (memory_analysis), not
+    # memory_stats()'s process-lifetime allocator peak — in a full sweep the
+    # latter would report whatever earlier config peaked highest
+    try:
+        import jax.random as jr
+        ma = sim._step.lower(jr.key(1), 0, chunk, False).compile() \
+            .memory_analysis()
+        peak = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                + ma.output_size_in_bytes + ma.generated_code_size_in_bytes)
+        row["peak_hbm_gb"] = round(peak / 2**30, 2)
+    except Exception:
+        pass
+    return row
+
+
+def config11():
+    """Flagship + per-realization white-noise hyperparameter sampling
+    (WhiteSampling): per-pulsar efac/log10_tnequad drawn fresh every
+    realization on device, on top of the HD GWB + red + DM program. Measures
+    the white-sampling overhead against config 5's fixed-sigma2 program."""
+    import jax
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
+                                                 WhiteSampling)
+
+    n_dev = len(jax.devices())
+    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    f = np.arange(1, 31) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                           gamma=13 / 3))
+    sim = EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
+        white_sample=WhiteSampling(efac=(0.5, 2.5),
+                                   log10_tnequad=(-8.0, -5.0)),
+        # synthetic batch: sigma2 IS the raw toaerr^2 (explicit to skip the
+        # provenance warning)
+        toaerr2=np.asarray(batch.sigma2))
+    nreal, chunk = _scaled(100_000, 10_000)
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    t = time.perf_counter() - t0
+    return {"config": 11,
+            "metric": "white-sampled realizations/s/chip (100 psr, per-psr "
+                      "efac/equad draws)",
             "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
 
 
@@ -290,7 +385,7 @@ def config5():
                             mesh=make_mesh(jax.devices()))
     # 10k-realization chunks pipeline on device with one packed host fetch at
     # the end; 100k total measures steady-state throughput (matches bench.py)
-    nreal, chunk = 100_000, 10_000
+    nreal, chunk = _scaled(100_000, 10_000)
     sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
     sim.run(nreal, seed=1, chunk=chunk)
@@ -332,21 +427,30 @@ def config5():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
-                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9])
+                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
+    ap.add_argument("--nreal-scale", type=float, default=1.0,
+                    help="scale every ensemble config's realization count "
+                         "(CPU stand-in runs use 0.1); rows are tagged")
     args = ap.parse_args()
+    global _NREAL_SCALE
+    _NREAL_SCALE = args.nreal_scale
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
     import jax
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
-           6: config6, 7: config7, 8: config8, 9: config9}
+           6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
+           11: config11}
     rows = []
+    ensemble_configs = {5, 6, 7, 8, 9, 10, 11}   # the ones that call _scaled
     for c in args.configs:
         row = fns[c]()
         row["platform"] = jax.devices()[0].platform
+        if _NREAL_SCALE != 1.0 and c in ensemble_configs:
+            row["nreal_scale"] = _NREAL_SCALE
         print(json.dumps(row))
         rows.append(row)
 

@@ -88,6 +88,39 @@ def main():
     out["gwb_amp2_ratio"] = float(os_["amp2"].mean() / (gwb_psd * df).sum())
     out["curves_finite"] = bool(np.all(np.isfinite(run["curves"])))
 
+    # 5. fused Pallas statistic path (interpret mode on CPU) at f32 must match
+    # the XLA path's packed statistics to the bf16-operand bound
+    sim_x = EnsembleSimulator(batch, gwb=GWBConfig(psd=gwb_psd, orf="hd"),
+                              include=("white", "gwb"),
+                              mesh=make_mesh(jax.devices()[:1]))
+    sim_p = EnsembleSimulator(batch, gwb=GWBConfig(psd=gwb_psd, orf="hd"),
+                              include=("white", "gwb"),
+                              mesh=make_mesh(jax.devices()[:1]),
+                              use_pallas=True, pallas_precision="f32")
+    a = sim_x.run(8, seed=41, chunk=8)
+    b = sim_p.run(8, seed=41, chunk=8)
+    scale = np.abs(a["curves"]).max()
+    out["pallas_curves_rel_err"] = float(
+        np.abs(b["curves"] - a["curves"]).max() / scale)
+    out["pallas_autos_rel_err"] = float(
+        np.abs(b["autos"] - a["autos"]).max() / np.abs(a["autos"]).max())
+
+    # 6. joint dense-covariance GWB (the reference's dead draft) at f32:
+    # finite injection, remove inverts add
+    from fakepta_tpu.correlated_noises import add_common_correlated_noise_gp
+    psrs = [Pulsar(toas[:80], 1e-7, 0.9 + 0.4 * k, 0.8 * k, seed=k)
+            for k in range(3)]
+    add_common_correlated_noise_gp(psrs, orf="hd", components=8,
+                                   log10_A=-13.2, gamma=13 / 3, seed=17)
+    res_in = [np.asarray(p.residuals).copy() for p in psrs]
+    out["joint_gwb_finite"] = bool(all(np.all(np.isfinite(r)) and
+                                       np.abs(r).max() > 0 for r in res_in))
+    for p in psrs:
+        p.remove_signal("gw_common")
+    out["joint_gwb_remove_residue_rel"] = float(max(
+        np.abs(np.asarray(p.residuals)).max() / np.abs(r).max()
+        for p, r in zip(psrs, res_in)))
+
     print(json.dumps(out), flush=True)
 
 

@@ -136,6 +136,7 @@ def test_cgw_sampling_pinned_matches_fixed_config():
     np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_cgw_sampling_varies_and_is_mesh_invariant():
     """Wide ranges: realizations differ; streams are global nuisances folding
     no shard index, so every mesh shape reproduces the same realizations."""
@@ -209,6 +210,7 @@ def test_cgw_sampling_log10_dist_mode_pinned():
     np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-3)
 
 
+@pytest.mark.slow
 def test_cgw_sampling_pdist_draw_matches_host_key_oracle():
     """sample_pdist=True: each pulsar's distance nuisance p_dist ~ N(0, 1)
     (in sigma units) per realization. The full key chain is replicated on the
@@ -267,6 +269,7 @@ def test_cgw_sampling_pdist_draw_matches_host_key_oracle():
     assert np.ptp(out["autos"]) > 0
 
 
+@pytest.mark.slow
 def test_cgw_sampling_pdist_mesh_invariance():
     """p_dist draws fold the GLOBAL pulsar index: mesh shapes agree."""
     psrs = _psrs(n=4, T=64)

@@ -1,9 +1,12 @@
 """f32-default lane: the precision-sensitive paths with x64 OFF.
 
 The suite's conftest enables x64 globally (exact f64 oracles); real TPUs run
-f32-default. VERDICT r3 #7: run the facade injections, CGW, and GWB
-statistics in a subprocess with jax_enable_x64=False and assert the
-documented precision bounds hold there.
+f32-default. VERDICT r3 #7: run the facade injections, CGW, GWB statistics,
+the Pallas-interpret statistic path and the joint dense-covariance GWB in a
+subprocess with jax_enable_x64=False and assert the documented precision
+bounds hold there. One subprocess run (module fixture), one assertion per
+test, so a failure names the exact check instead of dumping a JSON blob
+(VERDICT r4 weak #6/#7).
 """
 
 import json
@@ -12,14 +15,20 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 from fakepta_tpu import constants as const
 from fakepta_tpu.models import cgw as cgw_model
 
 CHECKS = pathlib.Path(__file__).parent / "_f32_checks.py"
 
+pytestmark = pytest.mark.slow
 
-def test_f32_default_lane(tmp_path):
+
+@pytest.fixture(scope="module")
+def f32(tmp_path_factory):
+    """Run the f32 subprocess ONCE per module; tests assert individual keys."""
+    tmp_path = tmp_path_factory.mktemp("f32")
     # f64 oracle for the facade add_cgw check, computed under the suite's x64
     toas = 53000.0 * 86400.0 + np.linspace(0, 10 * const.yr, 300)
     # mirror of the Pulsar(theta=1.1, phi=0.4) sky vector in _f32_checks.py
@@ -36,19 +45,47 @@ def test_f32_default_lane(tmp_path):
     r = subprocess.run([sys.executable, str(CHECKS), str(oracle_path)],
                        capture_output=True, text=True, timeout=540)
     assert r.returncode == 0, r.stderr[-2000:]
-    out = json.loads(r.stdout.strip().splitlines()[-1])
+    return json.loads(r.stdout.strip().splitlines()[-1])
 
+
+def test_f32_psd_log_space_no_flush(f32):
     # log-space PSDs survive f32 (naive products flush to zero)
-    assert out["psd_min_positive"]
+    assert f32["psd_min_positive"]
+
+
+def test_f32_gp_reconstruction_roundtrip(f32):
     # GP reconstruction round-trips at f32 (stored coefficients -> residuals)
-    assert out["reconstruct_rel_err"] < 5e-5, out
+    assert f32["reconstruct_rel_err"] < 5e-5, f32["reconstruct_rel_err"]
+
+
+def test_f32_white_noise_std_band(f32):
     # defaults: efac=1, tnequad=-8, toaerr=1e-6 => std ~= sqrt(2)*1e-6 with
-    # red+DM power on top; just pin the order of magnitude band
-    assert 0.8e-6 < out["white_std"] < 1.2e-5, out
+    # red+DM power on top; pin the order-of-magnitude band
+    assert 0.8e-6 < f32["white_std"] < 1.2e-5, f32["white_std"]
+
+
+def test_f32_facade_cgw_is_host_f64(f32):
     # add_cgw is evaluated at host f64 regardless of device mode: f32 storage
     # rounding only, NOT the ~2e-5 on-device absolute-epoch error
-    assert out["cgw_rel_err_vs_f64_oracle"] < 1e-6, out
-    assert out["cgw_remove_residue_rel"] < 1e-6, out
+    assert f32["cgw_rel_err_vs_f64_oracle"] < 1e-6, f32
+    assert f32["cgw_remove_residue_rel"] < 1e-6, f32
+
+
+def test_f32_gwb_amplitude_recovery(f32):
     # ensemble GWB amplitude recovery through the f32 sharded program
-    assert abs(out["gwb_amp2_ratio"] - 1.0) < 0.3, out
-    assert out["curves_finite"]
+    assert abs(f32["gwb_amp2_ratio"] - 1.0) < 0.3, f32["gwb_amp2_ratio"]
+    assert f32["curves_finite"]
+
+
+def test_f32_pallas_interpret_matches_xla(f32):
+    # fused statistic kernel (interpret) vs XLA path at f32 operands
+    assert f32["pallas_curves_rel_err"] < 1e-4, f32["pallas_curves_rel_err"]
+    assert f32["pallas_autos_rel_err"] < 1e-4, f32["pallas_autos_rel_err"]
+
+
+def test_f32_joint_covariance_gwb(f32):
+    # the joint dense-covariance GWB injects finite residuals and remove
+    # inverts add at f32
+    assert f32["joint_gwb_finite"]
+    assert f32["joint_gwb_remove_residue_rel"] < 1e-5, \
+        f32["joint_gwb_remove_residue_rel"]

@@ -71,6 +71,7 @@ def test_ensemble_single_device(small_batch):
     assert np.all(out["autos"] > 0)
 
 
+@pytest.mark.slow
 def test_ensemble_multichip_matches_single_device(small_batch):
     """The sharded program must produce BIT-IDENTICAL realizations regardless of
     mesh shape: noise keys fold by global pulsar index, so resharding over
@@ -304,6 +305,7 @@ def test_ecorr_epoch_sampler_matches_block_covariance():
     assert np.all(np.isfinite(out["curves"]))
 
 
+@pytest.mark.slow
 def test_pallas_fused_statistic_matches_xla_path():
     """The fused Pallas curves/autos (interpret mode on CPU) must agree with the
     two-stage XLA path to bf16-operand tolerance."""
@@ -328,6 +330,7 @@ def test_pallas_fused_statistic_matches_xla_path():
                                             keep_corr=True)["corr"])
 
 
+@pytest.mark.slow
 def test_pallas_f32_mode_is_tighter_than_bf16():
     """precision='f32' must match the XLA path to f32 round-off, much tighter
     than the bf16 default's ~4e-3 operand-rounding bound."""
@@ -374,6 +377,7 @@ def test_pick_rt_respects_vmem_budget():
         assert pick_rt(*args, mxu_binning=False) >= pick_rt(*args)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("mxu", [True, False])
 def test_pallas_fused_multichip_psum(mxu):
     """Fused path on the 8-device mesh (2 psr shards): psum over shards must

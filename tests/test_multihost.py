@@ -29,6 +29,9 @@ from fakepta_tpu.parallel.mesh import make_mesh
 WORKER = pathlib.Path(__file__).parent / "_multihost_worker.py"
 
 
+pytestmark = pytest.mark.slow
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))

@@ -54,6 +54,7 @@ def test_zero_width_sampling_reproduces_fixed_psd_run(batch):
     np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-4)
 
 
+@pytest.mark.slow
 def test_gwb_uniform_mixture_mean_matches_analytic(batch):
     """With log10_A ~ U(lo, hi) the ensemble-mean cross-power must equal the
     analytic mixture: E[10^(2x)] = (10^(2hi) - 10^(2lo)) / (2 ln10 (hi - lo)),
@@ -91,6 +92,7 @@ def test_gwb_uniform_mixture_mean_matches_analytic(batch):
     assert os["amp2"].std() > 1.5 * os_f["amp2"].std()
 
 
+@pytest.mark.slow
 def test_per_pulsar_red_sampling_statistics(batch):
     """Per-pulsar red (log10_A, gamma) draws: the ensemble-mean residual power
     must match the analytic uniform mixture of the power-law's total power."""
@@ -116,6 +118,7 @@ def test_per_pulsar_red_sampling_statistics(batch):
     np.testing.assert_allclose(out["autos"].mean(), want, rtol=0.15)
 
 
+@pytest.mark.slow
 def test_sampling_mesh_shape_invariance(batch):
     """Streams fold the global pulsar index (per-pulsar targets) or no index
     at all (gwb): every mesh shape must produce identical realizations."""
@@ -152,6 +155,7 @@ def test_normal_dist_and_chrom_activation(batch):
     assert np.all(np.isfinite(out["autos"])) and out["autos"].mean() > 0
 
 
+@pytest.mark.slow
 def test_multi_gwb_configs_layer_in_one_program(batch):
     """A sequence of GWBConfigs (HD background + clock monopole) must layer:
     the ensemble-mean binned correlation equals Gamma_hd(theta) * S_hd + S_mono
@@ -206,6 +210,7 @@ def test_multi_gwb_configs_layer_in_one_program(batch):
                                atol=1e-7 * np.abs(a["curves"]).max())
 
 
+@pytest.mark.slow
 def test_sampled_turnover_mixture_mean(batch):
     """Generalized spectrum sampling (VERDICT r4 #4): a per-realization
     turnover PSD with log10_A ~ U(lo, hi) and every other hyperparameter
@@ -230,6 +235,7 @@ def test_sampled_turnover_mixture_mean(batch):
                                rtol=0.15)
 
 
+@pytest.mark.slow
 def test_sampled_free_spectrum_per_bin(batch):
     """free_spectrum sampling draws an independent log10_rho per bin per
     pulsar per realization; mean auto power = nbin * E[10^(2 rho)]."""

@@ -2,6 +2,7 @@
 
 import jax
 import numpy as np
+import pytest
 
 from fakepta_tpu import constants as const
 from fakepta_tpu.batch import PulsarBatch
@@ -79,6 +80,7 @@ def test_sampled_roemer_mesh_shape_independent():
     np.testing.assert_allclose(o8["autos"], o1["autos"], rtol=1e-5)
 
 
+@pytest.mark.slow
 def test_multi_planet_sampling():
     """A sequence of RoemerSampling configs samples several bodies at once,
     with independent draws per body (variances add)."""
