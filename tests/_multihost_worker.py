@@ -51,6 +51,10 @@ def main():
     initialize_multihost(f"localhost:{port}", num_processes=nproc,
                          process_id=pid)
     assert jax.process_count() == nproc
+    # sentinel for the test's skip classifier: anything that goes wrong AFTER
+    # this line is a real bug in the sharded program, never an
+    # environment-unavailable skip
+    print("MULTIHOST_INIT_OK", file=sys.stderr, flush=True)
 
     # global mesh: 'real' x 'psr' both span the two processes' devices
     sim = build_sim(make_mesh(jax.devices(), psr_shards=PSR_SHARDS))

@@ -75,7 +75,7 @@ def test_checkpoint_resume_identical_with_sampling(tmp_path):
     so the resumed stream replays the exact draws of an uninterrupted run."""
     from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.parallel.montecarlo import (CGWSampling, GWBConfig,
-                                                 NoiseSampling)
+                                                 NoiseSampling, WhiteSampling)
 
     batch = PulsarBatch.synthetic(npsr=4, ntoa=48, tspan_years=10.0,
                                   toaerr=1e-7, n_red=4, n_dm=4, seed=3)
@@ -90,7 +90,10 @@ def test_checkpoint_resume_identical_with_sampling(tmp_path):
                                     gamma=(2.0, 5.0)),
                       NoiseSampling("gwb", log10_A=(-14.0, -13.2),
                                     gamma=(13 / 3, 13 / 3))],
+        white_sample=WhiteSampling(efac=(0.5, 2.5),
+                                   log10_tnequad=(-8.0, -5.0)),
         cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
+        toaerr2=np.asarray(batch.sigma2),   # synthetic: sigma2 IS toaerr^2
         toas_abs=toas_abs)
     ck = tmp_path / "mc.npz"
     full = s.run(24, seed=5, chunk=8)
