@@ -43,6 +43,10 @@ def main():
     ap.add_argument("--bases-bf16", action="store_true",
                     help="store the GP projection basis in bfloat16 (half "
                          "the projection HBM traffic; ~4e-3 operand rounding)")
+    ap.add_argument("--stats-bf16", action="store_true",
+                    help="cast residual blocks to bfloat16 at the statistic "
+                         "boundary (halves the dominant (R,P,T) all_gather + "
+                         "contraction traffic; ~4e-3 operand rounding)")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -65,7 +69,8 @@ def main():
                                            gamma=13 / 3))
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()),
-                            bases_dtype="bf16" if args.bases_bf16 else "f32")
+                            bases_dtype="bf16" if args.bases_bf16 else "f32",
+                            stats_dtype="bf16" if args.stats_bf16 else "f32")
 
     # compile + warm, then measure steady state
     sim.run(args.chunk, seed=9, chunk=args.chunk)

@@ -860,7 +860,7 @@ def _batch_specs():
     return PulsarBatch(**specs)
 
 
-def _correlation_rows(res_local):
+def _correlation_rows(res_local, stats_bf16=False):
     """Raw cross-correlation rows via the program's one collective.
 
     all_gathers the residual blocks over 'psr' and contracts local rows against
@@ -872,9 +872,22 @@ def _correlation_rows(res_local):
     all_gather + counts einsum out of the shard_map body and single-sources
     the normalization with the fused Pallas path (the division itself was
     measured perf-neutral: XLA fused it).
+
+    ``stats_bf16`` casts the residual blocks to bfloat16 at this statistic
+    boundary — the signal accumulation stays f32; only the (R, P, T) tensors
+    feeding the collective + contraction (the program's dominant HBM/ICI
+    traffic per the roofline: intensity 7 vs ridge 240) halve their bytes.
+    Numerically this is the SAME operand rounding XLA's default TPU matmul
+    precision already applies inside the contraction (~4e-3 relative on pair
+    correlations); the explicit cast additionally halves the HBM reads and
+    the all_gather payload, which default-precision f32 storage does not.
+    Accumulation stays f32 via preferred_element_type.
     """
+    if stats_bf16:
+        res_local = res_local.astype(jnp.bfloat16)
     res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
-    return jnp.einsum("rpt,rqt->rpq", res_local, res_full)
+    return jnp.einsum("rpt,rqt->rpq", res_local, res_full,
+                      preferred_element_type=jnp.float32)
 
 
 class EnsembleSimulator:
@@ -890,7 +903,7 @@ class EnsembleSimulator:
                                      "sys", "gwb", "det"),
                  nbins: int = 15, use_pallas: Optional[bool] = None,
                  pallas_precision: str = "bf16", pallas_mxu_binning: bool = True,
-                 bases_dtype: str = "f32",
+                 bases_dtype: str = "f32", stats_dtype: str = "f32",
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
                  cgw_sample=None, white_sample=None, toaerr2=None,
@@ -1209,6 +1222,23 @@ class EnsembleSimulator:
         # XLA's TPU default (accumulation stays f32); realizations shift by
         # the ~4e-3 operand rounding
         self._bases_bf16 = bases_dtype == "bf16"
+        if stats_dtype not in ("f32", "bf16"):
+            raise ValueError(f"stats_dtype must be 'f32' or 'bf16', got "
+                             f"{stats_dtype!r}")
+        # 'bf16' halves the (R, P, T) residual traffic through the all_gather
+        # + correlation contraction — the program's dominant HBM bytes per the
+        # roofline (BASELINE.md round 5). Signal accumulation stays f32; the
+        # cast adds only the operand rounding the TPU matmul already applies
+        # (~4e-3 relative on pair correlations). XLA path only: the fused
+        # Pallas path keeps residuals in VMEM and has its own
+        # pallas_precision knob, so the combination would be silently inert —
+        # reject it instead.
+        self._stats_bf16 = stats_dtype == "bf16"
+        if self._stats_bf16 and self._use_pallas:
+            raise ValueError(
+                "stats_dtype='bf16' applies to the XLA statistic path only "
+                "(a no-op under use_pallas, whose precision is "
+                "pallas_precision); drop one of the two")
 
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
@@ -1248,7 +1278,7 @@ class EnsembleSimulator:
                 term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
                                     cgw_ranges[j], stat, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            return _correlation_rows(res)
+            return _correlation_rows(res, stats_bf16=self._stats_bf16)
 
         roe_specs = tuple(_orbit_state_specs() for _ in range(n_roe))
         samp_specs = tuple(P() for _ in self._samp_params)

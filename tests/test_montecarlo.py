@@ -425,6 +425,35 @@ def test_bf16_bases_parity_and_validation(small_batch):
         EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh, bases_dtype="fp8")
 
 
+def test_bf16_stats_parity_and_validation(small_batch):
+    """stats_dtype='bf16' halves the (R, P, T) residual traffic through the
+    all_gather + correlation contraction (the roofline's dominant bytes);
+    statistics must sit within the documented ~4e-3 operand-rounding bound
+    (same draws — the cast happens at the statistic boundary only)."""
+    cfg = _gwb_cfg(small_batch)
+    mesh = make_mesh(jax.devices()[:1])
+    a = EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh).run(
+        32, seed=5, chunk=16)
+    b = EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh,
+                          stats_dtype="bf16").run(32, seed=5, chunk=16)
+    scale = np.abs(a["curves"]).max()
+    assert np.abs(b["curves"] - a["curves"]).max() < 2e-2 * scale
+    np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-2)
+    # mesh invariance survives the cast (deterministic, before the collective)
+    devs = jax.devices()
+    c = EnsembleSimulator(small_batch, gwb=cfg,
+                          mesh=make_mesh(devs, psr_shards=4),
+                          stats_dtype="bf16").run(32, seed=5, chunk=16)
+    np.testing.assert_allclose(c["curves"], b["curves"], rtol=5e-3,
+                               atol=5e-3 * scale)
+    with pytest.raises(ValueError, match="stats_dtype"):
+        EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh, stats_dtype="fp8")
+    with pytest.raises(ValueError, match="pallas"):
+        # silently-inert combination: the fused path never sees the cast
+        EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh, stats_dtype="bf16",
+                          use_pallas=True)
+
+
 def test_system_noise_band_masked_and_scaled():
     """from_pulsars turns '<backend>_system_noise_<backend>' entries into masked
     GP bands: variance lands only on that backend's TOAs and matches sum(psd*df)."""
