@@ -764,7 +764,8 @@ def _orbit_state_specs():
     return OrbitState(**specs)
 
 
-def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
+def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
+                         waveform=None):
     """(P, T) summed deterministic delay block, or None if nothing configured.
 
     ``cgw``/``roemer`` accept a single config or a sequence. CGW waveforms are
@@ -772,15 +773,48 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype):
     from 28 s TOA quantization is far below the waveform scale); Roemer deltas
     go through the f32-stable difference kernel with the nominal orbit
     propagated host-side in float64.
+
+    ``waveform`` is the engine counterpart of the facade's generic
+    ``add_deterministic`` hook (reference ``fake_pta.py:444-455``): either a
+    precomputed padded (P, T) delay array, or a callable with the FACADE'S
+    contract — ``fn(toas) -> delays`` on ONE pulsar's real (unpadded)
+    absolute epochs — evaluated per pulsar here at host float64, so the same
+    callable injects identically through ``Pulsar.add_deterministic`` and the
+    engine (zero padding never leaks into min/max/span-sensitive waveforms).
+    A sequence mixes both forms; contributions sum. ``toas_abs`` is only
+    required when a callable (or a cgw/roemer config) needs epochs.
     """
     cgw_list = _as_config_list(cgw)
     roe_list = _as_config_list(roemer)
-    if not cgw_list and not roe_list:
+    wf_list = _as_config_list(waveform)
+    if not cgw_list and not roe_list and not wf_list:
         return None
-    toas_abs = _validated_toas_abs(batch, toas_abs,
-                                   "cgw/roemer deterministic signals")
+    if cgw_list or roe_list or any(callable(w) for w in wf_list):
+        toas_abs = _validated_toas_abs(
+            batch, toas_abs, "cgw/roemer/waveform deterministic signals")
 
     det = jnp.zeros(batch.t_own.shape, dtype)
+    mask_np = np.asarray(batch.mask)
+    for wf in wf_list:
+        if callable(wf):
+            arr = np.zeros(batch.t_own.shape)
+            for i in range(batch.npsr):
+                n = int(mask_np[i].sum())
+                row = np.asarray(wf(toas_abs[i, :n]), dtype=np.float64)
+                if row.shape != (n,):
+                    raise ValueError(
+                        f"deterministic waveform returned shape {row.shape} "
+                        f"for pulsar {i} ({n} epochs); the callable contract "
+                        f"is fn(toas) -> delays per pulsar, as in the "
+                        f"facade's add_deterministic")
+                arr[i, :n] = row
+        else:
+            arr = np.asarray(wf, dtype=np.float64)
+            if arr.shape != batch.t_own.shape:
+                raise ValueError(
+                    f"deterministic waveform array has shape {arr.shape}; "
+                    f"expected the padded batch shape {batch.t_own.shape}")
+        det = det + jnp.asarray(arr, dtype)
     if cgw_list:
         from jax import enable_x64
 
@@ -907,7 +941,7 @@ class EnsembleSimulator:
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
                  cgw_sample=None, white_sample=None, toaerr2=None,
-                 backend_id=None):
+                 backend_id=None, waveform=None):
         """``noise_sample`` takes :class:`NoiseSampling` config(s) — per-
         realization (log10_A, gamma) draws replacing the fixed PSD of the
         red/dm/chrom/gwb stages. ``use_pallas`` enables the fused statistic kernel
@@ -1087,7 +1121,8 @@ class EnsembleSimulator:
         # CGW epoch both need more than f32 gives on ~1e9 s). Only built when
         # the 'det' stage is actually enabled.
         self._det = _build_deterministic(
-            batch, cgw, roemer, ephem, toas_abs, pdist, dtype) \
+            batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
+            waveform=waveform) \
             if "det" in include else None
         self._has_det = self._det is not None
         if self._det is None:

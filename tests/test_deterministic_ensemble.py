@@ -109,3 +109,54 @@ def test_missing_toas_abs_raises():
     with pytest.raises(ValueError, match="toas_abs"):
         EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
                           cgw=CGWConfig(**CGW))
+
+
+def test_generic_waveform_hook_matches_facade_add_deterministic():
+    """The engine's ``waveform=`` hook (callable or precomputed (P, T) array)
+    is the counterpart of the facade's generic ``add_deterministic``
+    (reference ``fake_pta.py:444-455``): same injected delays."""
+    def ramp(toas, amp=3e-7):
+        t = np.asarray(toas)
+        return amp * np.sin(2 * np.pi * (t - t.min())
+                            / (t.max() - t.min() + 1.0))
+
+    psrs, ephem = _psrs()
+    for p in psrs:
+        p.make_ideal()
+        p.add_deterministic(ramp, amp=3e-7)
+
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    toas_abs = padded_abs_toas(psrs)
+    mask = np.asarray(batch.mask)
+
+    # the SAME callable the facade consumed works unchanged: the engine
+    # evaluates it per pulsar on real (unpadded) epochs, so min/max-sensitive
+    # waveforms cannot be skewed by the zero padding
+    padded = np.zeros_like(toas_abs)
+    for i in range(toas_abs.shape[0]):
+        n = int(mask[i].sum())
+        padded[i, :n] = ramp(toas_abs[i, :n])
+    for form in (ramp, padded):
+        sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                                waveform=form, toas_abs=toas_abs)
+        det = np.asarray(sim._det)
+        for i, p in enumerate(psrs):
+            n = len(p.toas)
+            np.testing.assert_allclose(det[i, :n], np.asarray(p.residuals),
+                                       rtol=1e-5, err_msg=p.name)
+            np.testing.assert_array_equal(det[i, n:], 0.0)
+
+    import pytest
+
+    # a precomputed array needs no toas_abs at all
+    sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                            waveform=padded)
+    np.testing.assert_allclose(np.asarray(sim._det), padded, rtol=1e-5)
+    # ... but a callable does
+    with pytest.raises(ValueError, match="toas_abs"):
+        EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                          waveform=ramp)
+    # shape mismatches raise instead of broadcasting silently
+    with pytest.raises(ValueError, match="shape"):
+        EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                          waveform=np.zeros((2, 2)), toas_abs=toas_abs)
