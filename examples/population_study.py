@@ -37,6 +37,15 @@ def main():
     ap.add_argument("--red-gamma", type=float, nargs=2, default=(1.0, 5.0))
     ap.add_argument("--cgw", action="store_true",
                     help="also sample a continuous-wave source per realization")
+    ap.add_argument("--white-prior", action="store_true",
+                    help="also marginalize the white-noise dictionary: "
+                         "per-pulsar efac ~ U(0.5, 2.5) and log10_tnequad "
+                         "~ U(-8, -5) per realization (the reference's "
+                         "randomize ranges, as a population prior)")
+    ap.add_argument("--red-spectrum", default="powerlaw",
+                    choices=["powerlaw", "turnover"],
+                    help="red-noise prior family; 'turnover' additionally "
+                         "marginalizes the bend frequency lf0 ~ U(-8.8, -8)")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
@@ -51,7 +60,7 @@ def main():
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (CGWSampling,
                                                  EnsembleSimulator, GWBConfig,
-                                                 NoiseSampling)
+                                                 NoiseSampling, WhiteSampling)
 
     batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
                                   tspan_years=15.0, toaerr=1e-7,
@@ -66,14 +75,24 @@ def main():
     mask = np.asarray(batch.mask, dtype=np.float64)
     counts = mask @ mask.T
 
-    red_prior = NoiseSampling("red", log10_A=tuple(args.red_log10_A),
-                              gamma=tuple(args.red_gamma))
+    if args.red_spectrum == "turnover":
+        red_prior = NoiseSampling(
+            "red", spectrum="turnover",
+            params={"log10_A": tuple(args.red_log10_A),
+                    "gamma": tuple(args.red_gamma), "lf0": (-8.8, -8.0)})
+    else:
+        red_prior = NoiseSampling("red", log10_A=tuple(args.red_log10_A),
+                                  gamma=tuple(args.red_gamma))
     extra = {}
+    if args.white_prior:
+        extra.update(white_sample=WhiteSampling(efac=(0.5, 2.5),
+                                                log10_tnequad=(-8.0, -5.0)),
+                     toaerr2=np.asarray(batch.sigma2))
     if args.cgw:
         toas_abs = np.tile(
             53000.0 * 86400.0 + np.linspace(0.0, 15 * const.yr, args.ntoa),
             (args.npsr, 1))
-        extra = dict(cgw_sample=CGWSampling(tref=float(toas_abs[0].mean())),
+        extra.update(cgw_sample=CGWSampling(tref=float(toas_abs[0].mean())),
                      toas_abs=toas_abs)
 
     runs = {}
@@ -96,9 +115,16 @@ def main():
     print(json.dumps({
         "npsr": args.npsr, "nreal": args.nreal,
         "gwb_log10_A_prior": list(args.gwb_log10_A),
-        "red_prior": {"log10_A": list(args.red_log10_A),
-                      "gamma": list(args.red_gamma)},
+        # the record a consumer would rebuild the prior from: the actual
+        # sampled parameter ranges, not just the CLI echoes
+        "red_prior": {"spectrum": args.red_spectrum,
+                      **({"log10_A": list(args.red_log10_A),
+                          "gamma": list(args.red_gamma)}
+                         if args.red_spectrum == "powerlaw" else
+                         {k: list(v) for k, v in red_prior.params.items()})},
         "cgw_sampled": bool(args.cgw),
+        "white_prior": bool(args.white_prior),
+        "red_spectrum": args.red_spectrum,
         "null_amp2_mean": float(null_os.mean()),
         "null_sigma_empirical": float(os["sigma"]),
         "injected_amp2_mean": float(os["amp2"].mean()),

@@ -212,10 +212,6 @@ def test_post_merger_epochs_finite_not_nan():
     finite delays at every epoch (the quadrupole evolution clamps just below
     merger instead of poisoning the realization with NaNs — the failure mode
     a wide population prior would otherwise hit silently)."""
-    import numpy as np
-
-    from fakepta_tpu.models.cgw import cw_delay
-
     toas = np.linspace(0.0, 15 * 3.15576e7, 400)   # tref=0 epochs
     pos = np.array([0.3, 0.5, np.sqrt(1 - 0.3**2 - 0.5**2)])
     # extreme corner: 10^10 Msun chirp mass at 100 nHz merges in well under

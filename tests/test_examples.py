@@ -95,12 +95,34 @@ def test_population_study_example_runs(tmp_path):
     proc = subprocess.run(
         [sys.executable, str(EXAMPLES / "population_study.py"),
          "--platform", "cpu", "--npsr", "10", "--ntoa", "80",
-         "--nreal", "200", "--chunk", "100", "--cgw",
+         "--nreal", "200", "--chunk", "100", "--cgw", "--white-prior",
+         "--red-spectrum", "turnover",
          "--gwb-log10-A", "-13.4", "-13.0"],
         capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
         env=_repo_env())
     assert proc.returncode == 0, proc.stderr[-2000:]
     row = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert row["cgw_sampled"] is True
+    assert row["cgw_sampled"] is True and row["white_prior"] is True
+    assert row["red_spectrum"] == "turnover"
+    assert row["red_prior"]["spectrum"] == "turnover"
+    assert "lf0" in row["red_prior"], "provenance must record the real prior"
     assert row["detection_significance_sigma"] > 1.0
     assert row["injected_amp2_mean"] > row["null_amp2_mean"]
+
+    # the white prior must be OBSERVABLE, not just echoed: marginalizing
+    # efac ~ U(0.5, 2.5) + log10_tnequad ~ U(-8, -5) inflates the per-TOA
+    # white variance ~500x; cross-pair dilution brings that to a measured
+    # ~1.5x on the null ensemble's empirical sigma. A DROPPED white_sample
+    # (the regression this guards) reproduces the no-flag run bit-for-bit —
+    # ratio 1.00 — so 1.2x separates the two decisively.
+    base = subprocess.run(
+        [sys.executable, str(EXAMPLES / "population_study.py"),
+         "--platform", "cpu", "--npsr", "10", "--ntoa", "80",
+         "--nreal", "200", "--chunk", "100", "--cgw",
+         "--red-spectrum", "turnover",
+         "--gwb-log10-A", "-13.4", "-13.0"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert base.returncode == 0, base.stderr[-2000:]
+    row_base = json.loads(base.stdout.strip().splitlines()[-1])
+    assert row["null_sigma_empirical"] > 1.2 * row_base["null_sigma_empirical"]
