@@ -48,6 +48,23 @@ def _scaled(nreal, chunk):
     n -= n % chunk
     return max(n, chunk), chunk
 
+def _hd_psd(batch, ncomp=30):
+    """The standard HD-background PSD (A=2e-15, gamma=13/3) on the batch's
+    common grid — the config every ensemble benchmark injects."""
+    from fakepta_tpu import spectrum as spectrum_lib
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    return np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
+                                            gamma=13 / 3))
+
+
+def _ensemble_rate(sim, nreal, chunk):
+    """Warm (compile) one chunk, then measure steady-state realizations/s."""
+    sim.run(chunk, seed=9, chunk=chunk)
+    t0 = time.perf_counter()
+    sim.run(nreal, seed=1, chunk=chunk)
+    return nreal / (time.perf_counter() - t0)
+
+
 def _timeit(fn, repeats=3):
     fn()                                   # warm (compile)
     best = float("inf")
@@ -136,7 +153,6 @@ def config6():
     realizations — no per-pulsar host loop anywhere."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
@@ -146,9 +162,7 @@ def config6():
     npsr, ntoa = 100, 780
     batch = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     toas_abs = _flagship_toas_abs(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"),
@@ -156,14 +170,11 @@ def config6():
         roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
         toas_abs=toas_abs, mesh=make_mesh(jax.devices()))
     nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     return {"config": 6,
             "metric": "GWB+DM+BayesEphem realizations/s/chip (100 psr, one "
                       "device program)",
-            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
 
 
 def config7():
@@ -202,14 +213,11 @@ def config7():
     sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()),
                             include=("white", "ecorr", "red", "dm", "sys"))
     nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     return {"config": 7,
             "metric": "full-noise realizations/s/chip (40 psr, ECORR + "
                       "2-backend system noise)",
-            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
 
 
 def config8():
@@ -220,7 +228,6 @@ def config8():
     config 5's fixed-PSD program."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
@@ -229,9 +236,7 @@ def config8():
     n_dev = len(jax.devices())
     batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
         noise_sample=[NoiseSampling("red", log10_A=(-17.0, -13.0),
@@ -239,14 +244,11 @@ def config8():
                       NoiseSampling("gwb", log10_A=(-15.0, -14.0),
                                     gamma=(13 / 3, 13 / 3))])
     nreal, chunk = _scaled(100_000, 10_000)
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     return {"config": 8,
             "metric": "hyperparameter-sampled realizations/s/chip (100 psr, "
                       "per-psr red + GWB draws)",
-            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
 
 
 def config9():
@@ -257,7 +259,6 @@ def config9():
     the continuous-wave population workload the reference cannot express."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (CGWSampling,
@@ -267,23 +268,18 @@ def config9():
     npsr, ntoa = 100, 780
     batch = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     toas_abs = _flagship_toas_abs(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
         cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
         toas_abs=toas_abs)
     nreal, chunk = _scaled(40_000, 4000)
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     return {"config": 9,
             "metric": "CW-population realizations/s/chip (100 psr, sampled "
                       "SMBHB source per realization)",
-            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
 
 
 def config10():
@@ -293,7 +289,6 @@ def config10():
     testable. Reports the compiled chunk program's memory reservation."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
@@ -301,19 +296,14 @@ def config10():
     n_dev = len(jax.devices())
     batch = PulsarBatch.synthetic(npsr=256, ntoa=780, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()))
     nreal, chunk = _scaled(16_000, 2000)
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     row = {"config": 10,
            "metric": "scale-out realizations/s/chip (256 psr, HD GWB)",
-           "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+           "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
     # THIS program's static reservation (memory_analysis), not
     # memory_stats()'s process-lifetime allocator peak — in a full sweep the
     # latter would report whatever earlier config peaked highest
@@ -336,7 +326,6 @@ def config11():
     the white-sampling overhead against config 5's fixed-sigma2 program."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
@@ -345,9 +334,7 @@ def config11():
     n_dev = len(jax.devices())
     batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
         white_sample=WhiteSampling(efac=(0.5, 2.5),
@@ -356,21 +343,17 @@ def config11():
         # provenance warning)
         toaerr2=np.asarray(batch.sigma2))
     nreal, chunk = _scaled(100_000, 10_000)
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     return {"config": 11,
             "metric": "white-sampled realizations/s/chip (100 psr, per-psr "
                       "efac/equad draws)",
-            "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
 
 
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
 
-    from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
@@ -378,22 +361,17 @@ def config5():
     n_dev = len(jax.devices())
     batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
                                   toaerr=1e-7, n_red=30, n_dm=100, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
-    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
-                                           gamma=13 / 3))
+    psd = _hd_psd(batch)
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()))
     # 10k-realization chunks pipeline on device with one packed host fetch at
     # the end; 100k total measures steady-state throughput (matches bench.py)
     nreal, chunk = _scaled(100_000, 10_000)
-    sim.run(chunk, seed=9, chunk=chunk)
-    t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    t = time.perf_counter() - t0
+    rate = _ensemble_rate(sim, nreal, chunk)
     row = {"config": 5,
            "metric": "PTA realizations/sec/chip (100 psr, 15 yr, HD GWB)",
-           "value": round(nreal / t / n_dev, 2), "unit": "real/s/chip",
-           "vs_baseline": round(nreal / t / n_dev / (10_000 / (60.0 * 8)), 2)}
+           "value": round(rate / n_dev, 2), "unit": "real/s/chip",
+           "vs_baseline": round(rate / n_dev / (10_000 / (60.0 * 8)), 2)}
 
     # Peak device memory (allocator stats where the plugin provides them, else
     # XLA's static reservation for the chunk program) and an MFU estimate from
@@ -414,7 +392,7 @@ def config5():
         ca = ca[0] if isinstance(ca, (list, tuple)) else ca
         flops = float(ca.get("flops", 0.0)) * (nreal / chunk)
         if flops > 0:
-            achieved = flops / t / n_dev
+            achieved = flops * rate / nreal / n_dev
             row["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
             # v5e bf16 MXU peak ~197 TFLOP/s; this program is float32, so the
             # number is a conservative model-flops-utilization estimate

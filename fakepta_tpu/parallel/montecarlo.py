@@ -317,9 +317,10 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
     plus a clock monopole — ride one program; config 0 keeps the original
     key stream, so single-signal realizations are bit-identical to before).
     gwb_idxs/gwb_freqfs: matching static tuples.
-    samp_static: static tuple of (target, dist) pairs for per-realization
-    hyperparameter sampling (:class:`NoiseSampling`); samp_params the matching
-    traced (2, 2) [[A_a, A_b], [gamma_a, gamma_b]] arrays.
+    samp_static: static tuple of resolved NoiseSampling descriptors
+    ``(target, spectrum, names, per_bin flags, dist per param)`` (see
+    :func:`_resolve_noise_sampling`); samp_params the matching traced
+    (n_params, 2) range arrays in draw order.
     white_static: static (sample_efac, sample_equad, sample_ecorr, dist) for
     per-realization white sampling (:class:`WhiteSampling`); white_params the
     traced (3, 2) range array, white_toaerr2/white_bid the local (P, T) raw
@@ -508,27 +509,31 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             s_efac, s_equad, s_ecorr, wdist = white_static
             wroot = jax.random.fold_in(key, _WHITE_TAG)
             kp = jax.vmap(lambda g: jax.random.fold_in(wroot, g))(gidx)
-            z = jax.vmap(lambda k: (
+            zw = jax.vmap(lambda k: (
                 jax.random.uniform(k, (white_nb, 3), dtype)
                 if wdist == "uniform"
                 else jax.random.normal(k, (white_nb, 3), dtype)))(kp)  # (P,B,3)
+            # eager (P, B, 3) values, not a closure over the draw (a closure
+            # here once invited silent capture of later same-named arrays)
+            wscale = (white_params[:, 1] - white_params[:, 0]
+                      if wdist == "uniform" else white_params[:, 1])
+            wvals = white_params[:, 0] + zw * wscale
 
-            def wval(i):
-                a, b = white_params[i, 0], white_params[i, 1]
-                v = a + z[..., i] * ((b - a) if wdist == "uniform" else b)
-                return jnp.take_along_axis(v, white_bid, axis=1)       # (P,T)
+            def wgather(i):
+                return jnp.take_along_axis(wvals[..., i], white_bid,
+                                           axis=1)                     # (P,T)
 
             if include_white:
                 sigma2_eff = white_toaerr2
                 if s_efac:
-                    sigma2_eff = wval(0) ** 2 * sigma2_eff
+                    sigma2_eff = wgather(0) ** 2 * sigma2_eff
                 if s_equad:
-                    sigma2_eff = sigma2_eff + 10.0 ** (2.0 * wval(1))
+                    sigma2_eff = sigma2_eff + 10.0 ** (2.0 * wgather(1))
             if s_ecorr:
                 # the where-gate keeps padding TOAs and single-TOA epochs
                 # excluded exactly as the fixed path resolved them
                 ecorr_eff = jnp.where(batch.ecorr_amp > 0.0,
-                                      10.0 ** wval(2), 0.0)
+                                      10.0 ** wgather(2), 0.0)
 
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
@@ -569,9 +574,6 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             gwb_c = [None] * len(gwb_bases)
             for j, (chol_j, w_j) in enumerate(zip(chols, gwb_ws)):
                 kg = tag if j == 0 else jax.random.fold_in(tag, j)
-                # NB: not named `z` — the white-sampling closure `wval` above
-                # captures its `z` by reference; shadowing it here would make
-                # any later wval call silently read GWB normals
                 zg = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
                 corr = zg @ chol_j.T
                 corr_local = lax.dynamic_slice_in_dim(
