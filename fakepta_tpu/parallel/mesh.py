@@ -1,10 +1,14 @@
 """Device-mesh helpers: the framework's distributed-communication layer.
 
 The reference has no parallelism or communication backend at all (SURVEY.md §5);
-scaling here is pure SPMD: a 2-D ``jax.sharding.Mesh`` with a ``'real'`` axis for
-Monte-Carlo realizations (embarrassingly parallel, the data-parallel analog) and a
+scaling here is pure SPMD: a 3-D ``jax.sharding.Mesh`` with a ``'real'`` axis for
+Monte-Carlo realizations (embarrassingly parallel, the data-parallel analog), a
 ``'psr'`` axis for pulsars (the model-parallel analog — cross-pulsar statistics
-ride XLA collectives: ``all_gather`` over 'psr', ``psum`` reductions over 'real').
+ride XLA collectives: ``all_gather`` over 'psr', ``psum`` reductions over 'real'),
+and a ``'toa'`` axis for the time dimension — the sequence-parallel analog for
+long datasets: per-TOA state shards over 'toa', and the correlation statistic
+(a reduction over TOAs) closes with one ``psum`` over the axis, the
+reduction-shaped counterpart of ring/all-to-all sequence parallelism.
 Collectives are inserted by shard_map/GSPMD over ICI on real hardware; the same
 program runs unchanged on the virtual CPU mesh used in tests.
 """
@@ -19,22 +23,27 @@ from jax.sharding import Mesh
 
 REAL_AXIS = "real"
 PSR_AXIS = "psr"
+TOA_AXIS = "toa"
 
 
-def make_mesh(devices: Optional[Sequence] = None, psr_shards: int = 1) -> Mesh:
-    """Build the (real, psr) mesh over the given (default: all) devices.
+def make_mesh(devices: Optional[Sequence] = None, psr_shards: int = 1,
+              toa_shards: int = 1) -> Mesh:
+    """Build the (real, psr, toa) mesh over the given (default: all) devices.
 
-    ``psr_shards`` must divide the device count; the remaining devices go to the
-    realization axis. One device -> a 1x1 mesh, so every code path is identical on
-    a laptop CPU, one TPU chip, or a pod slice. In a multi-host program
-    ``jax.devices()`` already spans every process (after
+    ``psr_shards * toa_shards`` must divide the device count; the remaining
+    devices go to the realization axis. One device -> a 1x1x1 mesh, so every
+    code path is identical on a laptop CPU, one TPU chip, or a pod slice. In a
+    multi-host program ``jax.devices()`` already spans every process (after
     :func:`initialize_multihost`), so the same call builds the global pod mesh.
     """
     devices = list(devices if devices is not None else jax.devices())
-    if len(devices) % psr_shards != 0:
-        raise ValueError(f"psr_shards={psr_shards} must divide {len(devices)} devices")
-    grid = np.array(devices).reshape(len(devices) // psr_shards, psr_shards)
-    return Mesh(grid, (REAL_AXIS, PSR_AXIS))
+    model = psr_shards * toa_shards
+    if len(devices) % model != 0:
+        raise ValueError(f"psr_shards*toa_shards={model} must divide "
+                         f"{len(devices)} devices")
+    grid = np.array(devices).reshape(len(devices) // model, psr_shards,
+                                     toa_shards)
+    return Mesh(grid, (REAL_AXIS, PSR_AXIS, TOA_AXIS))
 
 
 def initialize_multihost(coordinator_address: Optional[str] = None,

@@ -38,7 +38,12 @@ from jax.sharding import PartitionSpec as P
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
 from ..utils import rng as rng_utils
-from .mesh import PSR_AXIS, REAL_AXIS, make_mesh, to_host
+from .mesh import PSR_AXIS, REAL_AXIS, TOA_AXIS, make_mesh, to_host
+
+# PulsarBatch fields whose LAST axis is the TOA dimension (shard over 'toa');
+# sys_mask carries it behind the band axis
+_BATCH_TOA_FIELDS = ("t_own", "t_common", "mask", "freqs", "sigma2",
+                     "epoch_idx", "ecorr_amp")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -307,7 +312,7 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                     include_chrom, include_sys, include_gwb,
                     samp_static=(), samp_params=(), bases_bf16=False,
                     white_static=None, white_params=None, white_toaerr2=None,
-                    white_bid=None, white_nb=1):
+                    white_bid=None, white_nb=1, toa_shards=1):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -326,6 +331,12 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
     traced (3, 2) range array, white_toaerr2/white_bid the local (P, T) raw
     squared TOA errors and int32 backend partition, white_nb the static
     backend count.
+    toa_shards: static size of the 'toa' mesh axis. Per-TOA draws (white,
+    ECORR epoch normals) generate at the FULL TOA width from the same
+    per-pulsar keys and slice locally, so realization streams are
+    bit-identical to the unsharded program on any time sharding; every other
+    draw (GP/GWB coefficients, hyperparameters, sources) is T-independent and
+    identical on every time shard by key construction.
     """
     from .. import spectrum as spectrum_lib
     p_local = batch.t_own.shape[0]
@@ -535,14 +546,36 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                 ecorr_eff = jnp.where(batch.ecorr_amp > 0.0,
                                       10.0 ** wgather(2), 0.0)
 
+        # per-TOA draws under time sharding: generate at the FULL width from
+        # the same keys and slice this shard's window — values per global TOA
+        # are bit-identical to the unsharded program (XLA computes only the
+        # sliced elements: the RNG is an elementwise map over iota, and the
+        # slice fuses into it)
+        if toa_shards > 1:
+            full_T = T * toa_shards
+            t0 = lax.axis_index(TOA_AXIS) * T
+
+            def draw_toa(keys_p):
+                return lax.dynamic_slice_in_dim(draw(keys_p, full_T), t0, T,
+                                                axis=1)
+        else:
+            full_T = T
+
+            def draw_toa(keys_p):
+                return draw(keys_p, T)
+
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
-            res = res + jnp.sqrt(sigma2_eff) * draw(kw, T)
+            res = res + jnp.sqrt(sigma2_eff) * draw_toa(kw)
         if include_ecorr:
             # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
             # ONE shared normal per epoch: no per-block Cholesky (the reference
-            # draws a dense MVN per block, fake_pta.py:219-228)
-            shared = jnp.take_along_axis(draw(ke, T), batch.epoch_idx, axis=1)
+            # draws a dense MVN per block, fake_pta.py:219-228). Epoch ids are
+            # GLOBAL, so the epoch normals index the full-width draw — epochs
+            # straddling a time-shard boundary see the same shared normal on
+            # both shards
+            shared = jnp.take_along_axis(draw(ke, full_T), batch.epoch_idx,
+                                         axis=1)
             res = res + ecorr_eff * shared
         coeffs = []
         if include_red:
@@ -754,13 +787,14 @@ def _validated_toas_abs(batch, toas_abs, what: str) -> np.ndarray:
     return toas_abs
 
 
-def _orbit_state_specs():
-    """PartitionSpecs for an OrbitState: per-TOA leaves shard over 'psr',
-    the scalar masses replicate (mirrors :func:`_batch_specs`)."""
+def _orbit_state_specs(has_toa=False):
+    """PartitionSpecs for an OrbitState: per-TOA leaves shard over 'psr' (and
+    'toa' when the mesh has the axis — every leaf's TOA dim is axis 1), the
+    scalar masses replicate (mirrors :func:`_batch_specs`)."""
     from ..models.roemer import OrbitState
 
-    specs = {f.name: P(PSR_AXIS)
-             for f in dataclasses.fields(OrbitState)}
+    leaf = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
+    specs = {f.name: leaf for f in dataclasses.fields(OrbitState)}
     specs["mass"] = P()
     specs["mass_ss"] = P()
     return OrbitState(**specs)
@@ -887,16 +921,21 @@ def unpack_stats(packed, nbins: int):
     return packed[:, :nbins], packed[:, nbins]
 
 
-def _batch_specs():
+def _batch_specs(has_toa=False):
     """PartitionSpecs for a PulsarBatch: every (npsr, ...) leaf shards over the
-    psr axis, scalars replicate. Derived from the dataclass fields so adding a
-    field to PulsarBatch cannot silently miss a spec."""
+    psr axis, per-TOA trailing axes additionally over 'toa' (when the mesh has
+    the axis), scalars replicate. Derived from the dataclass fields so adding
+    a field to PulsarBatch cannot silently miss a spec."""
     specs = {f.name: P(PSR_AXIS) for f in dataclasses.fields(PulsarBatch)}
+    if has_toa:
+        for name in _BATCH_TOA_FIELDS:
+            specs[name] = P(PSR_AXIS, TOA_AXIS)
+        specs["sys_mask"] = P(PSR_AXIS, None, TOA_AXIS)
     specs["tspan_common"] = P()
     return PulsarBatch(**specs)
 
 
-def _correlation_rows(res_local, stats_bf16=False):
+def _correlation_rows(res_local, stats_bf16=False, toa_psum=False):
     """Raw cross-correlation rows via the program's one collective.
 
     all_gathers the residual blocks over 'psr' and contracts local rows against
@@ -922,8 +961,14 @@ def _correlation_rows(res_local, stats_bf16=False):
     if stats_bf16:
         res_local = res_local.astype(jnp.bfloat16)
     res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
-    return jnp.einsum("rpt,rqt->rpq", res_local, res_full,
+    corr = jnp.einsum("rpt,rqt->rpq", res_local, res_full,
                       preferred_element_type=jnp.float32)
+    if toa_psum:
+        # sequence parallelism's closing collective: the pair products are a
+        # reduction over TOAs, so time shards contribute partial sums and one
+        # psum over 'toa' completes them (replicating corr over the axis)
+        corr = lax.psum(corr, TOA_AXIS)
+    return corr
 
 
 class EnsembleSimulator:
@@ -964,6 +1009,37 @@ class EnsembleSimulator:
             raise ValueError(
                 f"npsr={batch.npsr} must be divisible by the psr mesh axis "
                 f"({n_psr_shards}); pad the batch")
+        # the 'toa' axis (sequence parallelism for long datasets) is optional
+        # so externally-built 2-D (real, psr) meshes keep working
+        self._has_toa = TOA_AXIS in self.mesh.shape
+        self._n_toa_shards = (self.mesh.shape[TOA_AXIS]
+                              if self._has_toa else 1)
+        if batch.max_toa % self._n_toa_shards != 0:
+            raise ValueError(
+                f"max_toa={batch.max_toa} must be divisible by the toa mesh "
+                f"axis ({self._n_toa_shards}); pad the batch")
+        if self._n_toa_shards > 1:
+            # restore _batch_specs' cannot-silently-miss guarantee for the
+            # 'toa' dimension: any batch leaf whose trailing axis is the TOA
+            # width must be in the shard list, else it would enter the
+            # shard_map body at full width beside local-width siblings
+            known = set(_BATCH_TOA_FIELDS) | {"sys_mask"}
+            for fld in dataclasses.fields(PulsarBatch):
+                arr = getattr(batch, fld.name)
+                if (getattr(arr, "ndim", 0) >= 2
+                        and arr.shape[-1] == batch.max_toa
+                        and fld.name not in known):
+                    raise AssertionError(
+                        f"PulsarBatch.{fld.name} has a TOA-width trailing "
+                        f"axis but is not listed in _BATCH_TOA_FIELDS; add "
+                        f"it (or, if the width match is coincidental — e.g. "
+                        f"a bin count equal to max_toa — rename this check's "
+                        f"exemptions)")
+        if self._n_toa_shards > 1 and use_pallas:
+            raise ValueError(
+                "use_pallas is incompatible with toa sharding (the fused "
+                "kernel assumes each shard holds the full TOA axis); drop "
+                "one of the two")
         self.batch = batch
         self.nbins = nbins
         self._n_real_shards = n_real_shards
@@ -1282,7 +1358,9 @@ class EnsembleSimulator:
 
     def _build_step(self):
         mesh = self.mesh
-        batch_specs = _batch_specs()
+        has_toa = self._has_toa
+        toa_shards = self._n_toa_shards
+        batch_specs = _batch_specs(has_toa)
         inc = self._include
         has_det = self._has_det
         roe_scales = self._roe_scales
@@ -1304,7 +1382,8 @@ class EnsembleSimulator:
                                   white_static=white_static,
                                   white_params=white_params,
                                   white_toaerr2=white_toaerr2,
-                                  white_bid=white_bid, white_nb=white_nb)
+                                  white_bid=white_bid, white_nb=white_nb,
+                                  toa_shards=toa_shards)
             if has_det:
                 res = res + det[None]
             for j in range(n_roe):
@@ -1315,17 +1394,23 @@ class EnsembleSimulator:
                 term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
                                     cgw_ranges[j], stat, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            return _correlation_rows(res, stats_bf16=self._stats_bf16)
+            return _correlation_rows(res, stats_bf16=self._stats_bf16,
+                                     toa_psum=has_toa)
 
-        roe_specs = tuple(_orbit_state_specs() for _ in range(n_roe))
+        # (P, T) side inputs shard over 'toa' like the batch's per-TOA leaves;
+        # the no-sampling white dummies are (P, 1) broadcast shapes and stay
+        # replicated over 'toa'
+        pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
+        white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
+        roe_specs = tuple(_orbit_state_specs(has_toa) for _ in range(n_roe))
         samp_specs = tuple(P() for _ in self._samp_params)
-        cgw_trel_specs = tuple(P(PSR_AXIS) for _ in self._cgw_trel)
+        cgw_trel_specs = tuple(pt_spec for _ in self._cgw_trel)
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs,
                       tuple(P() for _ in self._chol),
-                      tuple(P() for _ in self._gwb_w), P(PSR_AXIS),
-                      samp_specs, P(), P(PSR_AXIS), P(PSR_AXIS),
+                      tuple(P() for _ in self._gwb_w), pt_spec,
+                      samp_specs, P(), white_spec, white_spec,
                       cgw_trel_specs, P(PSR_AXIS), *roe_specs),
             out_specs=P(REAL_AXIS, PSR_AXIS),
         )
@@ -1372,7 +1457,8 @@ class EnsembleSimulator:
             [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]], axis=0)
 
         mesh = self.mesh
-        batch_specs = _batch_specs()
+        has_toa = self._has_toa   # size-1 only: toa_shards > 1 raises at init
+        batch_specs = _batch_specs(has_toa)
         inc = self._include
         nbins = self.nbins
         interpret = self._pallas_interpret
@@ -1422,16 +1508,18 @@ class EnsembleSimulator:
             # the only other collective: reduce partial bin sums over psr shards
             return (lax.psum(curves_p, PSR_AXIS), lax.psum(autos_p, PSR_AXIS))
 
+        pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
+        white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
         shmapped = jax.shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs,
                       tuple(P() for _ in self._chol),
                       tuple(P() for _ in self._gwb_w),
-                      P(None, PSR_AXIS, None), P(PSR_AXIS),
+                      P(None, PSR_AXIS, None), pt_spec,
                       tuple(P() for _ in self._samp_params),
-                      P(), P(PSR_AXIS), P(PSR_AXIS),
-                      tuple(P(PSR_AXIS) for _ in self._cgw_trel), P(PSR_AXIS),
-                      *(tuple(_orbit_state_specs()
+                      P(), white_spec, white_spec,
+                      tuple(pt_spec for _ in self._cgw_trel), P(PSR_AXIS),
+                      *(tuple(_orbit_state_specs(has_toa)
                               for _ in range(n_roe)))),
             out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
             # pallas_call does not annotate vma on its outputs; the psum above

@@ -1,0 +1,131 @@
+"""Time-axis (sequence) parallelism: the 'toa' mesh axis.
+
+Long-dataset scaling the reference cannot express at all: per-TOA state
+shards over the third mesh axis, per-TOA draws generate at full width from
+the same keys and slice locally (streams bit-identical to the unsharded
+program), and the correlation statistic — a reduction over TOAs — closes
+with one psum over 'toa' (the reduction-shaped counterpart of ring/
+all-to-all sequence parallelism on TPU).
+"""
+
+import numpy as np
+import jax
+import pytest
+
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.fake_pta import Pulsar
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import (CGWSampling, EnsembleSimulator,
+                                             GWBConfig, NoiseSampling,
+                                             RoemerConfig, WhiteSampling)
+
+MJD0_S = 53000.0 * 86400.0
+
+
+@pytest.fixture
+def batch():
+    return PulsarBatch.synthetic(npsr=8, ntoa=128, tspan_years=10.0,
+                                 toaerr=1e-7, n_red=8, n_dm=8, seed=1)
+
+
+def _gwb(batch, ncomp=8, log10_A=-13.5):
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    return GWBConfig(psd=np.asarray(spectrum_lib.powerlaw(
+        f, log10_A=log10_A, gamma=13 / 3)), orf="hd")
+
+
+def _run(batch, mesh, **kw):
+    return EnsembleSimulator(batch, mesh=mesh, **kw).run(16, seed=3, chunk=8)
+
+
+def test_toa_sharded_streams_match_unsharded(batch):
+    """The full program (white + red + DM + GWB + sampling) on toa shards
+    {2, 4} must reproduce the single-device run: per-TOA draws slice the same
+    full-width streams, everything else is T-independent by key construction.
+    Only f32 reduction order differs (the psum)."""
+    devs = jax.devices()
+    kw = dict(gwb=_gwb(batch),
+              noise_sample=NoiseSampling("red", log10_A=(-14.5, -13.5),
+                                         gamma=(2.0, 5.0)),
+              white_sample=WhiteSampling(efac=(0.5, 2.5),
+                                         log10_tnequad=(-8.0, -6.0)),
+              toaerr2=np.asarray(batch.sigma2))
+    ref = _run(batch, make_mesh(devs[:1]), **kw)
+    for toa_shards in (2, 4):
+        got = _run(batch, make_mesh(devs, toa_shards=toa_shards), **kw)
+        np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
+                                   atol=1e-7 * np.abs(ref["curves"]).max())
+        np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
+
+
+def test_toa_and_psr_sharding_compose(batch):
+    """A (real=2, psr=2, toa=2) mesh — all three axes active — reproduces the
+    single-device realizations."""
+    devs = jax.devices()
+    assert len(devs) >= 8
+    kw = dict(gwb=_gwb(batch))
+    ref = _run(batch, make_mesh(devs[:1]), **kw)
+    got = _run(batch, make_mesh(devs, psr_shards=2, toa_shards=2), **kw)
+    np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
+                               atol=1e-7 * np.abs(ref["curves"]).max())
+    np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
+
+
+def test_toa_sharded_ecorr_straddling_epochs():
+    """ECORR epochs that straddle a time-shard boundary must see the SAME
+    shared epoch normal on both shards (the epoch draw indexes a full-width
+    stream by global epoch id)."""
+    day = 86400.0
+    # 16 epochs x 8 TOAs = 128 TOAs; toa_shards=4 puts shard boundaries at
+    # TOA 32/64/96 — inside epochs 4, 8 and 12
+    toas = np.concatenate([k * 30 * day + np.arange(8) * 600.0
+                           for k in range(16)])
+    psrs = []
+    for k in range(8):
+        p = Pulsar(toas, 1e-7, np.arccos(1 - 2 * (k + 0.5) / 8),
+                   2.39996 * k % (2 * np.pi), seed=k,
+                   custom_model={"RN": 4, "DM": None, "Sv": None})
+        p.noisedict[f"{p.name}_{p.backends[0]}_log10_ecorr"] = -6.3
+        psrs.append(p)
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4, ecorr=True)
+    assert bool(np.any(np.asarray(batch.ecorr_amp) > 0))
+    devs = jax.devices()
+    kw = dict(include=("white", "ecorr", "red"))
+    ref = _run(batch, make_mesh(devs[:1]), **kw)
+    got = _run(batch, make_mesh(devs, toa_shards=4), **kw)
+    np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
+                               atol=1e-7 * np.abs(ref["curves"]).max())
+    np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
+
+
+@pytest.mark.slow
+def test_toa_sharded_deterministic_and_sampled_signals(batch):
+    """CGW-source sampling, BayesEphem perturbations and the deterministic
+    block all ride the sharded time axis."""
+    devs = jax.devices()
+    toas_abs = np.tile(MJD0_S + np.linspace(0, 10 * 3.15576e7, 128), (8, 1))
+    kw = dict(gwb=_gwb(batch),
+              roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
+              cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
+              toas_abs=toas_abs)
+    ref = _run(batch, make_mesh(devs[:1]), **kw)
+    got = _run(batch, make_mesh(devs, toa_shards=2), **kw)
+    scale = np.abs(ref["curves"]).max()
+    np.testing.assert_allclose(got["curves"], ref["curves"], atol=1e-4 * scale)
+    np.testing.assert_allclose(got["autos"], ref["autos"], rtol=1e-4)
+
+
+def test_toa_sharding_validation(batch):
+    devs = jax.devices()
+    with pytest.raises(ValueError, match="toa mesh"):
+        # 128 TOAs not divisible by 8... use a batch with an odd count
+        odd = PulsarBatch.synthetic(npsr=8, ntoa=130, tspan_years=10.0,
+                                    seed=1)
+        EnsembleSimulator(odd, mesh=make_mesh(devs, toa_shards=4))
+    with pytest.raises(ValueError, match="pallas"):
+        EnsembleSimulator(batch, gwb=_gwb(batch),
+                          mesh=make_mesh(devs, toa_shards=2),
+                          use_pallas=True)
+    with pytest.raises(ValueError, match="toa_shards"):
+        make_mesh(devs, psr_shards=4, toa_shards=3)
