@@ -16,12 +16,16 @@ import os
 import pathlib
 import sys
 
-# single source of truth for the worker AND test_multihost.py's oracle
+# single source of truth for the worker AND test_multihost.py's oracle.
+# The global (real=2, psr=2, toa=2) mesh spans both processes, so the
+# all_gather over 'psr' AND the sequence-parallel psum over 'toa' both cross
+# the process boundary.
 SIM = dict(npsr=8, ntoa=64, tspan_years=10.0, toaerr=1e-7, n_red=8, n_dm=8,
            seed=1)
 GWB = dict(log10_A=-13.5, gamma=13 / 3, ncomp=8)
 RUN = dict(nreal=16, seed=3, chunk=8)
 PSR_SHARDS = 2
+TOA_SHARDS = 2
 
 
 def build_sim(mesh):
@@ -56,8 +60,9 @@ def main():
     # environment-unavailable skip
     print("MULTIHOST_INIT_OK", file=sys.stderr, flush=True)
 
-    # global mesh: 'real' x 'psr' both span the two processes' devices
-    sim = build_sim(make_mesh(jax.devices(), psr_shards=PSR_SHARDS))
+    # global mesh: 'real' x 'psr' x 'toa' all span the two processes' devices
+    sim = build_sim(make_mesh(jax.devices(), psr_shards=PSR_SHARDS,
+                              toa_shards=TOA_SHARDS))
 
     # per-process private checkpoint dir: only process 0 may create files
     # (run() gates saves on jax.process_index())

@@ -102,11 +102,13 @@ def test_two_process_run_matches_single_host(tmp_path):
     assert all(not files for files in by_pid[1]["ckpt_files_mid_run"])
 
     # the 2-process global mesh reproduces the single-host run exactly
-    # (streams are mesh-placement independent; same global (4, 2) shape;
-    # config single-sourced from the worker module so oracle and workers
-    # cannot drift)
+    # (streams are mesh-placement independent; same global (2, 2, 2) shape
+    # with the sequence-parallel psum crossing the process boundary; config
+    # single-sourced from the worker module so oracle and workers cannot
+    # drift)
     ref = worker_cfg.build_sim(
-        make_mesh(jax.devices(), psr_shards=worker_cfg.PSR_SHARDS)
+        make_mesh(jax.devices(), psr_shards=worker_cfg.PSR_SHARDS,
+                  toa_shards=worker_cfg.TOA_SHARDS)
     ).run(worker_cfg.RUN["nreal"], seed=worker_cfg.RUN["seed"],
           chunk=worker_cfg.RUN["chunk"])
     scale = np.abs(ref["curves"]).max()
