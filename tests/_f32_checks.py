@@ -105,6 +105,17 @@ def main():
     out["pallas_autos_rel_err"] = float(
         np.abs(b["autos"] - a["autos"]).max() / np.abs(a["autos"]).max())
 
+    # 5b. time-sharded mesh at f32: the sequence-parallel program (full-width
+    # RNG sliced locally + psum over 'toa') must reproduce the unsharded
+    # statistics at device-default precision
+    sim_t = EnsembleSimulator(batch, gwb=GWBConfig(psd=gwb_psd, orf="hd"),
+                              include=("white", "gwb"),
+                              mesh=make_mesh(jax.devices(), toa_shards=2))
+    c = sim_t.run(8, seed=41, chunk=8)
+    out["toa_sharded_rel_err"] = float(
+        np.abs(c["curves"] - a["curves"]).max()
+        / np.abs(a["curves"]).max())
+
     # 6. joint dense-covariance GWB (the reference's dead draft) at f32:
     # finite injection, remove inverts add
     from fakepta_tpu.correlated_noises import add_common_correlated_noise_gp

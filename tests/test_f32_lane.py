@@ -83,6 +83,12 @@ def test_f32_pallas_interpret_matches_xla(f32):
     assert f32["pallas_autos_rel_err"] < 1e-4, f32["pallas_autos_rel_err"]
 
 
+def test_f32_toa_sharded_matches_unsharded(f32):
+    # sequence parallelism at device-default f32: full-width RNG slicing +
+    # the closing psum reproduce the single-device run to reduction roundoff
+    assert f32["toa_sharded_rel_err"] < 1e-4, f32["toa_sharded_rel_err"]
+
+
 def test_f32_joint_covariance_gwb(f32):
     # the joint dense-covariance GWB injects finite residuals and remove
     # inverts add at f32
