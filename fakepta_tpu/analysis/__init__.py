@@ -1,0 +1,21 @@
+"""Static analysis for the engine's correctness invariants.
+
+An stdlib-``ast`` linter enforcing, at review time, the contracts the test
+suite can only spot-check at runtime: RNG stream discipline, host-sync and
+tracer hygiene inside jitted scopes, the per-module dtype policy, and the
+mesh-axis naming contract. See docs/INVARIANTS.md for the catalogue and
+``python -m fakepta_tpu.analysis check fakepta_tpu/ tests/ examples/`` for
+the CLI the tier-1 suite runs.
+
+Suppression: ``# fakepta: allow[rule-id] <one-line justification>`` on (or
+standalone above) the offending line, or the committed baseline
+(``fakepta_tpu/analysis/baseline.json``). Unjustified pragmas are findings
+themselves.
+"""
+
+from .engine import (Finding, apply_baseline, check_paths, check_source,
+                     load_baseline, save_baseline)
+from .rules import ALL_RULES, RULE_IDS
+
+__all__ = ["Finding", "ALL_RULES", "RULE_IDS", "apply_baseline",
+           "check_paths", "check_source", "load_baseline", "save_baseline"]

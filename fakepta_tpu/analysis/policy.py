@@ -1,0 +1,72 @@
+"""Per-module policy tables the rules cross-check against.
+
+This is the one place where the repo's precision/axis contracts are written
+down as data rather than prose: which modules are *sanctioned* host-float64
+stages (their f64 use is the design, not a leak), which mesh axis names
+exist, and what counts as library code (where e.g. literal re-seeding is a
+bug rather than a test convenience).
+
+Keep this file boring: plain dicts and tuples, no imports from the rest of
+the package, so rules and tests can read it without dragging jax in.
+"""
+
+from __future__ import annotations
+
+# Mesh axis names every collective must use — single-sourced in spirit with
+# fakepta_tpu/parallel/mesh.py (REAL_AXIS/PSR_AXIS/TOA_AXIS); duplicated as
+# literals here because the analyzer must not import the package under
+# analysis. test_static_analysis pins the two in sync.
+MESH_AXES = ("real", "psr", "toa")
+
+# Module-level constant names that resolve to a declared axis (the idiomatic
+# way montecarlo.py spells them).
+MESH_AXIS_CONSTANTS = ("REAL_AXIS", "PSR_AXIS", "TOA_AXIS")
+
+# dtype policy: repo-relative posix paths -> "host-f64" for modules whose
+# float64 use is sanctioned by design (one-off host staging: ephemeris
+# element propagation, CGW phase references, ORF Cholesky factorization,
+# pixel geometry, the host facade's f64 phase tables). Everything else under
+# the library prefix defaults to "device-f32", where f64 markers are
+# findings unless pragma'd with a justification; paths outside the library
+# (tests, examples, benchmarks) are exempt — their f64 oracles are the
+# point.
+DTYPE_POLICY = {
+    "fakepta_tpu/ephemeris.py": "host-f64",
+    "fakepta_tpu/models/cgw.py": "host-f64",
+    "fakepta_tpu/ops/healpix.py": "host-f64",
+    "fakepta_tpu/ops/gwb.py": "host-f64",
+    "fakepta_tpu/ops/kepler.py": "host-f64",
+    "fakepta_tpu/fake_pta.py": "host-f64",
+    "fakepta_tpu/utils/io.py": "host-f64",
+    # the batch builder IS the sanctioned host-f64 staging layer: absolute
+    # TOAs and noisedict variances assemble at f64, device arrays take the
+    # batch dtype at materialization
+    "fakepta_tpu/batch.py": "host-f64",
+    # facade-side statistics layer: host numpy analysis (optimal statistic,
+    # ORF fits) around small jitted helpers whose dtype follows the inputs
+    "fakepta_tpu/correlated_noises.py": "host-f64",
+}
+DTYPE_DEFAULT_LIBRARY = "device-f32"
+DTYPE_EXEMPT = "exempt"
+
+# Library code prefix: rules with a library-only clause (literal re-seeding,
+# dtype policy) fire only under it.
+LIBRARY_PREFIXES = ("fakepta_tpu/",)
+
+# Directory names skipped when *walking* directories (explicit file
+# arguments always win): the analyzer's own fixture corpus is intentionally
+# dirty, so `check tests/` must not trip on it.
+EXCLUDE_DIR_NAMES = ("__pycache__", "fixtures_analysis", ".git")
+
+
+def dtype_policy_for(rel: str) -> str:
+    """Resolve the dtype policy for a repo-relative posix path."""
+    if rel in DTYPE_POLICY:
+        return DTYPE_POLICY[rel]
+    if is_library(rel):
+        return DTYPE_DEFAULT_LIBRARY
+    return DTYPE_EXEMPT
+
+
+def is_library(rel: str) -> bool:
+    return any(rel.startswith(p) for p in LIBRARY_PREFIXES)

@@ -1,0 +1,13 @@
+"""Rule registry: one module per rule, registered here in report order.
+
+Adding a rule = add a module with ``RULE_ID`` and ``check(ctx)``, append it
+below, give it a fixture pair in ``tests/fixtures_analysis/`` (one seeded
+true positive, one clean file), and document it in docs/INVARIANTS.md.
+"""
+
+from . import dtype, hostsync, meshaxis, rng, tracer
+
+ALL_RULES = tuple((mod.RULE_ID, mod.check)
+                  for mod in (rng, hostsync, tracer, dtype, meshaxis))
+
+RULE_IDS = tuple(rid for rid, _ in ALL_RULES)

@@ -1,0 +1,248 @@
+"""Shared AST machinery for the rule visitors.
+
+Pure stdlib-``ast`` — the analyzer never imports jax/numpy or the modules
+under analysis, so it runs identically on a laptop, in CI, and on machines
+without an accelerator stack at all.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+class NameResolver:
+    """Resolve Name/Attribute chains to dotted names through import aliases.
+
+    ``import numpy as np`` makes ``np.random.seed`` resolve to
+    ``numpy.random.seed``; ``from jax import lax`` makes ``lax.psum``
+    resolve to ``jax.lax.psum``. Relative imports are normalized by
+    stripping the leading dots (``from ..utils import rng as rng_utils`` ->
+    ``rng_utils`` = ``utils.rng``): rules match on suffixes, so the absolute
+    package prefix is never needed.
+    """
+
+    def __init__(self, tree: ast.AST):
+        self.aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.aliases[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = (node.module or "").lstrip(".")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    full = f"{mod}.{a.name}" if mod else a.name
+                    self.aliases[a.asname or a.name] = full
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name for a Name/Attribute chain, or None for anything else."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        parts.append(self.aliases.get(node.id, node.id))
+        return ".".join(reversed(parts))
+
+
+def last_component(dotted: Optional[str]) -> Optional[str]:
+    return dotted.rsplit(".", 1)[-1] if dotted else None
+
+
+def call_name(resolver: NameResolver, call: ast.Call) -> Optional[str]:
+    return resolver.resolve(call.func)
+
+
+# ---------------------------------------------------------------------------
+# jit-scope detection
+# ---------------------------------------------------------------------------
+
+_JIT_WRAPPERS = {"jit", "pjit", "shard_map"}
+
+
+def _is_jit_transform(resolver: NameResolver, node: ast.AST) -> bool:
+    name = resolver.resolve(node)
+    return last_component(name) in _JIT_WRAPPERS if name else False
+
+
+def jitted_functions(tree: ast.AST,
+                     resolver: NameResolver) -> List[ast.FunctionDef]:
+    """Top-level set of FunctionDefs that become device programs.
+
+    Detected forms:
+
+    - decorated: ``@jax.jit``, ``@jit``, ``@pjit``, ``@jax.jit(...)``,
+      ``@partial(jax.jit, ...)`` / ``@functools.partial(jit, ...)``;
+    - wrapped: ``jax.jit(f)`` / ``shard_map(f, mesh=...)`` / ``pjit(f)``
+      where ``f`` names a function defined in the module.
+
+    Nested defs inside a jitted function are jitted too — callers walk each
+    returned def's whole subtree, which covers them; the returned list holds
+    only the outermost jitted defs so no node is visited twice.
+    """
+    defs_by_name: Dict[str, List[ast.FunctionDef]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    jitted: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_transform(resolver, dec):
+                    jitted.add(node)
+                elif isinstance(dec, ast.Call):
+                    if _is_jit_transform(resolver, dec.func):
+                        jitted.add(node)
+                    elif (last_component(resolver.resolve(dec.func))
+                          == "partial" and dec.args
+                          and _is_jit_transform(resolver, dec.args[0])):
+                        jitted.add(node)
+        elif isinstance(node, ast.Call) and _is_jit_transform(resolver,
+                                                              node.func):
+            if node.args and isinstance(node.args[0], ast.Name):
+                for d in defs_by_name.get(node.args[0].id, ()):
+                    jitted.add(d)
+
+    # keep only outermost jitted defs (inner ones ride the subtree walk)
+    inner: Set[ast.AST] = set()
+    for d in jitted:
+        for sub in ast.walk(d):
+            if sub is not d and sub in jitted:
+                inner.add(sub)
+    return [d for d in jitted if d not in inner]
+
+
+def local_bindings(fn: ast.AST) -> Set[str]:
+    """Names bound in ``fn``'s own scope (params, assignments, for/with
+    targets, imports, nested def/class names) — NOT descending into nested
+    functions, whose bindings live in their own scope."""
+    bound: Set[str] = set()
+    if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+        a = fn.args
+        for arg in (list(a.posonlyargs) + list(a.args) + list(a.kwonlyargs)):
+            bound.add(arg.arg)
+        if a.vararg:
+            bound.add(a.vararg.arg)
+        if a.kwarg:
+            bound.add(a.kwarg.arg)
+
+    def collect_target(t: ast.AST) -> None:
+        for sub in ast.walk(t):
+            if isinstance(sub, ast.Name) and isinstance(sub.ctx,
+                                                        (ast.Store,)):
+                bound.add(sub.id)
+
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                bound.add(child.name)
+                continue
+            if isinstance(child, ast.Lambda):
+                continue
+            if isinstance(child, ast.ClassDef):
+                bound.add(child.name)
+                continue
+            if isinstance(child, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                targets = (child.targets
+                           if isinstance(child, ast.Assign)
+                           else [child.target])
+                for t in targets:
+                    if isinstance(t, (ast.Name, ast.Tuple, ast.List,
+                                      ast.Starred)):
+                        collect_target(t)
+            elif isinstance(child, ast.NamedExpr):
+                collect_target(child.target)
+            elif isinstance(child, ast.For):
+                collect_target(child.target)
+            elif isinstance(child, ast.withitem) and child.optional_vars:
+                collect_target(child.optional_vars)
+            elif isinstance(child, (ast.Import, ast.ImportFrom)):
+                for al in child.names:
+                    bound.add((al.asname or al.name).split(".")[0])
+            elif isinstance(child, (ast.comprehension,)):
+                collect_target(child.target)
+            visit(child)
+
+    visit(fn)
+    return bound
+
+
+def walk_scope(fn: ast.AST) -> Iterable[ast.AST]:
+    """Yield nodes of ``fn``'s own scope, not descending into nested
+    function/lambda bodies (their own scope analysis handles them)."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def function_scopes(tree: ast.AST) -> List[ast.AST]:
+    """The module plus every function/lambda node — the scopes rules iterate."""
+    scopes: List[ast.AST] = [tree]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            scopes.append(node)
+    return scopes
+
+
+BranchPath = Tuple[Tuple[int, str], ...]
+
+
+def branch_paths(scope: ast.AST) -> Dict[int, BranchPath]:
+    """Map ``id(node)`` -> branch path for every node in ``scope``'s own scope.
+
+    A branch path records which arm of each enclosing If/IfExp/Try the node
+    sits in, so rules can tell mutually-exclusive uses (if/else arms —
+    cannot both execute) from sequential ones.
+    """
+    paths: Dict[int, BranchPath] = {}
+
+    def visit(node: ast.AST, path: BranchPath) -> None:
+        paths[id(node)] = path
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return  # nested scope: its own branch_paths() call covers it
+        if isinstance(node, (ast.If, ast.IfExp)):
+            visit(node.test, path)
+            visit_many(node.body if isinstance(node, ast.If)
+                       else [node.body], path + ((id(node), "body"),))
+            visit_many(node.orelse if isinstance(node, ast.If)
+                       else [node.orelse], path + ((id(node), "else"),))
+        elif isinstance(node, ast.Try):
+            visit_many(node.body, path + ((id(node), "try"),))
+            for h in node.handlers:
+                paths[id(h)] = path
+                visit_many(h.body, path + ((id(node), "except"),))
+            visit_many(node.orelse, path + ((id(node), "try"),))
+            visit_many(node.finalbody, path)
+        else:
+            for child in ast.iter_child_nodes(node):
+                visit(child, path)
+
+    def visit_many(nodes, path):
+        for n in nodes:
+            visit(n, path)
+
+    for child in ast.iter_child_nodes(scope):
+        visit(child, ())
+    return paths
+
+
+def paths_diverge(p1: BranchPath, p2: BranchPath) -> bool:
+    """True when the two paths sit in different arms of the same branch —
+    i.e. they cannot both execute in one pass through the scope."""
+    for a, b in zip(p1, p2):
+        if a == b:
+            continue
+        return a[0] == b[0] and a[1] != b[1]
+    return False

@@ -1,0 +1,96 @@
+"""dtype-policy: float64 leaks into declared device-f32 modules.
+
+The engine's precision contract (BASELINE/VERDICT: host-f64 staging feeds
+device-f32 kernels) is encoded as data in ``analysis.policy.DTYPE_POLICY``:
+modules like ``ephemeris.py`` and ``models/cgw.py`` are *sanctioned*
+host-f64 stages; everything else in the library is device-f32, where an f64
+marker is either a real dtype leak (flag it) or an intentional host staging
+step (pragma it with the justification — which is exactly the audit trail
+the policy wants).
+
+Also flags ``jnp.exp``/``jnp.power`` whose arguments carry no log-space
+marker in their names: exponentiating a non-log-space magnitude overflows
+f32 at |x| > ~88/ln10, the classic silent-inf in spectral code. Log-space
+pipelines (``jnp.exp(ln_psd - jnp.log(f))``) pass by construction.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import policy
+from ..engine import Finding, ModuleContext
+from .common import NameResolver, call_name
+
+RULE_ID = "dtype-policy"
+
+_F64_ATTRS = {"numpy.float64", "jax.numpy.float64", "numpy.complex128",
+              "jax.numpy.complex128"}
+_F64_STRINGS = {"float64", "f8", ">f8", "<f8", "double", "complex128"}
+_EXP_FNS = {"jax.numpy.exp", "jax.numpy.power", "jax.numpy.exp2",
+            "jax.numpy.exp10"}
+_LOG_MARKERS = ("log", "ln_", "_ln", "lg")
+
+
+def _has_log_marker(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        elif isinstance(sub, ast.keyword):
+            ident = sub.arg
+        if ident and any(m in ident.lower() for m in _LOG_MARKERS):
+            return True
+    return False
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    if ctx.dtype_policy != policy.DTYPE_DEFAULT_LIBRARY:
+        return []   # host-f64 sanctioned modules and non-library code
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = resolver.resolve(node)
+            if name in _F64_ATTRS:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{name.split('.')[-1]} in a device-f32 module; if this "
+                    f"is sanctioned host staging, pragma it with the reason "
+                    f"(or add the module to analysis.policy.DTYPE_POLICY)"))
+            elif name and name.split(".")[-1] == "enable_x64":
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    "enable_x64 in a device-f32 module flips global "
+                    "precision; sanction it with a pragma naming the host "
+                    "stage it wraps"))
+        elif isinstance(node, ast.Call):
+            cname = call_name(resolver, node)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value in _F64_STRINGS:
+                    findings.append(ctx.finding(
+                        RULE_ID, arg,
+                        f"dtype string {arg.value!r} in a device-f32 "
+                        f"module; spell the policy (batch dtype) or pragma "
+                        f"the host stage"))
+            if cname == "jax.config.update" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    node.args[0].value == "jax_enable_x64":
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    "jax_enable_x64 toggle in a device-f32 module changes "
+                    "process-global precision"))
+            if cname in _EXP_FNS and node.args and \
+                    not any(_has_log_marker(a) for a in node.args):
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{cname.replace('jax.numpy', 'jnp')} of a non-log-space "
+                    f"magnitude overflows f32 beyond ~1e38; compute in log "
+                    f"space (or pragma with the proven bound)"))
+    return findings

@@ -1,0 +1,55 @@
+"""host-sync-in-jit: host materialization inside device programs.
+
+``float(x)``, ``x.item()``, ``x.tolist()`` and ``np.asarray(x)`` on a traced
+value force a device->host sync (or a ConcretizationTypeError) inside a
+``jax.jit``/``pjit``/``shard_map`` program — on a remote TPU every sync is
+~80 ms of flat latency (montecarlo.run's whole chunking strategy exists to
+avoid exactly that), and in the best case it silently pins a constant at
+trace time. Flags those calls inside functions that are decorated with or
+wrapped by a jit-family transform (nested defs included).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from ..engine import Finding, ModuleContext
+from .common import NameResolver, call_name, jitted_functions
+
+RULE_ID = "host-sync-in-jit"
+
+_HOST_CASTS = {"float", "int", "bool", "complex"}
+_HOST_METHODS = {"item", "tolist"}
+_NUMPY_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+    for fn in jitted_functions(ctx.tree, resolver):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(resolver, node)
+            if name in _HOST_CASTS and len(node.args) == 1 and \
+                    not isinstance(node.args[0], ast.Constant):
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{name}() on a value inside jitted '{fn.name}' "
+                    f"materializes it on host at trace time; use jnp ops or "
+                    f"hoist the cast out of the jitted scope"))
+            elif name in _NUMPY_MATERIALIZERS:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{name.replace('numpy', 'np')} inside jitted "
+                    f"'{fn.name}' forces a device->host copy (or pins a "
+                    f"trace-time constant); use jnp.asarray or move it to "
+                    f"setup code"))
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _HOST_METHODS and not node.args:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f".{node.func.attr}() inside jitted '{fn.name}' is a "
+                    f"blocking device->host sync; keep the value on device"))
+    return findings

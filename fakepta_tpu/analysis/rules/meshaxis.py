@@ -1,0 +1,77 @@
+"""mesh-axis-contract: collectives must name a declared mesh axis.
+
+The whole SPMD program speaks exactly three axis names — ``('real', 'psr',
+'toa')``, declared once in ``parallel/mesh.py`` — and every
+``lax.psum``/``all_gather``/``axis_index`` call is a contract against them.
+A typo'd or ad-hoc axis name fails only at trace time *on a sharded mesh*,
+which single-device CPU tests never exercise; this rule catches it at lint
+time. Axis arguments must be statically checkable: a string literal in the
+declared set, one of the ``REAL_AXIS``/``PSR_AXIS``/``TOA_AXIS`` constants,
+or a tuple of those. Anything else (a runtime variable) is flagged as
+unverifiable — thread the constant instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .. import policy
+from ..engine import Finding, ModuleContext
+from .common import NameResolver, call_name, last_component
+
+RULE_ID = "mesh-axis-contract"
+
+# collective -> positional index of the axis-name argument
+_COLLECTIVES = {
+    "psum": 1, "pmean": 1, "pmax": 1, "pmin": 1, "psum_scatter": 1,
+    "all_gather": 1, "all_to_all": 1, "ppermute": 1,
+    "axis_index": 0, "axis_size": 0,
+}
+# only axis_name: collectives' `axis=` kwarg is the ARRAY axis (all_gather)
+_AXIS_KWARGS = ("axis_name",)
+
+
+def _axis_ok(node: ast.AST, resolver: NameResolver) -> bool:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value in policy.MESH_AXES
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_axis_ok(e, resolver) for e in node.elts)
+    name = resolver.resolve(node)
+    if name is not None:
+        return last_component(name) in policy.MESH_AXIS_CONSTANTS
+    return False
+
+
+def _axis_arg(call: ast.Call, pos: int) -> Optional[ast.AST]:
+    for kw in call.keywords:
+        if kw.arg in _AXIS_KWARGS:
+            return kw.value
+    if len(call.args) > pos:
+        return call.args[pos]
+    return None
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(resolver, node)
+        if not name:
+            continue
+        tail = last_component(name)
+        if tail not in _COLLECTIVES or ".lax" not in "." + name:
+            continue
+        axis = _axis_arg(node, _COLLECTIVES[tail])
+        if axis is None:
+            continue   # defaulted/omitted axis is jax's problem, not ours
+        if not _axis_ok(axis, resolver):
+            declared = ", ".join(repr(a) for a in policy.MESH_AXES)
+            findings.append(ctx.finding(
+                RULE_ID, node,
+                f"lax.{tail} axis is not statically one of the declared "
+                f"mesh axes ({declared} / their *_AXIS constants from "
+                f"parallel.mesh); typos here only fail on a sharded mesh"))
+    return findings

@@ -1,0 +1,148 @@
+"""rng-discipline: the stream contracts behind bit-identical realizations.
+
+The engine's reproducibility story (montecarlo.py module docstring; VERDICT
+coverage rows 2/29) rests on every draw flowing through explicitly threaded
+``jax.random`` keys with per-(psr, signal, realization) folding. Three ways
+that discipline erodes:
+
+1. **global-state numpy RNG** — ``np.random.normal()`` etc. draw from hidden
+   process state the way the reference does at 20+ sites; results then
+   depend on import order and call history, never on the seed contract.
+2. **key reuse** — the same PRNG key passed to two consuming samplers
+   without an intervening ``split``/``fold_in`` makes the two draws
+   *identical*, which silently correlates signals.
+3. **literal re-seeding in library code** — ``PRNGKey(0)`` inside the
+   package pins a stream the caller cannot thread, so two call sites
+   collide (tests/examples may pin seeds freely).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Tuple
+
+from ..engine import Finding, ModuleContext
+from .common import (NameResolver, branch_paths, call_name, last_component,
+                     paths_diverge, function_scopes, walk_scope)
+
+RULE_ID = "rng-discipline"
+
+# numpy.random attributes that are NOT the hidden global state
+_NP_RANDOM_OK = {"default_rng", "Generator", "SeedSequence", "BitGenerator",
+                 "PCG64", "PCG64DXSM", "Philox", "MT19937", "SFC64"}
+
+# jax.random functions that CONSUME a key (same key to two of these = the
+# same bits twice); split/fold_in/key constructors derive instead
+_CONSUMERS = {
+    "normal", "uniform", "bernoulli", "randint", "choice", "permutation",
+    "gamma", "beta", "exponential", "poisson", "truncated_normal",
+    "multivariate_normal", "categorical", "laplace", "logistic", "gumbel",
+    "rademacher", "bits", "ball", "cauchy", "dirichlet", "loggamma",
+    "maxwell", "pareto", "rayleigh", "t", "weibull_min", "orthogonal",
+}
+
+_SEED_CONSTRUCTORS = {"jax.random.PRNGKey", "jax.random.key",
+                      "numpy.random.default_rng"}
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(resolver, node)
+        if not name:
+            continue
+        # (1) global-state numpy RNG
+        if name.startswith("numpy.random.") and \
+                name.split(".")[2] not in _NP_RANDOM_OK:
+            findings.append(ctx.finding(
+                RULE_ID, node,
+                f"{last_component(name)} draws from numpy's hidden global "
+                f"state; thread an explicit np.random.default_rng(seed) or "
+                f"a jax.random key instead"))
+        # (3) literal integer re-seeding inside library code
+        if ctx.is_library and name in _SEED_CONSTRUCTORS and node.args:
+            a0 = node.args[0]
+            if isinstance(a0, ast.Constant) and isinstance(a0.value, int):
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"literal seed {a0.value} in library code pins a stream "
+                    f"callers cannot thread; accept a seed/key argument "
+                    f"(utils.rng.as_key) instead"))
+
+    findings.extend(_key_reuse(ctx, resolver))
+    return findings
+
+
+def _key_reuse(ctx: ModuleContext, resolver: NameResolver) -> List[Finding]:
+    """(2) same key Name consumed twice with no rebinding between.
+
+    Per scope: record consuming uses (a bare Name as the key argument of a
+    jax.random sampler) and rebindings, ordered by position, each tagged
+    with its branch path. A second use flags unless it sits in the opposite
+    arm of the same branch as the first (mutually exclusive), or the name
+    was rebound between the two.
+    """
+    findings: List[Finding] = []
+    for scope in function_scopes(ctx.tree):
+        paths = branch_paths(scope)
+        # (name -> list of (pos, kind, node, path)) in source order
+        events: Dict[str, List[Tuple[Tuple[int, int], str, ast.AST,
+                                     tuple]]] = {}
+
+        def record(name: str, kind: str, node: ast.AST) -> None:
+            pos = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+            events.setdefault(name, []).append(
+                (pos, kind, node, paths.get(id(node), ())))
+
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Call):
+                fname = call_name(resolver, node)
+                if fname and fname.startswith("jax.random.") and \
+                        fname.split(".")[2] in _CONSUMERS:
+                    key_arg = None
+                    if node.args:
+                        key_arg = node.args[0]
+                    else:
+                        for kw in node.keywords:
+                            if kw.arg == "key":
+                                key_arg = kw.value
+                    if isinstance(key_arg, ast.Name):
+                        record(key_arg.id, "use", node)
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                   ast.NamedExpr, ast.For)):
+                targets = []
+                if isinstance(node, ast.Assign):
+                    targets = node.targets
+                elif isinstance(node, ast.NamedExpr):
+                    targets = [node.target]
+                elif isinstance(node, ast.For):
+                    targets = [node.target]
+                else:
+                    targets = [node.target]
+                for t in targets:
+                    for sub in ast.walk(t):
+                        if isinstance(sub, ast.Name) and \
+                                isinstance(sub.ctx, ast.Store):
+                            record(sub.id, "rebind", sub)
+
+        for name, evs in events.items():
+            evs.sort(key=lambda e: e[0])
+            active: List[Tuple[tuple, ast.AST]] = []
+            for pos, kind, node, path in evs:
+                if kind == "rebind":
+                    active.clear()
+                    continue
+                clash = next((n for p, n in active
+                              if not paths_diverge(p, path)), None)
+                if clash is not None:
+                    findings.append(ctx.finding(
+                        RULE_ID, node,
+                        f"key '{name}' already consumed on line "
+                        f"{clash.lineno}; reusing it yields identical bits "
+                        f"— split/fold_in a fresh subkey first"))
+                active.append((path, node))
+    return findings

@@ -1,0 +1,108 @@
+"""tracer-leak: Python control flow / mutation on traced values.
+
+Inside a jitted scope, ``if``/``while``/``assert`` on a traced expression
+raises ConcretizationTypeError at best and silently bakes a trace-time
+constant at worst (the classic "worked on the example input" bug). The
+heuristic is deliberately narrow — the test must *syntactically* involve a
+``jnp.*``/``jax.lax.*`` call, so static Python flags like
+``_simulate_block``'s ``include_white`` never false-positive.
+
+In-place mutation of a *closed-over* list/array (``outer[i] = ...``,
+``outer.append(...)``) inside a jitted scope leaks trace-time Python state
+across traces: the mutation happens once at trace time, not per call, and
+retraces append again — locally-bound accumulators are fine.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..engine import Finding, ModuleContext
+from .common import (NameResolver, call_name, jitted_functions,
+                     local_bindings)
+
+RULE_ID = "tracer-leak"
+
+_MUTATORS = {"append", "extend", "insert", "remove", "pop", "clear",
+             "update", "setdefault"}
+_TRACED_PREFIXES = ("jax.numpy.", "jax.lax.", "jax.random.")
+
+
+def _mentions_traced_call(resolver: NameResolver, expr: ast.AST) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            name = call_name(resolver, node)
+            if name and (name.startswith(_TRACED_PREFIXES)
+                         or name == "jax.numpy"):
+                return True
+    return False
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+    module_bound = local_bindings(ctx.tree)
+    for fn in jitted_functions(ctx.tree, resolver):
+        findings.extend(_check_scope(ctx, resolver, fn,
+                                     outer_bound=module_bound))
+    return findings
+
+
+def _check_scope(ctx: ModuleContext, resolver: NameResolver, fn: ast.AST,
+                 outer_bound: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    bound = local_bindings(fn)
+
+    def visit(node: ast.AST) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not fn:
+            findings.extend(_check_scope(ctx, resolver, node,
+                                         outer_bound | bound))
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            if _mentions_traced_call(resolver, node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"Python {kind} on a traced expression inside a jitted "
+                    f"scope concretizes the tracer; use jnp.where / "
+                    f"lax.cond / lax.while_loop"))
+        elif isinstance(node, ast.Assert):
+            if _mentions_traced_call(resolver, node.test):
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    "assert on a traced expression inside a jitted scope "
+                    "concretizes the tracer; use checkify or move the check "
+                    "to host code"))
+        elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and \
+                        isinstance(t.value, ast.Name):
+                    name = t.value.id
+                    if name not in bound and name in outer_bound:
+                        findings.append(ctx.finding(
+                            RULE_ID, t,
+                            f"in-place mutation of closed-over '{name}' "
+                            f"inside a jitted scope happens at trace time, "
+                            f"not per call; use a local accumulator or "
+                            f".at[].set()"))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS and \
+                isinstance(node.func.value, ast.Name):
+            name = node.func.value.id
+            if name not in bound and name in outer_bound:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f".{node.func.attr}() on closed-over '{name}' inside a "
+                    f"jitted scope mutates trace-time Python state; "
+                    f"accumulate locally and return the result"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for child in ast.iter_child_nodes(fn):
+        visit(child)
+    return findings

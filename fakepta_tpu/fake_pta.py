@@ -994,7 +994,7 @@ class Pulsar:
         the accelerator's dtype. Falls back to the default device when no CPU
         backend exists.
         """
-        from jax import enable_x64
+        from .utils.compat import enable_x64
 
         kw = dict(cos_gwtheta=rec["costheta"], gwphi=rec["phi"],
                   cos_inc=rec["cosinc"], log10_mc=rec["log10_mc"],

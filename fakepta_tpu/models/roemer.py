@@ -76,12 +76,14 @@ def nominal_state(ephem, planet: str, toas, dtype=jnp.float32) -> OrbitState:
     """
     el = ephem.planets[planet]
     E, a_t, e_t, Om_t, varpi_t, inc_t = ephem._propagate_elements(
+        # fakepta: allow[dtype-policy] nominal orbit propagates at host f64
         np.asarray(toas, dtype=np.float64), el["T"], el["Om"], el["omega"],
         el["inc"], el["a"], el["e"], el["l0"])
     argp_t = varpi_t - Om_t
     b_t = np.sqrt(1.0 - e_t**2)
     x = a_t * (np.cos(E) - e_t)
     y = a_t * b_t * np.sin(E)
+    # fakepta: allow[dtype-policy] nominal orbit positions at host f64
     pos = ephem.get_orbit_planet(np.asarray(toas, dtype=np.float64), planet)
 
     def dev(arr):

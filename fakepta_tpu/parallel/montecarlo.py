@@ -38,6 +38,7 @@ from jax.sharding import PartitionSpec as P
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
 from ..utils import rng as rng_utils
+from ..utils.compat import enable_x64, shard_map
 from .mesh import PSR_AXIS, REAL_AXIS, TOA_AXIS, make_mesh, to_host
 
 # PulsarBatch fields whose LAST axis is the TOA dimension (shard over 'toa');
@@ -534,7 +535,11 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                 return jnp.take_along_axis(wvals[..., i], white_bid,
                                            axis=1)                     # (P,T)
 
-            if include_white:
+            if include_white and (s_efac or s_equad):
+                # the raw toaerr^2 only replaces the batch's sigma2 when an
+                # efac/equad is actually drawn: ecorr-only sampling must keep
+                # the (possibly noisedict-derived) fixed white variance, not
+                # silently reset it to neutral toaerr^2 (ADVICE r5 finding 1)
                 sigma2_eff = white_toaerr2
                 if s_efac:
                     sigma2_eff = wgather(0) ** 2 * sigma2_eff
@@ -780,6 +785,7 @@ def _validated_toas_abs(batch, toas_abs, what: str) -> np.ndarray:
             f"{what} needs toas_abs: the padded (npsr, max_toa) absolute "
             f"MJD-second TOAs (float64 host array; build one from a pulsar "
             f"list with fakepta_tpu.batch.padded_abs_toas(psrs))")
+    # fakepta: allow[dtype-policy] absolute MJD-second epochs need host f64
     toas_abs = np.asarray(toas_abs, dtype=np.float64)
     if toas_abs.shape != batch.t_own.shape:
         raise ValueError(f"toas_abs shape {toas_abs.shape} != batch "
@@ -813,12 +819,16 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
     ``waveform`` is the engine counterpart of the facade's generic
     ``add_deterministic`` hook (reference ``fake_pta.py:444-455``): either a
     precomputed padded (P, T) delay array, or a callable with the FACADE'S
-    contract — ``fn(toas) -> delays`` on ONE pulsar's real (unpadded)
-    absolute epochs — evaluated per pulsar here at host float64, so the same
-    callable injects identically through ``Pulsar.add_deterministic`` and the
-    engine (zero padding never leaks into min/max/span-sensitive waveforms).
-    A sequence mixes both forms; contributions sum. ``toas_abs`` is only
-    required when a callable (or a cgw/roemer config) needs epochs.
+    contract — invoked ``fn(toas=...)`` on ONE pulsar's real (unpadded)
+    absolute epochs, the exact keyword convention ``Pulsar.add_deterministic``
+    uses — evaluated per pulsar here at host float64, so the same callable
+    (keyword-only ``toas`` included) injects identically through the facade
+    and the engine (zero padding never leaks into min/max/span-sensitive
+    waveforms). Extra parameters the facade would forward as ``**kwargs``
+    must be pre-bound with ``functools.partial`` here: the engine passes
+    ``toas`` alone. A sequence mixes both forms; contributions sum.
+    ``toas_abs`` is only required when a callable (or a cgw/roemer config)
+    needs epochs.
     """
     cgw_list = _as_config_list(cgw)
     roe_list = _as_config_list(roemer)
@@ -836,15 +846,18 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
             arr = np.zeros(batch.t_own.shape)
             for i in range(batch.npsr):
                 n = int(mask_np[i].sum())
-                row = np.asarray(wf(toas_abs[i, :n]), dtype=np.float64)
+                # fakepta: allow[dtype-policy] facade-parity host evaluation
+                row = np.asarray(wf(toas=toas_abs[i, :n]), dtype=np.float64)
                 if row.shape != (n,):
                     raise ValueError(
                         f"deterministic waveform returned shape {row.shape} "
                         f"for pulsar {i} ({n} epochs); the callable contract "
-                        f"is fn(toas) -> delays per pulsar, as in the "
-                        f"facade's add_deterministic")
+                        f"is fn(toas=...) -> delays per pulsar, as in the "
+                        f"facade's add_deterministic (pre-bind extra kwargs "
+                        f"with functools.partial)")
                 arr[i, :n] = row
         else:
+            # fakepta: allow[dtype-policy] precomputed host array, cast below
             arr = np.asarray(wf, dtype=np.float64)
             if arr.shape != batch.t_own.shape:
                 raise ValueError(
@@ -852,13 +865,13 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
                     f"expected the padded batch shape {batch.t_own.shape}")
         det = det + jnp.asarray(arr, dtype)
     if cgw_list:
-        from jax import enable_x64
-
         from ..models import cgw as cgw_model
 
         if pdist is None:
             pdist = np.zeros((batch.npsr, 2))
+        # fakepta: allow[dtype-policy] one-off host-f64 CGW staging (below)
         pdist = np.asarray(pdist, dtype=np.float64).reshape(batch.npsr, 2)
+        # fakepta: allow[dtype-policy] one-off host-f64 CGW staging (below)
         pos64 = np.asarray(batch.pos, dtype=np.float64)
         # construction-time, once: evaluate at float64 on the host CPU backend
         # (absolute MJD-second epochs ~4.6e9 s quantize at ~550 s in f32 —
@@ -869,6 +882,8 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
         for cfg in cgw_list:
             mode = "h" if cfg.log10_h is not None else "dist"
             groups.setdefault((bool(cfg.psrterm), mode), []).append(cfg)
+        # fakepta: allow[dtype-policy] sanctioned host-f64 stage: CGW phases
+        # from ~4.6e9 s epochs lose ~550 s at f32 (module docstring bound)
         with enable_x64(), jax.default_device(jax.devices("cpu")[0]):
             for (psrterm, mode), cfgs in groups.items():
                 amp = np.array([c.log10_h if mode == "h" else c.log10_dist
@@ -1138,15 +1153,19 @@ class EnsembleSimulator:
                 # from_pulsars batch with noisedict efac/equad baked into
                 # sigma2 would silently double-apply them here — the batch
                 # carries no provenance to detect that, so warn and point at
-                # the explicit path (batch.padded_toaerr2)
-                import warnings
-                warnings.warn(
-                    "WhiteSampling with no explicit toaerr2: treating "
-                    "batch.sigma2 as the raw toaerr^2 (exact for synthetic "
-                    "batches; WRONG if the batch baked noisedict efac/equad "
-                    "into sigma2 — pass toaerr2=padded_toaerr2(psrs))",
-                    stacklevel=2)
+                # the explicit path (batch.padded_toaerr2). Ecorr-only
+                # sampling never reads toaerr2 (the fixed sigma2 stays in
+                # place), so the provenance warning would be noise there.
+                if ws.efac is not None or ws.log10_tnequad is not None:
+                    import warnings
+                    warnings.warn(
+                        "WhiteSampling with no explicit toaerr2: treating "
+                        "batch.sigma2 as the raw toaerr^2 (exact for synthetic "
+                        "batches; WRONG if the batch baked noisedict efac/equad "
+                        "into sigma2 — pass toaerr2=padded_toaerr2(psrs))",
+                        stacklevel=2)
                 toaerr2 = np.asarray(batch.sigma2)
+            # fakepta: allow[dtype-policy] host validation; device cast below
             toaerr2 = np.asarray(toaerr2, dtype=np.float64)
             if toaerr2.shape != batch.t_own.shape:
                 raise ValueError(f"toaerr2 shape {toaerr2.shape} != batch "
@@ -1276,9 +1295,11 @@ class EnsembleSimulator:
         if pdist is None:
             pdist = np.zeros((batch.npsr, 2))
         self._pdist = jnp.asarray(
+            # fakepta: allow[dtype-policy] host staging; jnp cast to dtype
             np.asarray(pdist, dtype=np.float64).reshape(batch.npsr, 2), dtype)
 
         # angular bins for the correlation curve (static, from positions)
+        # fakepta: allow[dtype-policy] host-f64 angle/bin setup, done once
         pos = np.asarray(batch.pos, dtype=np.float64)
         ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
         edges = np.linspace(0.0, np.pi, nbins + 1)
@@ -1296,6 +1317,7 @@ class EnsembleSimulator:
         # curves/autos; this also removes the mask all_gather + counts einsum
         # from the shard_map body and matches how the fused Pallas path already
         # normalizes (measured perf-neutral: XLA was fusing the division).
+        # fakepta: allow[dtype-policy] exact integer pair counts at host f64
         mask_np = np.asarray(batch.mask, dtype=np.float64)
         raw_counts = mask_np @ mask_np.T
         # public: the RAW valid-pair TOA counts optimal_statistic wants as its
@@ -1405,7 +1427,7 @@ class EnsembleSimulator:
         roe_specs = tuple(_orbit_state_specs(has_toa) for _ in range(n_roe))
         samp_specs = tuple(P() for _ in self._samp_params)
         cgw_trel_specs = tuple(pt_spec for _ in self._cgw_trel)
-        shmapped = jax.shard_map(
+        shmapped = shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs,
                       tuple(P() for _ in self._chol),
@@ -1510,7 +1532,7 @@ class EnsembleSimulator:
 
         pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
         white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
-        shmapped = jax.shard_map(
+        shmapped = shard_map(
             sharded, mesh=mesh,
             in_specs=(P(REAL_AXIS), batch_specs,
                       tuple(P() for _ in self._chol),

@@ -137,6 +137,7 @@ def free_spectrum(f, log10_rho=None):
     """
     f = jnp.asarray(f)
     if not isinstance(f, jax.core.Tracer):
+        # fakepta: allow[dtype-policy] host-side grid validation, not traced
         f_host = np.asarray(f, dtype=np.float64)
         expect = np.arange(1, f_host.size + 1) * f_host[0]
         # atol=0: PTA grids are ~1e-9 Hz, far below allclose's default atol

@@ -1,0 +1,32 @@
+"""Version tolerance for the handful of jax APIs that moved out of experimental.
+
+The engine targets the modern public names (``jax.shard_map``,
+``jax.enable_x64``) but must also run on jaxlib builds where those still live
+under ``jax.experimental`` — the virtual-CPU test mesh in CI is one such
+build. Everything here resolves the preferred name first and falls back, so
+call sites import from this module and never branch on versions themselves.
+"""
+
+from __future__ import annotations
+
+import jax
+
+try:
+    from jax import enable_x64  # noqa: F401  (re-export)
+except ImportError:  # pragma: no cover - depends on the installed jax
+    from jax.experimental import enable_x64  # noqa: F401
+
+
+def shard_map(f, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` with the pre-0.5 experimental fallback.
+
+    ``check_vma`` is the modern name of the replication-checking switch; on
+    older jax it maps onto ``check_rep``, which gates the same validation.
+    """
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(f, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=check_vma)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_rep=check_vma)

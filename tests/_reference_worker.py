@@ -1,0 +1,130 @@
+"""Subprocess worker that runs the UNTRUSTED public reference package.
+
+The parity lane (``test_reference_parity.py``) compares ensemble statistics
+against the actual ``fakepta`` reference tree mounted at /root/reference.
+That tree is public, unreviewed content: importing it in-process would run
+arbitrary code inside the pytest process whenever the slow suite runs with
+the mount present (ADVICE r5 finding 3). This worker is the isolation
+boundary — the same pattern as the multihost/f32 subprocess lanes: the
+reference imports and executes HERE, in a throwaway child process, and only
+plain numeric arrays cross back via an .npz file the parent reads.
+
+Usage: ``python _reference_worker.py <mode> <out.npz>`` with mode one of
+``hd_ensemble`` | ``white``. Prints ``REFERENCE_IMPORT_OK`` after the
+reference package imported, so the parent can tell environment failures
+(missing mount, broken tree) from crashes in the computation itself.
+"""
+
+import sys
+import types
+
+import numpy as np
+
+REFERENCE = "/root/reference"
+
+# Ensemble configuration shared with the parent test (single-sourced here so
+# worker and oracle cannot drift) — same numbers as the original in-process
+# lane.
+HD = dict(npsr=12, ntoa=96, ncomp=6, n_arrays=60, log10_A=-13.2,
+          gamma=13 / 3, nbins=8, sky_seed=41, ref_seed=12345)
+WHITE = dict(ntoa=400, toaerr=1e-6, ref_seed=777)
+YR = 3.15576e7
+
+
+def _import_reference():
+    """Stub the reference's external imports and import it from the mount.
+
+    enterprise.constants supplies fyr; enterprise_extensions/healpy are
+    imported at the reference's module scope but unused by the paths
+    exercised here.
+    """
+    from fakepta_tpu import constants as tpu_constants
+
+    if "enterprise" not in sys.modules:
+        ent = types.ModuleType("enterprise")
+        ent.constants = types.ModuleType("enterprise.constants")
+        for name in ("fyr", "yr", "day", "c", "Msun", "GMsun", "AU", "kpc"):
+            if hasattr(tpu_constants, name):
+                setattr(ent.constants, name, getattr(tpu_constants, name))
+        sys.modules["enterprise"] = ent
+        sys.modules["enterprise.constants"] = ent.constants
+    if "enterprise_extensions" not in sys.modules:
+        ee = types.ModuleType("enterprise_extensions")
+        ee.deterministic = types.ModuleType(
+            "enterprise_extensions.deterministic")
+
+        def _unused(*a, **k):
+            raise AssertionError("cw_delay stub must not be called here")
+
+        ee.deterministic.cw_delay = _unused
+        sys.modules["enterprise_extensions"] = ee
+        sys.modules["enterprise_extensions.deterministic"] = ee.deterministic
+    if "healpy" not in sys.modules:
+        sys.modules["healpy"] = types.ModuleType("healpy")
+    sys.path.insert(0, REFERENCE)
+    try:
+        import fakepta.correlated_noises as ref_cn
+        import fakepta.fake_pta as ref_fp
+    finally:
+        sys.path.remove(REFERENCE)
+    print("REFERENCE_IMPORT_OK", flush=True)
+    return ref_fp, ref_cn
+
+
+def hd_ensemble():
+    """Reference HD-GWB ensemble: per-array binned correlation curves."""
+    ref_fp, ref_cn = _import_reference()
+    cfg = HD
+    toas = np.linspace(0.0, 12 * YR, cfg["ntoa"])
+    rng = np.random.default_rng(cfg["sky_seed"])
+    costh = rng.uniform(-1, 1, cfg["npsr"])
+    phis = rng.uniform(0, 2 * np.pi, cfg["npsr"])
+    thetas = np.arccos(costh)
+
+    # fakepta: allow[rng-discipline] the reference draws from the global state
+    np.random.seed(cfg["ref_seed"])
+    curves = []
+    edges = np.linspace(0.0, np.pi, cfg["nbins"] + 1)
+    for _ in range(cfg["n_arrays"]):
+        psrs = [ref_fp.Pulsar(toas, 1e-7, thetas[i], phis[i],
+                              custom_model={"RN": None, "DM": None,
+                                            "Sv": None})
+                for i in range(cfg["npsr"])]
+        ref_cn.add_common_correlated_noise(psrs, orf="hd",
+                                           spectrum="powerlaw",
+                                           log10_A=cfg["log10_A"],
+                                           gamma=cfg["gamma"],
+                                           components=cfg["ncomp"])
+        res = np.stack([p.residuals for p in psrs])
+        corr = (res @ res.T) / cfg["ntoa"]
+        pos = np.stack([p.pos for p in psrs])
+        ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
+        bin_idx = np.clip(np.digitize(ang, edges) - 1, 0, cfg["nbins"] - 1)
+        off = ~np.eye(cfg["npsr"], dtype=bool)
+        curve = np.array([corr[off & (bin_idx == b)].mean()
+                          if (off & (bin_idx == b)).any() else np.nan
+                          for b in range(cfg["nbins"])])
+        curves.append(curve)
+    return dict(curves=np.asarray(curves), costheta=costh, phi=phis)
+
+
+def white():
+    """Reference default-white-noise residual variance."""
+    ref_fp, _ = _import_reference()
+    toas = np.linspace(0.0, 10 * YR, WHITE["ntoa"])
+    # fakepta: allow[rng-discipline] the reference draws from the global state
+    np.random.seed(WHITE["ref_seed"])
+    p_ref = ref_fp.Pulsar(toas, WHITE["toaerr"], 1.0, 1.0,
+                          custom_model={"RN": None, "DM": None, "Sv": None})
+    p_ref.add_white_noise()
+    return dict(var=np.array(np.var(p_ref.residuals)))
+
+
+def main():
+    mode, out = sys.argv[1], sys.argv[2]
+    result = {"hd_ensemble": hd_ensemble, "white": white}[mode]()
+    np.savez(out, **result)
+
+
+if __name__ == "__main__":
+    main()

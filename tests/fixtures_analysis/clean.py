@@ -1,0 +1,36 @@
+"""Clean fixture: near-miss patterns every rule must NOT flag.
+
+Analyzed under a device-f32 library fake path — the strictest policy — and
+expected to produce zero findings.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+
+def draws(seed, key):
+    rng = np.random.default_rng(seed)        # explicit generator, not global
+    k1, k2 = jax.random.split(key)           # split before each consumption
+    a = jax.random.normal(k1, (4,))
+    b = jax.random.uniform(k2, (4,))
+    return rng.normal(), a, b
+
+
+@jax.jit
+def kernel(x):
+    log10_amp = jnp.log10(jnp.abs(x) + 1.0)
+    y = jnp.exp(log10_amp * jnp.log(jnp.float32(10.0)))   # log-space exp
+    psums = lax.psum(y, "psr")               # declared axis literal
+    idx = lax.axis_index("real")             # declared axis literal
+    acc = []                                 # locally bound: mutation fine
+    acc.append(psums + idx)
+    return jnp.stack(acc)
+
+
+def host_side(x):
+    # host code: materialization and concrete control flow are fine
+    arr = np.asarray(x)
+    if arr.any():
+        return float(arr.sum())
+    return arr.item()

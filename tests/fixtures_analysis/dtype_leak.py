@@ -1,0 +1,26 @@
+"""Seeded dtype-policy violations (analyzed under a device-f32 fake path)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import enable_x64
+
+
+def bad_f64(x):
+    y = np.asarray(x, dtype=np.float64)      # line 9: f64 marker
+    z = jnp.zeros(4, dtype="float64")        # line 10: f64 dtype string
+    return y, z
+
+
+def bad_x64_toggle():
+    jax.config.update("jax_enable_x64", True)    # line 15: global precision
+    with enable_x64():                           # line 16: enable_x64 use
+        return jnp.ones(3)
+
+
+def bad_exp(amplitude):
+    return jnp.exp(amplitude)                # line 21: non-log-space exp
+
+
+def ok_log_space(log10_amp, f):
+    # log-space pipeline: markers in the names sanction the exp
+    return jnp.exp(2.0 * log10_amp - jnp.log(f))

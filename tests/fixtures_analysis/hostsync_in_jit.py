@@ -1,0 +1,30 @@
+"""Seeded host-sync-in-jit violations inside jitted scopes."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def bad_item(x):
+    s = jnp.sum(x)
+    return s.item()                      # line 12: blocking sync
+
+
+@partial(jax.jit, static_argnums=(1,))
+def bad_float(x, n):
+    scale = float(jnp.max(x))            # line 17: trace-time materialize
+    return np.asarray(x) * scale / n     # line 18: host copy in jit
+
+
+def sharded_body(x):
+    return x.tolist()                    # line 22: sync in shard_map body
+
+
+wrapped = jax.jit(sharded_body)
+
+
+def host_helper(x):
+    # not jitted: host-side .item()/asarray are fine
+    return np.asarray(x).item()

@@ -1,0 +1,18 @@
+"""Seeded mesh-axis-contract violations."""
+from jax import lax
+
+from fakepta_tpu.parallel.mesh import PSR_AXIS
+
+
+def bad_axes(x, axis):
+    a = lax.psum(x, "reall")                 # line 8: typo'd axis literal
+    b = lax.axis_index("batch")              # line 9: undeclared axis
+    c = lax.all_gather(x, axis, axis=1)      # line 10: unverifiable variable
+    return a + b + c
+
+
+def ok_axes(x):
+    a = lax.psum(x, "real")
+    b = lax.all_gather(x, PSR_AXIS, axis=1, tiled=True)
+    c = lax.axis_index(axis_name="toa")
+    return a, b, c

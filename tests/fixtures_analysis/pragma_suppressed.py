@@ -1,0 +1,11 @@
+"""Pragma fixture: seeded violations, every one suppressed with a reason."""
+import numpy as np
+
+# fakepta: allow[rng-discipline] corpus fixture exercising standalone pragmas
+np.random.seed(7)
+
+
+def draw():
+    # inline pragma on the offending line
+    x = np.random.normal(size=3)  # fakepta: allow[rng-discipline] corpus demo
+    return x

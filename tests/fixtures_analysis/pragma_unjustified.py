@@ -1,0 +1,4 @@
+"""Pragma fixture: a bare pragma with no justification is itself a finding."""
+import numpy as np
+
+np.random.seed(9)  # fakepta: allow[rng-discipline]

@@ -1,0 +1,10 @@
+"""Seeded rng-discipline violations: hidden global-state numpy draws."""
+import numpy as np
+
+np.random.seed(42)                       # line 4: global re-seed
+
+
+def draw(seed):
+    a = np.random.normal(size=8)         # line 8: global-state draw
+    rng = np.random.default_rng(seed)    # clean: explicit threaded generator
+    return a + rng.normal(size=8)

@@ -1,0 +1,29 @@
+"""Seeded rng-discipline violations: key reuse and literal library seeds.
+
+Analyzed under a fake library path, so the literal-seed clause fires.
+"""
+import jax
+
+
+def bad_reuse(key):
+    a = jax.random.normal(key, (4,))
+    b = jax.random.uniform(key, (4,))    # line 10: key consumed twice
+    return a + b
+
+
+def ok_branches(key, flag):
+    # mutually exclusive arms: NOT a reuse
+    if flag:
+        return jax.random.normal(key, (4,))
+    else:
+        return jax.random.uniform(key, (4,))
+
+
+def ok_split(key):
+    k1, k2 = jax.random.split(key)
+    return jax.random.normal(k1, (4,)) + jax.random.uniform(k2, (4,))
+
+
+def bad_literal():
+    key = jax.random.PRNGKey(0)          # line 27: literal seed in library
+    return jax.random.normal(key, (4,))

@@ -1,0 +1,34 @@
+"""Seeded tracer-leak violations: traced control flow, closure mutation."""
+import jax
+import jax.numpy as jnp
+
+acc = []
+
+
+@jax.jit
+def bad_branch(x):
+    if jnp.any(x > 0):                   # line 10: traced if
+        x = -x
+    while jnp.sum(x) > 1.0:              # line 12: traced while
+        x = x * 0.5
+    assert jnp.all(x < 2.0)              # line 14: traced assert
+    acc.append(x)                        # line 15: closed-over mutation
+    return x
+
+
+@jax.jit
+def bad_closure_cell(x):
+    out = [None]
+
+    def inner(y):
+        out[0] = y * 2                   # line 23: closure cell write in jit
+        return y
+
+    return inner(x) + out[0]
+
+
+def host_control(x):
+    # not jitted: concrete control flow is fine
+    if jnp.any(x > 0):
+        return -x
+    return x

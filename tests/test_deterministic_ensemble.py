@@ -160,3 +160,31 @@ def test_generic_waveform_hook_matches_facade_add_deterministic():
     with pytest.raises(ValueError, match="shape"):
         EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
                           waveform=np.zeros((2, 2)), toas_abs=toas_abs)
+
+
+def test_waveform_callable_keyword_contract():
+    """Regression (ADVICE r5 finding 2): the engine must invoke callables as
+    ``wf(toas=...)`` — the facade's keyword convention — so a callable with a
+    keyword-only ``toas`` parameter (or one relying on functools.partial for
+    extra kwargs) injects identically through both paths."""
+    import functools
+
+    def kw_only_ramp(*, toas, amp):
+        t = np.asarray(toas)
+        return amp * (t - t.min()) / (t.max() - t.min() + 1.0)
+
+    psrs, _ = _psrs()
+    for p in psrs:
+        p.make_ideal()
+        p.add_deterministic(kw_only_ramp, amp=2e-7)
+
+    batch = PulsarBatch.from_pulsars(psrs, n_red=4, n_dm=4)
+    toas_abs = padded_abs_toas(psrs)
+    sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()[:1]),
+                            waveform=functools.partial(kw_only_ramp, amp=2e-7),
+                            toas_abs=toas_abs)
+    det = np.asarray(sim._det)
+    for i, p in enumerate(psrs):
+        n = len(p.toas)
+        np.testing.assert_allclose(det[i, :n], np.asarray(p.residuals),
+                                   rtol=1e-5, err_msg=p.name)

@@ -8,6 +8,7 @@ import pytest
 
 from fakepta_tpu import constants as const
 from fakepta_tpu.batch import PulsarBatch, fourier_basis_norm
+from fakepta_tpu.utils import compat
 from fakepta_tpu.fake_pta import Pulsar
 from fakepta_tpu import spectrum as spectrum_lib
 from fakepta_tpu.parallel.mesh import make_mesh
@@ -283,7 +284,7 @@ def test_ecorr_epoch_sampler_matches_block_covariance():
     keys = jax.vmap(lambda i: jax.random.fold_in(jax.random.key(1), i))(
         np.arange(3000))
     specs = jax.tree_util.tree_map(lambda _: P(), batch)
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(compat.shard_map(
         lambda k, b: _simulate_block(k, b, (jnp.eye(2),), (jnp.zeros((1,)),),
                                      (0.0,), (1400.0,), False, True, False,
                                      False, False, False, False),

@@ -2,6 +2,7 @@
 reconstruction, covariances, pickling (SURVEY.md §4 pyramid: unit + golden)."""
 
 import json
+import os
 import pickle
 
 import numpy as np
@@ -306,6 +307,8 @@ def test_make_fake_array_gaps_and_random_config():
 
 
 def test_copy_array_with_epta_noisedict():
+    if not os.path.exists(EPTA_NOISEDICT):
+        pytest.skip("reference tree not mounted")
     noisedict = json.load(open(EPTA_NOISEDICT))
     src = make_fake_array(npsrs=2, Tobs=10, ntoas=60, gaps=False, toaerr=1e-6,
                           backends=["EFF.P200.1380", "EFF.P217.1380"], seed=19)

@@ -1,0 +1,157 @@
+"""The invariant linter: fixture corpus exactness + repo self-check (tier-1).
+
+Two layers:
+
+- **corpus**: every rule has at least one seeded true-positive fixture and a
+  clean near-miss fixture under ``tests/fixtures_analysis/`` (excluded from
+  directory walks); findings must match EXACT (rule, line) sets — no
+  under- or over-reporting.
+- **self-check**: the CLI over ``fakepta_tpu/ tests/ examples/`` must exit 0
+  — the repo stays clean modulo justified pragmas and the committed
+  baseline. This is the tier-1 gate: any new unsuppressed violation fails
+  the suite.
+"""
+
+import json
+import pathlib
+import subprocess
+import sys
+
+import pytest
+
+from fakepta_tpu.analysis import (RULE_IDS, apply_baseline, check_source,
+                                  load_baseline, save_baseline)
+from fakepta_tpu.analysis import engine, policy
+
+REPO = pathlib.Path(__file__).resolve().parents[1]
+CORPUS = pathlib.Path(__file__).parent / "fixtures_analysis"
+
+# fake repo-relative path per fixture: library placement turns on the
+# library-only clauses (literal seeds, dtype policy) the corpus seeds
+LIB = "fakepta_tpu/_corpus_{}.py"
+
+CASES = [
+    ("rng_global_state.py", LIB,
+     {("rng-discipline", 4), ("rng-discipline", 8)}),
+    ("rng_key_reuse.py", LIB,
+     {("rng-discipline", 10), ("rng-discipline", 28)}),
+    ("hostsync_in_jit.py", LIB,
+     {("host-sync-in-jit", 12), ("host-sync-in-jit", 17),
+      ("host-sync-in-jit", 18), ("host-sync-in-jit", 22)}),
+    ("tracer_leak.py", LIB,
+     {("tracer-leak", 10), ("tracer-leak", 12), ("tracer-leak", 14),
+      ("tracer-leak", 15), ("tracer-leak", 24)}),
+    ("dtype_leak.py", LIB,
+     {("dtype-policy", 9), ("dtype-policy", 10), ("dtype-policy", 15),
+      ("dtype-policy", 16), ("dtype-policy", 21)}),
+    ("meshaxis_bad.py", LIB,
+     {("mesh-axis-contract", 8), ("mesh-axis-contract", 9),
+      ("mesh-axis-contract", 10)}),
+    ("clean.py", LIB, set()),
+    ("pragma_suppressed.py", LIB, set()),
+    ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
+]
+
+
+@pytest.mark.parametrize("fname,relfmt,expected",
+                         CASES, ids=[c[0] for c in CASES])
+def test_corpus_exact_findings(fname, relfmt, expected):
+    source = (CORPUS / fname).read_text()
+    rel = relfmt.format(fname.removesuffix(".py"))
+    got = {(f.rule, f.line) for f in check_source(rel, source)}
+    assert got == expected, (
+        f"{fname}: expected {sorted(expected)}, got {sorted(got)}")
+
+
+def test_every_rule_has_a_true_positive_and_a_clean_fixture():
+    """The acceptance contract: >=5 rules, each witnessed both ways."""
+    assert len(RULE_IDS) >= 5
+    seeded = set()
+    for fname, relfmt, expected in CASES:
+        seeded |= {rule for rule, _ in expected}
+    assert set(RULE_IDS) <= seeded | {"pragma-justification"} - {None}, (
+        f"rules without a seeded true positive: "
+        f"{set(RULE_IDS) - seeded}")
+    # clean.py is the shared near-miss fixture and must stay empty
+    assert next(exp for f, _, exp in CASES if f == "clean.py") == set()
+
+
+def test_mesh_axes_policy_matches_mesh_module():
+    """The analyzer's axis table cannot drift from parallel/mesh.py."""
+    from fakepta_tpu.parallel import mesh
+
+    assert policy.MESH_AXES == (mesh.REAL_AXIS, mesh.PSR_AXIS, mesh.TOA_AXIS)
+
+
+def test_dtype_policy_paths_exist():
+    """Policy entries must point at real modules (refactors move files)."""
+    for rel in policy.DTYPE_POLICY:
+        assert (REPO / rel).is_file(), f"stale DTYPE_POLICY entry: {rel}"
+
+
+def test_pragma_requires_justification_and_use():
+    src = "import numpy as np\nnp.random.seed(1)  " \
+          "# fakepta: allow[rng-discipline]\n"
+    got = {(f.rule, f.line) for f in check_source("fakepta_tpu/x.py", src)}
+    assert got == {("pragma-justification", 2)}
+    # an allow[] naming the wrong rule suppresses nothing AND is flagged
+    src = "import numpy as np\nnp.random.seed(1)  " \
+          "# fakepta: allow[dtype-policy] wrong rule id\n"
+    rules = {f.rule for f in check_source("fakepta_tpu/x.py", src)}
+    assert rules == {"rng-discipline", "pragma-unused"}
+
+
+def test_baseline_roundtrip(tmp_path):
+    src = "import numpy as np\nnp.random.seed(1)\nnp.random.seed(2)\n"
+    findings = check_source("fakepta_tpu/x.py", src)
+    assert len(findings) == 2
+    bl = tmp_path / "baseline.json"
+    save_baseline(bl, findings)
+    data = json.loads(bl.read_text())
+    assert data == {"version": 1,
+                    "findings": {"fakepta_tpu/x.py::rng-discipline": 2}}
+    assert apply_baseline(findings, load_baseline(bl)) == []
+    # a NEW finding beyond the baselined count still surfaces
+    src3 = src + "np.random.seed(3)\n"
+    leftover = apply_baseline(check_source("fakepta_tpu/x.py", src3),
+                              load_baseline(bl))
+    assert [(f.rule, f.line) for f in leftover] == [("rng-discipline", 4)]
+
+
+def test_syntax_error_is_reported_not_raised():
+    got = check_source("fakepta_tpu/broken.py", "def f(:\n")
+    assert [f.rule for f in got] == ["syntax-error"]
+
+
+def test_repo_self_check_cli_exits_clean():
+    """`python -m fakepta_tpu.analysis check fakepta_tpu/ tests/ examples/`
+    over the repo: zero unsuppressed findings (the acceptance command)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.analysis", "check",
+         "fakepta_tpu/", "tests/", "examples/"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, (
+        f"invariant linter found new violations:\n{proc.stdout}\n"
+        f"{proc.stderr}\nfix them or pragma with a one-line justification "
+        f"(# fakepta: allow[rule-id] reason) — see docs/INVARIANTS.md")
+    assert "clean: 0 findings" in proc.stdout
+
+
+def test_cli_rules_subcommand_lists_all_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.analysis", "rules"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    listed = set(proc.stdout.split())
+    assert set(RULE_IDS) <= listed
+    assert engine.PRAGMA_RULE in listed
+
+
+def test_corpus_files_are_skipped_by_directory_walk():
+    """tests/fixtures_analysis is intentionally dirty; walking tests/ must
+    skip it (explicit file arguments still analyze it)."""
+    files = list(engine.iter_python_files([str(CORPUS.parent)]))
+    assert files and not [f for f in files
+                          if "fixtures_analysis" in f.parts]
+    direct = list(engine.iter_python_files([str(CORPUS / "clean.py")]))
+    assert len(direct) == 1

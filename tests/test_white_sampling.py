@@ -165,6 +165,47 @@ def test_white_sampling_leaves_other_streams_untouched(batch):
                                atol=2e-4 * np.abs(a["curves"]).max())
 
 
+def test_ecorr_only_sampling_keeps_batch_sigma2():
+    """Regression (ADVICE r5 finding 1): sampling ONLY log10_ecorr must keep
+    the batch's fixed sigma2 for the white stage — not silently swap in the
+    neutral raw toaerr^2. With the ecorr range pinned at the noisedict value,
+    the sampled run must reproduce the fixed run even when a deliberately
+    wrong toaerr2 is supplied (proving it is never read)."""
+    psrs = _epoch_psrs()
+    batch = PulsarBatch.from_pulsars(psrs, n_red=8, n_dm=8, ecorr=True)
+    bid, _ = padded_backend_ids(psrs)
+    mesh = make_mesh(jax.devices()[:1])
+    fixed = EnsembleSimulator(batch, include=("white", "ecorr"), mesh=mesh)
+    sampled = EnsembleSimulator(
+        batch, include=("white", "ecorr"), mesh=mesh,
+        white_sample=WhiteSampling(efac=None, log10_tnequad=None,
+                                   log10_ecorr=(-6.5, -6.5)),
+        toaerr2=1e4 * padded_toaerr2(psrs), backend_id=bid)
+    a = fixed.run(48, seed=21, chunk=24)
+    b = sampled.run(48, seed=21, chunk=24)
+    np.testing.assert_allclose(b["curves"], a["curves"], rtol=2e-4,
+                               atol=2e-4 * np.abs(a["curves"]).max())
+    np.testing.assert_allclose(b["autos"], a["autos"], rtol=2e-4)
+
+
+def test_ecorr_only_sampling_default_toaerr2_does_not_warn():
+    """The toaerr2 provenance warning is about the efac/equad rebuild; an
+    ecorr-only sampling never reads toaerr2, so it must not warn."""
+    import warnings
+
+    psrs = _epoch_psrs()
+    batch = PulsarBatch.from_pulsars(psrs, n_red=8, n_dm=8, ecorr=True)
+    bid, _ = padded_backend_ids(psrs)
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        EnsembleSimulator(
+            batch, include=("white", "ecorr"),
+            mesh=make_mesh(jax.devices()[:1]),
+            white_sample=WhiteSampling(efac=None, log10_tnequad=None,
+                                       log10_ecorr=(-7.0, -6.0)),
+            backend_id=bid)
+
+
 def test_white_sampling_default_toaerr2_warns(batch):
     """Defaulting toaerr2 to batch.sigma2 assumes no baked-in efac/equad —
     undetectable from the batch, so it must warn."""
