@@ -6,7 +6,22 @@ Metric: PTA realizations/sec/chip. The baseline target is BASELINE.json's
 "10k realizations in < 60 s on a v5e-8", i.e. 10000/(60*8) ~= 20.8 real/s/chip;
 ``vs_baseline`` is the measured multiple of that target.
 
-Prints exactly one JSON line.
+Prints exactly one JSON line. Schema (BENCH_r*.json rows are this line, so
+the trajectory is self-describing — sourced from the ``fakepta_tpu.obs``
+RunReport each ``sim.run()`` attaches):
+
+- ``metric``/``value``/``unit``/``vs_baseline``/``platform``: the headline
+  end-to-end throughput, as before;
+- ``compile_s``: chunk-program compile time (jax.monitoring, warm-up run);
+- ``steady_real_per_s_per_chip``: per-chip rate excluding the
+  compile-bearing first chunk of the measured run;
+- ``retraces``: unexpected same-signature recompilations during the measured
+  run (the retrace guard; anything nonzero means the steady-state number is
+  polluted by compiles);
+- ``cost_bytes_per_chunk`` (and ``cost_flops_per_chunk``): XLA cost-analysis
+  bytes/FLOPs of one chunk program — the roofline inputs as recorded
+  artifacts;
+- ``fallback``: present when the accelerator was unreachable (CPU stand-in).
 """
 
 import json
@@ -54,7 +69,7 @@ def main():
     # runs a reduced count so a dead tunnel still yields a labeled number.
     platform = jax.devices()[0].platform
     nreal, chunk = (100_000, 10_000) if platform != "cpu" else (2_000, 1_000)
-    sim.run(chunk, seed=99, chunk=chunk)         # compile + warm up
+    warm = sim.run(chunk, seed=99, chunk=chunk)  # compile + warm up
     t0 = time.perf_counter()
     out = sim.run(nreal, seed=1, chunk=chunk)
     elapsed = time.perf_counter() - t0
@@ -64,13 +79,25 @@ def main():
 
     per_chip = nreal / elapsed / n_devices
     baseline = 10_000 / (60.0 * 8)               # the v5e-8 target, per chip
+    # obs telemetry (see module docstring for the field schema): compile time
+    # from the warm-up run's report (the measured run reuses the executable),
+    # steady-state rate / retraces from the measured run's report
+    warm_rep, rep = warm["report"], out["report"]
     row = {
         "metric": "PTA realizations/sec/chip (100 psr, 15 yr, HD-correlated GWB)",
         "value": round(per_chip, 2),
         "unit": "realizations/s/chip",
         "vs_baseline": round(per_chip / baseline, 2),
         "platform": platform,
+        "compile_s": round(warm_rep.compile_s, 3),
+        "steady_real_per_s_per_chip": round(
+            rep.steady_real_per_s_per_chip(), 2),
+        "retraces": rep.retraces,
     }
+    if rep.cost.get("bytes_per_chunk"):
+        row["cost_bytes_per_chunk"] = rep.cost["bytes_per_chunk"]
+    if rep.cost.get("flops_per_chunk"):
+        row["cost_flops_per_chunk"] = rep.cost["flops_per_chunk"]
     if fallback:
         row["fallback"] = "accelerator backend unavailable; CPU stand-in"
     print(json.dumps(row))

@@ -6,7 +6,11 @@ with a per-realization sampled CW source, 10: the 256-pulsar scale-out,
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
-``--update-baseline`` to append a measured table to BASELINE.md.
+``--update-baseline`` to append a measured table to BASELINE.md. Ensemble
+rows carry the ``fakepta_tpu.obs`` telemetry fields (``compile_s``,
+``steady_real_per_s_per_chip``, ``retraces``, ``cost_bytes_per_chunk`` —
+see the bench.py docstring for the schema), sourced from the RunReport each
+``sim.run()`` attaches.
 
     python benchmarks/suite.py                 # all configs, default sizes
     python benchmarks/suite.py --configs 1 2   # subset
@@ -58,11 +62,28 @@ def _hd_psd(batch, ncomp=30):
 
 
 def _ensemble_rate(sim, nreal, chunk):
-    """Warm (compile) one chunk, then measure steady-state realizations/s."""
-    sim.run(chunk, seed=9, chunk=chunk)
+    """Warm (compile) one chunk, then measure steady-state realizations/s.
+
+    Returns ``(rate, obs_fields)``: the end-to-end rate plus the
+    ``fakepta_tpu.obs`` RunReport fields every ensemble row carries
+    (``compile_s`` from the warm-up run, ``steady_real_per_s_per_chip`` /
+    ``retraces`` / ``cost_bytes_per_chunk`` from the measured run — the
+    bench.py line schema, so BENCH/BASELINE rows stay self-describing).
+    """
+    warm = sim.run(chunk, seed=9, chunk=chunk)
     t0 = time.perf_counter()
-    sim.run(nreal, seed=1, chunk=chunk)
-    return nreal / (time.perf_counter() - t0)
+    out = sim.run(nreal, seed=1, chunk=chunk)
+    rate = nreal / (time.perf_counter() - t0)
+    rep = out["report"]
+    fields = {
+        "compile_s": round(warm["report"].compile_s, 3),
+        "steady_real_per_s_per_chip": round(
+            rep.steady_real_per_s_per_chip(), 2),
+        "retraces": rep.retraces,
+    }
+    if rep.cost.get("bytes_per_chunk"):
+        fields["cost_bytes_per_chunk"] = rep.cost["bytes_per_chunk"]
+    return rate, fields
 
 
 def _timeit(fn, repeats=3):
@@ -170,11 +191,11 @@ def config6():
         roemer=RoemerConfig("jupiter", d_mass=1e-4 * 1.899e27),
         toas_abs=toas_abs, mesh=make_mesh(jax.devices()))
     nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     return {"config": 6,
             "metric": "GWB+DM+BayesEphem realizations/s/chip (100 psr, one "
                       "device program)",
-            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
 
 
 def config7():
@@ -213,11 +234,11 @@ def config7():
     sim = EnsembleSimulator(batch, mesh=make_mesh(jax.devices()),
                             include=("white", "ecorr", "red", "dm", "sys"))
     nreal, chunk = _scaled(40_000, 4000)  # chunks pipeline; steady-state rate
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     return {"config": 7,
             "metric": "full-noise realizations/s/chip (40 psr, ECORR + "
                       "2-backend system noise)",
-            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
 
 
 def config8():
@@ -244,11 +265,11 @@ def config8():
                       NoiseSampling("gwb", log10_A=(-15.0, -14.0),
                                     gamma=(13 / 3, 13 / 3))])
     nreal, chunk = _scaled(100_000, 10_000)
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     return {"config": 8,
             "metric": "hyperparameter-sampled realizations/s/chip (100 psr, "
                       "per-psr red + GWB draws)",
-            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
 
 
 def config9():
@@ -275,11 +296,11 @@ def config9():
         cgw_sample=CGWSampling(tref=float(toas_abs.mean())),
         toas_abs=toas_abs)
     nreal, chunk = _scaled(40_000, 4000)
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     return {"config": 9,
             "metric": "CW-population realizations/s/chip (100 psr, sampled "
                       "SMBHB source per realization)",
-            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
 
 
 def config10():
@@ -300,22 +321,16 @@ def config10():
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()))
     nreal, chunk = _scaled(16_000, 2000)
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     row = {"config": 10,
            "metric": "scale-out realizations/s/chip (256 psr, HD GWB)",
-           "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
-    # THIS program's static reservation (memory_analysis), not
-    # memory_stats()'s process-lifetime allocator peak — in a full sweep the
-    # latter would report whatever earlier config peaked highest
-    try:
-        import jax.random as jr
-        ma = sim._step.lower(jr.key(1), 0, chunk, False).compile() \
-            .memory_analysis()
-        peak = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
-                + ma.output_size_in_bytes + ma.generated_code_size_in_bytes)
-        row["peak_hbm_gb"] = round(peak / 2**30, 2)
-    except Exception:
-        pass
+           "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
+    # THIS program's static reservation (obs cost capture / memory_analysis),
+    # not memory_stats()'s process-lifetime allocator peak — in a full sweep
+    # the latter would report whatever earlier config peaked highest
+    reserved = sim.last_report.cost.get("static_reservation_bytes")
+    if reserved:
+        row["peak_hbm_gb"] = round(reserved / 2**30, 2)
     return row
 
 
@@ -343,11 +358,11 @@ def config11():
         # provenance warning)
         toaerr2=np.asarray(batch.sigma2))
     nreal, chunk = _scaled(100_000, 10_000)
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     return {"config": 11,
             "metric": "white-sampled realizations/s/chip (100 psr, per-psr "
                       "efac/equad draws)",
-            "value": round(rate / n_dev, 2), "unit": "real/s/chip"}
+            "value": round(rate / n_dev, 2), "unit": "real/s/chip", **obsf}
 
 
 def config5():
@@ -367,38 +382,28 @@ def config5():
     # 10k-realization chunks pipeline on device with one packed host fetch at
     # the end; 100k total measures steady-state throughput (matches bench.py)
     nreal, chunk = _scaled(100_000, 10_000)
-    rate = _ensemble_rate(sim, nreal, chunk)
+    rate, obsf = _ensemble_rate(sim, nreal, chunk)
     row = {"config": 5,
            "metric": "PTA realizations/sec/chip (100 psr, 15 yr, HD GWB)",
            "value": round(rate / n_dev, 2), "unit": "real/s/chip",
-           "vs_baseline": round(rate / n_dev / (10_000 / (60.0 * 8)), 2)}
+           "vs_baseline": round(rate / n_dev / (10_000 / (60.0 * 8)), 2),
+           **obsf}
 
-    # Peak device memory (allocator stats where the plugin provides them, else
-    # XLA's static reservation for the chunk program) and an MFU estimate from
-    # XLA's own cost analysis of the compiled chunk program.
-    stats = jax.devices()[0].memory_stats() or {}
-    peak = stats.get("peak_bytes_in_use")
+    # Peak device memory and an MFU estimate, both from the obs RunReport
+    # (allocator stats where the plugin provides them, else XLA's static
+    # reservation; FLOPs from the one-time cost-analysis capture).
+    rep = sim.last_report
+    peak = rep.memory.get("peak_bytes_in_use") \
+        or rep.cost.get("static_reservation_bytes")
     if peak:
         row["peak_hbm_gb"] = round(peak / 2**30, 2)
-    try:
-        import jax.random as jr
-        compiled = sim._step.lower(jr.key(1), 0, chunk, False).compile()
-        if not peak:
-            ma = compiled.memory_analysis()
-            total = (ma.temp_size_in_bytes + ma.argument_size_in_bytes
-                     + ma.output_size_in_bytes + ma.generated_code_size_in_bytes)
-            row["peak_hbm_gb"] = round(total / 2**30, 2)
-        ca = compiled.cost_analysis()
-        ca = ca[0] if isinstance(ca, (list, tuple)) else ca
-        flops = float(ca.get("flops", 0.0)) * (nreal / chunk)
-        if flops > 0:
-            achieved = flops * rate / nreal / n_dev
-            row["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
-            # v5e bf16 MXU peak ~197 TFLOP/s; this program is float32, so the
-            # number is a conservative model-flops-utilization estimate
-            row["mfu_vs_bf16_peak_pct"] = round(100 * achieved / 197e12, 2)
-    except Exception:
-        pass  # cost/memory analysis is best-effort; absent on some backends
+    flops = rep.cost.get("flops_per_chunk", 0.0) * (nreal / chunk)
+    if flops > 0:
+        achieved = flops * rate / nreal / n_dev
+        row["achieved_tflops_per_chip"] = round(achieved / 1e12, 2)
+        # v5e bf16 MXU peak ~197 TFLOP/s; this program is float32, so the
+        # number is a conservative model-flops-utilization estimate
+        row["mfu_vs_bf16_peak_pct"] = round(100 * achieved / 197e12, 2)
     return row
 
 

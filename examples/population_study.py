@@ -48,6 +48,11 @@ def main():
                          "marginalizes the bend frequency lf0 ~ U(-8.8, -8)")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--report", type=pathlib.Path, default=None,
+                    help="save the injected ensemble's RunReport (the "
+                         "fakepta_tpu.obs JSON-lines telemetry artifact) "
+                         "here; inspect with `python -m fakepta_tpu.obs "
+                         "summarize PATH` or diff two runs with `compare`")
     args = ap.parse_args()
     import jax
     if args.platform:
@@ -105,8 +110,13 @@ def main():
         include = ("white", "red", "dm") + (("gwb",) if gwb else ())
         sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh,
                                 noise_sample=samp, **extra)
-        runs[name] = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
-                             keep_corr=True)["corr"]
+        out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
+                      keep_corr=True)
+        runs[name] = out["corr"]
+        if args.report is not None and name == "injected":
+            # the L5 surface: every run carries its telemetry artifact
+            out["report"].save(args.report)
+            print(f"saved RunReport -> {args.report}", file=sys.stderr)
 
     null_os = optimal_statistic(runs["null"], pos, counts=counts)["amp2"]
     os = optimal_statistic(runs["injected"], pos, counts=counts,

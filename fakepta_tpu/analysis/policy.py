@@ -45,6 +45,16 @@ DTYPE_POLICY = {
     # facade-side statistics layer: host numpy analysis (optimal statistic,
     # ORF fits) around small jitted helpers whose dtype follows the inputs
     "fakepta_tpu/correlated_noises.py": "host-f64",
+    # the observability layer is pure host-side telemetry (metrics, reports,
+    # CLI): wall-clock floats and JSON serialization are its job, never
+    # device arrays — its hooks are trace-time-only by contract
+    # (docs/INVARIANTS.md), so f64 host timing there is sanctioned
+    "fakepta_tpu/obs/__init__.py": "host-f64",
+    "fakepta_tpu/obs/metrics.py": "host-f64",
+    "fakepta_tpu/obs/timing.py": "host-f64",
+    "fakepta_tpu/obs/report.py": "host-f64",
+    "fakepta_tpu/obs/cli.py": "host-f64",
+    "fakepta_tpu/obs/__main__.py": "host-f64",
 }
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"

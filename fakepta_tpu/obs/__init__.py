@@ -1,0 +1,28 @@
+"""fakepta_tpu.obs — run telemetry for the ensemble engine.
+
+Structured observability spanning the metrics core (counters / gauges /
+timing histograms + a schema-stable JSON-lines sink, ``metrics``), trace
+spans and device-synced timing (``timing``; absorbs and supersedes
+``fakepta_tpu.utils.profiling``), and the per-run :class:`RunReport`
+artifact every ``EnsembleSimulator.run()`` attaches, with a CLI to diff two
+runs (``python -m fakepta_tpu.obs summarize|compare``). See
+docs/OBSERVABILITY.md.
+
+Everything here is host-side code. The one contract: obs hooks never
+introduce host syncs into jitted scopes — spans execute at trace time only,
+and telemetry reads happen at chunk boundaries where the engine already
+fetches (docs/INVARIANTS.md).
+"""
+
+from .metrics import (SCHEMA, Collector, EventLog, active, collect, count,
+                      event, gauge, observe, record_span,
+                      subscribe_jax_monitoring)
+from .report import RunReport, format_delta, format_summary
+from .timing import Timer, annotation, span, trace
+
+__all__ = [
+    "SCHEMA", "Collector", "EventLog", "RunReport", "Timer", "annotation",
+    "active", "collect", "count", "event", "format_delta", "format_summary",
+    "gauge", "observe", "record_span", "span", "subscribe_jax_monitoring",
+    "trace",
+]

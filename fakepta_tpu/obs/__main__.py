@@ -1,0 +1,8 @@
+"""Entry point: ``python -m fakepta_tpu.obs summarize|compare ...``."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""CLI: ``python -m fakepta_tpu.obs summarize|compare <report.jsonl>...``.
+
+``summarize`` prints one report's metric table; ``compare`` prints a
+per-metric delta table between two reports and flags regressions
+(throughput down, retraces/compile-time/cost-bytes up beyond the relative
+threshold). ``compare`` exits 0 by default even with regressions flagged —
+it is a diff tool; pass ``--fail-on-regression`` to gate CI on it. Exit 2 on
+usage/IO errors, mirroring ``fakepta_tpu.analysis``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .report import RunReport, format_delta, format_summary
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m fakepta_tpu.obs",
+        description="inspect and diff ensemble-engine RunReport artifacts "
+                    "(JSON-lines files written by report.save())")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    summ = sub.add_parser("summarize", help="print one report's metrics")
+    summ.add_argument("report", help="a RunReport .jsonl file")
+    summ.add_argument("--format", choices=("text", "json"), default="text")
+
+    comp = sub.add_parser("compare",
+                          help="per-metric delta table between two reports")
+    comp.add_argument("report_a", help="baseline RunReport .jsonl")
+    comp.add_argument("report_b", help="candidate RunReport .jsonl")
+    comp.add_argument("--rel-threshold", type=float, default=0.10,
+                      help="relative change beyond which a metric moving the "
+                           "wrong way is flagged (default 0.10)")
+    comp.add_argument("--fail-on-regression", action="store_true",
+                      help="exit 1 when any metric is flagged")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        if args.command == "summarize":
+            rep = RunReport.load(args.report)
+            if args.format == "json":
+                print(json.dumps(rep.to_json(), indent=2))
+            else:
+                print(format_summary(rep))
+            return 0
+        rep_a = RunReport.load(args.report_a)
+        rep_b = RunReport.load(args.report_b)
+    except (OSError, ValueError, KeyError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    text, regressions = format_delta(rep_a, rep_b,
+                                     rel_threshold=args.rel_threshold)
+    print(text)
+    if regressions:
+        print(f"{len(regressions)} regression(s): {', '.join(regressions)}")
+        if args.fail_on_regression:
+            return 1
+    else:
+        print("no regressions flagged")
+    return 0
+
+
+if __name__ == "__main__":                               # pragma: no cover
+    sys.exit(main())

@@ -1,0 +1,250 @@
+"""Metrics core: counters, gauges, timing histograms, and a JSON-lines sink.
+
+The structured half of the observability layer (docs/OBSERVABILITY.md). A
+:class:`Collector` owns the run's metrics; the engine (and any other producer)
+reports through the module-level helpers — ``count``/``gauge``/``observe``/
+``record_span``/``event`` — which write to the *active* collector and are
+no-ops when none is installed. That no-op path is the zero-overhead-by-default
+contract: with no collector, instrumentation costs one truthiness check on the
+host, and nothing at all inside compiled programs (span/trace hooks execute
+only at trace time).
+
+Event schema (one JSON object per line, ``SCHEMA`` below versions it):
+
+    {"kind": "header",  "schema": ..., "meta": {...}}        # first line
+    {"kind": "span",    "name": "white"}
+    {"kind": "counter", "name": "chunks", "value": 2}
+    {"kind": "gauge",   "name": "cost.bytes_per_chunk", "value": 1.07e8}
+    {"kind": "timing",  "name": "chunk_wall_s", "values": [..]}
+    {"kind": "event",   "name": ..., "value": ..., "attrs": {...}}
+    {"kind": "summary", "metrics": {...}}                    # last line
+
+``subscribe_jax_monitoring()`` bridges ``jax.monitoring`` (compilation /
+tracing duration events, where the running jax exposes them) into the active
+collector, so compile time is a recorded artifact instead of a stopwatch
+guess.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterator, List, Optional
+
+SCHEMA = "fakepta_tpu.obs/1"
+
+# jax.monitoring duration events forwarded into collectors, renamed to stable
+# schema keys (the raw jax event paths are an implementation detail of the
+# running jax version)
+_JAX_DURATION_EVENTS = {
+    "/jax/core/compile/backend_compile_duration": "jax.backend_compile_s",
+    "/jax/core/compile/jaxpr_trace_duration": "jax.trace_s",
+    "/jax/core/compile/jaxpr_to_mlir_module_duration": "jax.lowering_s",
+}
+
+
+@dataclass
+class Collector:
+    """One run's worth of metrics: counters, gauges, timings, spans, events."""
+
+    counters: Dict[str, float] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    timings: Dict[str, List[float]] = field(default_factory=dict)
+    spans: List[str] = field(default_factory=list)
+    events: List[dict] = field(default_factory=list)
+
+    def count(self, name: str, n: float = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + n
+
+    def gauge(self, name: str, value: float) -> None:
+        self.gauges[name] = float(value)
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.timings.setdefault(name, []).append(float(seconds))
+
+    def record_span(self, name: str) -> None:
+        if name not in self.spans:
+            self.spans.append(name)
+
+    def event(self, name: str, value: Any = None, **attrs) -> None:
+        ev = {"name": name}
+        if value is not None:
+            ev["value"] = value
+        if attrs:
+            ev["attrs"] = attrs
+        self.events.append(ev)
+
+    def timing_summary(self) -> Dict[str, dict]:
+        return {name: {"n": len(ts), "total_s": sum(ts),
+                       "mean_s": sum(ts) / len(ts)}
+                for name, ts in self.timings.items() if ts}
+
+
+# Active-collector stack. Thread-local so concurrent runs (e.g. two
+# simulators driven from different host threads) do not interleave metrics.
+_state = threading.local()
+
+
+def active() -> Optional[Collector]:
+    """The innermost installed collector, or None (the zero-overhead case)."""
+    stack = getattr(_state, "stack", None)
+    return stack[-1] if stack else None
+
+
+@contextlib.contextmanager
+def collect(collector: Optional[Collector] = None) -> Iterator[Collector]:
+    """Install ``collector`` as the active sink for the ``with`` body."""
+    if collector is None:
+        collector = Collector()
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    stack.append(collector)
+    try:
+        yield collector
+    finally:
+        stack.pop()
+
+
+def count(name: str, n: float = 1) -> None:
+    c = active()
+    if c is not None:
+        c.count(name, n)
+
+
+def gauge(name: str, value: float) -> None:
+    c = active()
+    if c is not None:
+        c.gauge(name, value)
+
+
+def observe(name: str, seconds: float) -> None:
+    c = active()
+    if c is not None:
+        c.observe(name, seconds)
+
+
+def record_span(name: str) -> None:
+    c = active()
+    if c is not None:
+        c.record_span(name)
+
+
+def event(name: str, value: Any = None, **attrs) -> None:
+    c = active()
+    if c is not None:
+        c.event(name, value, **attrs)
+
+
+_monitoring_subscribed = False
+
+
+def subscribe_jax_monitoring() -> bool:
+    """Bridge ``jax.monitoring`` duration events into the active collector.
+
+    Idempotent (listeners register once per process) and safe on jax builds
+    without the monitoring module. The listener itself is a no-op when no
+    collector is active, so subscription adds no steady-state cost. Returns
+    whether the bridge is installed.
+    """
+    global _monitoring_subscribed
+    if _monitoring_subscribed:
+        return True
+    try:
+        from jax import monitoring
+    except ImportError:                                  # pragma: no cover
+        return False
+    if not hasattr(monitoring, "register_event_duration_secs_listener"):
+        return False                                     # pragma: no cover
+
+    def _on_duration(jax_event: str, duration: float, **attrs) -> None:
+        name = _JAX_DURATION_EVENTS.get(jax_event)
+        if name is None:
+            return
+        c = active()
+        if c is not None:
+            c.observe(name, duration)
+
+    monitoring.register_event_duration_secs_listener(_on_duration)
+    _monitoring_subscribed = True
+    return True
+
+
+class EventLog:
+    """Append-only JSON-lines sink with the stable ``SCHEMA`` framing.
+
+    The write path: ``append`` dicts, ``save`` to a ``.jsonl`` file (header
+    first, summary last). The read path: ``EventLog.load`` round-trips any
+    file this module (or :meth:`RunReport.save <.report.RunReport.save>`)
+    wrote. Schema mismatches fail loudly — a silent cross-version diff is
+    exactly the "mixing three eras of numbers" failure this layer exists to
+    end.
+    """
+
+    def __init__(self, meta: Optional[dict] = None):
+        self.meta = dict(meta or {})
+        self.lines: List[dict] = []
+
+    def append(self, kind: str, **fields) -> dict:
+        ev = {"kind": kind, **fields}
+        self.lines.append(ev)
+        return ev
+
+    def extend_from(self, collector: Collector) -> None:
+        """Serialize a collector's state into schema lines."""
+        for name in collector.spans:
+            self.append("span", name=name)
+        for name, value in sorted(collector.counters.items()):
+            self.append("counter", name=name, value=value)
+        for name, value in sorted(collector.gauges.items()):
+            self.append("gauge", name=name, value=value)
+        for name, values in sorted(collector.timings.items()):
+            self.append("timing", name=name, values=list(values))
+        for ev in collector.events:
+            self.append("event", **ev)
+
+    def to_jsonl(self, summary: Optional[dict] = None) -> str:
+        out = [json.dumps({"kind": "header", "schema": SCHEMA,
+                           "meta": self.meta})]
+        out += [json.dumps(line) for line in self.lines]
+        if summary is not None:
+            out.append(json.dumps({"kind": "summary", "metrics": summary}))
+        return "\n".join(out) + "\n"
+
+    def save(self, path, summary: Optional[dict] = None) -> str:
+        with open(path, "w") as fh:
+            fh.write(self.to_jsonl(summary))
+        return str(path)
+
+    @classmethod
+    def parse(cls, text: str) -> "EventLog":
+        log = cls()
+        for i, raw in enumerate(text.splitlines()):
+            raw = raw.strip()
+            if not raw:
+                continue
+            line = json.loads(raw)
+            if i == 0:
+                if line.get("kind") != "header":
+                    raise ValueError("event log must start with a header line")
+                if line.get("schema") != SCHEMA:
+                    raise ValueError(
+                        f"event-log schema {line.get('schema')!r} != "
+                        f"{SCHEMA!r}: refusing to mix telemetry eras")
+                log.meta = line.get("meta", {})
+                continue
+            log.lines.append(line)
+        return log
+
+    @classmethod
+    def load(cls, path) -> "EventLog":
+        with open(path) as fh:
+            return cls.parse(fh.read())
+
+    def summary(self) -> Optional[dict]:
+        for line in reversed(self.lines):
+            if line.get("kind") == "summary":
+                return line.get("metrics", {})
+        return None

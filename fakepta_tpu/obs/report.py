@@ -1,0 +1,208 @@
+"""RunReport: the per-run telemetry artifact the ensemble engine emits.
+
+Every ``EnsembleSimulator.run()`` returns one of these under the ``"report"``
+key (and as ``sim.last_report``). It is a plain-data snapshot — meta, stage
+spans, per-chunk wall times, compile/steady split, retrace count, one-time
+XLA cost analysis and device-memory stats — with a stable JSON-lines
+serialization (:meth:`save`/:meth:`load`, schema
+:data:`~fakepta_tpu.obs.metrics.SCHEMA`) so BENCH_r*.json-style trajectories
+stop being hand-reconstructed numbers and become diffable files
+(``python -m fakepta_tpu.obs compare old.jsonl new.jsonl``).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .metrics import Collector, EventLog
+
+
+@dataclass
+class RunReport:
+    """Structured telemetry for one ``run()`` call."""
+
+    meta: Dict = field(default_factory=dict)      # nreal/chunk/platform/mesh..
+    spans: List[str] = field(default_factory=list)
+    chunks: List[dict] = field(default_factory=list)   # {idx, wall_s, synced}
+    counters: Dict[str, float] = field(default_factory=dict)
+    gauges: Dict[str, float] = field(default_factory=dict)
+    timings: Dict[str, List[float]] = field(default_factory=dict)
+    retraces: int = 0
+    compile_s: float = 0.0
+    total_s: float = 0.0
+    cost: Dict[str, float] = field(default_factory=dict)
+    memory: Dict[str, float] = field(default_factory=dict)
+
+    # -- derived -----------------------------------------------------------
+    @property
+    def nchunks(self) -> int:
+        return len(self.chunks)
+
+    @property
+    def first_chunk_s(self) -> float:
+        return self.chunks[0]["wall_s"] if self.chunks else 0.0
+
+    @property
+    def steady_s(self) -> float:
+        """Wall time excluding the first (trace+compile-bearing) chunk."""
+        return max(self.total_s - self.first_chunk_s, 0.0)
+
+    def real_per_s(self) -> float:
+        n = self.meta.get("nreal", 0)
+        return n / self.total_s if self.total_s > 0 else 0.0
+
+    def steady_real_per_s(self) -> float:
+        """Steady-state realizations/s. On a cold run the first chunk bears
+        trace+compile, so it is excluded (count and wall) when the run has
+        more than one chunk, or its compile time subtracted when it has only
+        one. A warm run (``compile_s == 0``) is steady throughout — excluding
+        its first chunk would drop realizations without dropping time."""
+        n = self.meta.get("nreal", 0)
+        chunk = self.meta.get("chunk", n)
+        if self.compile_s <= 0:
+            return self.real_per_s()
+        if self.nchunks > 1 and self.steady_s > 0:
+            return (n - min(chunk, n)) / self.steady_s
+        denom = self.total_s - self.compile_s
+        return n / denom if denom > 0 else 0.0
+
+    def steady_real_per_s_per_chip(self) -> float:
+        return self.steady_real_per_s() / max(self.meta.get("n_devices", 1), 1)
+
+    # -- summary metrics (the flat table `compare` diffs) ------------------
+    def summary(self) -> Dict[str, float]:
+        m = {
+            "nreal": self.meta.get("nreal", 0),
+            "chunks": self.nchunks,
+            "retraces": self.retraces,
+            "compile_s": round(self.compile_s, 6),
+            "total_s": round(self.total_s, 6),
+            "first_chunk_s": round(self.first_chunk_s, 6),
+            "real_per_s": round(self.real_per_s(), 3),
+            "steady_real_per_s_per_chip":
+                round(self.steady_real_per_s_per_chip(), 3),
+        }
+        if self.cost.get("bytes_per_chunk"):
+            m["cost_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
+        if self.cost.get("flops_per_chunk"):
+            m["cost_flops_per_chunk"] = self.cost["flops_per_chunk"]
+        if self.memory.get("peak_bytes_in_use"):
+            m["peak_bytes_in_use"] = self.memory["peak_bytes_in_use"]
+        return m
+
+    # -- construction ------------------------------------------------------
+    @classmethod
+    def from_collector(cls, collector: Collector, meta: dict,
+                       **kwargs) -> "RunReport":
+        rep = cls(meta=dict(meta), spans=list(collector.spans),
+                  counters=dict(collector.counters),
+                  gauges=dict(collector.gauges),
+                  timings={k: list(v) for k, v in collector.timings.items()},
+                  **kwargs)
+        # compile time is authoritative from the jax.monitoring bridge when
+        # the events fired; sub-jits contribute several events, so sum them
+        rep.compile_s = sum(rep.timings.get("jax.backend_compile_s", []))
+        return rep
+
+    # -- serialization -----------------------------------------------------
+    def to_json(self) -> dict:
+        return {
+            "meta": self.meta, "spans": self.spans, "chunks": self.chunks,
+            "counters": self.counters, "gauges": self.gauges,
+            "timings": self.timings, "retraces": self.retraces,
+            "compile_s": self.compile_s, "total_s": self.total_s,
+            "cost": self.cost, "memory": self.memory,
+            "summary": self.summary(),
+        }
+
+    def save(self, path) -> str:
+        """Write the JSON-lines artifact (schema-framed; see module doc)."""
+        log = EventLog(meta=self.meta)
+        for name in self.spans:
+            log.append("span", name=name)
+        for c in self.chunks:
+            log.append("chunk", **c)
+        for name, value in sorted(self.counters.items()):
+            log.append("counter", name=name, value=value)
+        for name, value in sorted(self.gauges.items()):
+            log.append("gauge", name=name, value=value)
+        for name, values in sorted(self.timings.items()):
+            log.append("timing", name=name, values=values)
+        log.append("report", retraces=self.retraces,
+                   compile_s=self.compile_s, total_s=self.total_s,
+                   cost=self.cost, memory=self.memory)
+        return log.save(path, summary=self.summary())
+
+    @classmethod
+    def load(cls, path) -> "RunReport":
+        log = EventLog.load(path)
+        rep = cls(meta=log.meta)
+        for line in log.lines:
+            kind = line.get("kind")
+            if kind == "span":
+                rep.spans.append(line["name"])
+            elif kind == "chunk":
+                rep.chunks.append(
+                    {k: v for k, v in line.items() if k != "kind"})
+            elif kind == "counter":
+                rep.counters[line["name"]] = line["value"]
+            elif kind == "gauge":
+                rep.gauges[line["name"]] = line["value"]
+            elif kind == "timing":
+                rep.timings[line["name"]] = list(line["values"])
+            elif kind == "report":
+                rep.retraces = int(line.get("retraces", 0))
+                rep.compile_s = float(line.get("compile_s", 0.0))
+                rep.total_s = float(line.get("total_s", 0.0))
+                rep.cost = dict(line.get("cost", {}))
+                rep.memory = dict(line.get("memory", {}))
+        return rep
+
+    def __repr__(self) -> str:   # compact, log-friendly
+        return (f"RunReport(nreal={self.meta.get('nreal')}, "
+                f"chunks={self.nchunks}, retraces={self.retraces}, "
+                f"compile_s={self.compile_s:.3f}, total_s={self.total_s:.3f})")
+
+
+def format_summary(rep: RunReport) -> str:
+    """Human-readable one-report table (the ``summarize`` CLI body)."""
+    rows = [("metric", "value")]
+    for k, v in rep.summary().items():
+        rows.append((k, f"{v:g}" if isinstance(v, float) else str(v)))
+    rows.append(("spans", ",".join(rep.spans) or "-"))
+    w = max(len(r[0]) for r in rows)
+    return "\n".join(f"{k:<{w}}  {v}" for k, v in rows)
+
+
+def format_delta(a: RunReport, b: RunReport,
+                 rel_threshold: float = 0.10) -> tuple:
+    """Per-metric delta table between two reports.
+
+    Returns ``(text, regressions)`` where regressions is the list of metric
+    names that moved the wrong way beyond ``rel_threshold`` (throughput down,
+    retraces/compile/cost up).
+    """
+    ma, mb = a.summary(), b.summary()
+    keys = sorted(set(ma) | set(mb))
+    higher_is_better = {"real_per_s", "steady_real_per_s_per_chip"}
+    exempt = {"nreal", "chunks"}   # run-shape facts, not performance metrics
+    lines = [f"{'metric':<28} {'a':>14} {'b':>14} {'delta':>12}"]
+    regressions = []
+    for k in keys:
+        va, vb = ma.get(k), mb.get(k)
+        if va is None or vb is None:
+            lines.append(f"{k:<28} {va if va is not None else '-':>14} "
+                         f"{vb if vb is not None else '-':>14} {'-':>12}")
+            continue
+        delta = vb - va
+        rel = delta / abs(va) if va else (1.0 if delta else 0.0)
+        flag = ""
+        if k not in exempt and abs(rel) > rel_threshold:
+            worse = rel < 0 if k in higher_is_better else rel > 0
+            if worse:
+                flag = "  << REGRESSION"
+                regressions.append(k)
+        lines.append(f"{k:<28} {va:>14g} {vb:>14g} {rel:>+11.1%}{flag}")
+    return "\n".join(lines), regressions

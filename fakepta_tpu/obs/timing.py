@@ -1,0 +1,92 @@
+"""Trace spans and device-synced timing (absorbs ``utils/profiling.py``).
+
+``span(name)`` is the engine's stage annotation: inside a traced program it
+names the emitted ops (``jax.named_scope``, so the stage shows up attributed
+in a Perfetto/TensorBoard device trace) and marks the host timeline
+(``jax.profiler.TraceAnnotation``); it also records the span name to the
+active :mod:`~fakepta_tpu.obs.metrics` collector. All of that happens at
+*trace time only* — a cached jitted call never re-enters the context manager,
+so steady-state chunks pay nothing (the host-sync-in-jit invariant,
+docs/INVARIANTS.md).
+
+``Timer`` keeps the device-sync semantics of the old ``utils.profiling.Timer``
+— ``block_until_ready`` on whatever the block hands to ``set_result``, so the
+recorded time covers device execution, not just async dispatch — and fixes
+its exception bug: the elapsed time is now recorded in a ``finally``, so a
+raising block still leaves a measurement (previously the section vanished,
+which is how failed runs ended up with no timing evidence at all).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+import jax
+
+from . import metrics
+
+annotation = jax.profiler.TraceAnnotation    # named spans inside a trace
+
+
+@contextlib.contextmanager
+def span(name: str):
+    """Name a stage: ops for the device trace, an annotation for the host
+    timeline, and a span record for the active collector (if any)."""
+    metrics.record_span(name)
+    with jax.named_scope(name), jax.profiler.TraceAnnotation(name):
+        yield
+
+
+@contextlib.contextmanager
+def trace(logdir: str, annotate: str = ""):
+    """Capture a device trace under ``logdir`` (open with TensorBoard/Perfetto).
+
+    >>> with trace("/tmp/pta_trace"):
+    ...     sim.run(1000, seed=0)
+    """
+    with jax.profiler.trace(str(logdir)):
+        if annotate:
+            with jax.profiler.TraceAnnotation(annotate):
+                yield
+        else:
+            yield
+
+
+@dataclass
+class Timer:
+    """Accumulating wall-clock timer with device-sync semantics.
+
+    ``block_until_ready`` is applied to whatever the timed block returns
+    through ``set_result``, so the recorded time includes device execution,
+    not just Python dispatch. The measurement lands even when the block
+    raises (recorded in ``finally``); the device sync is skipped in that case
+    only if no result was set before the raise.
+    """
+
+    times: Dict[str, List[float]] = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        holder = {}
+
+        def set_result(x):
+            holder["out"] = x
+            return x
+
+        t0 = time.perf_counter()
+        try:
+            yield set_result
+        finally:
+            if "out" in holder:
+                jax.block_until_ready(holder["out"])
+            elapsed = time.perf_counter() - t0
+            self.times.setdefault(name, []).append(elapsed)
+            metrics.observe(f"timer.{name}", elapsed)
+
+    def summary(self) -> Dict[str, dict]:
+        return {name: {"n": len(ts), "total_s": sum(ts),
+                       "mean_s": sum(ts) / len(ts)}
+                for name, ts in self.times.items() if ts}

@@ -26,6 +26,7 @@ a few hundred MB regardless of the total realization count.
 from __future__ import annotations
 
 import dataclasses
+import time
 from functools import partial
 from typing import Optional, Sequence, Tuple, Union
 
@@ -35,6 +36,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from .. import obs
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
 from ..utils import rng as rng_utils
@@ -571,7 +573,8 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
 
         res = jnp.zeros((p_local, T), dtype)
         if include_white:
-            res = res + jnp.sqrt(sigma2_eff) * draw_toa(kw)
+            with obs.span("white"):
+                res = res + jnp.sqrt(sigma2_eff) * draw_toa(kw)
         if include_ecorr:
             # sigma^2 I + c^2 11^T per epoch block == diagonal white (above) plus
             # ONE shared normal per epoch: no per-block Cholesky (the reference
@@ -579,18 +582,23 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             # GLOBAL, so the epoch normals index the full-width draw — epochs
             # straddling a time-shard boundary see the same shared normal on
             # both shards
-            shared = jnp.take_along_axis(draw(ke, full_T), batch.epoch_idx,
-                                         axis=1)
-            res = res + ecorr_eff * shared
+            with obs.span("ecorr"):
+                shared = jnp.take_along_axis(draw(ke, full_T),
+                                             batch.epoch_idx, axis=1)
+                res = res + ecorr_eff * shared
         coeffs = []
         if include_red:
-            c = draw(kr, 2, n_red) * w_samp.get("red", red_w)[:, None, :]
+            with obs.span("red"):
+                c = draw(kr, 2, n_red) * w_samp.get("red", red_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_dm:
-            c = draw(kd, 2, n_dm) * w_samp.get("dm", dm_w)[:, None, :]
+            with obs.span("dm"):
+                c = draw(kd, 2, n_dm) * w_samp.get("dm", dm_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_chrom:
-            c = draw(kc, 2, n_chrom) * w_samp.get("chrom", chrom_w)[:, None, :]
+            with obs.span("chrom"):
+                c = draw(kc, 2, n_chrom) * w_samp.get("chrom",
+                                                      chrom_w)[:, None, :]
             coeffs.append(c.reshape(p_local, -1))
         if include_sys:
             # per-(pulsar, backend-band) GP on the shared basis, masked to the
@@ -598,36 +606,39 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             # injector; bands share the basis, draws are independent). Static
             # loop over the (small) band count so no (R, P, B, T) intermediate
             # is ever materialized under the realization vmap.
-            c = draw(ks, n_bands, 2, n_sys) * sys_w[:, :, None, :]
-            for b in range(n_bands):
-                contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
-                res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
+            with obs.span("sys"):
+                c = draw(ks, n_bands, 2, n_sys) * sys_w[:, :, None, :]
+                for b in range(n_bands):
+                    contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
+                    res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
         if include_gwb:
             # identical z on every psr shard (key NOT folded with pidx): the
             # (npsr x npsr) correlation matmul is replicated, then sliced
             # locally. Config 0 keeps the bare 0x6B key (legacy stream);
             # further configs fold their index on top. Coefficients of
             # configs sharing a basis group sum (projection is linear).
-            tag = jax.random.fold_in(key, 0x6B)
-            gwb_c = [None] * len(gwb_bases)
-            for j, (chol_j, w_j) in enumerate(zip(chols, gwb_ws)):
-                kg = tag if j == 0 else jax.random.fold_in(tag, j)
-                zg = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
-                corr = zg @ chol_j.T
-                corr_local = lax.dynamic_slice_in_dim(
-                    corr, pidx * p_local, p_local, axis=2)
-                w_eff = w_samp.get("gwb", w_j) if j == 0 else w_j
-                c = corr_local * w_eff[None, :, None]                  # (2,C,P_loc)
-                c = jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1)
-                g = gwb_group[j]
-                gwb_c[g] = c if gwb_c[g] is None else gwb_c[g] + c
-            coeffs.extend(gwb_c)
+            with obs.span("gwb"):
+                tag = jax.random.fold_in(key, 0x6B)
+                gwb_c = [None] * len(gwb_bases)
+                for j, (chol_j, w_j) in enumerate(zip(chols, gwb_ws)):
+                    kg = tag if j == 0 else jax.random.fold_in(tag, j)
+                    zg = jax.random.normal(kg, (2, n_gwbs[j], p_total), dtype)
+                    corr = zg @ chol_j.T
+                    corr_local = lax.dynamic_slice_in_dim(
+                        corr, pidx * p_local, p_local, axis=2)
+                    w_eff = w_samp.get("gwb", w_j) if j == 0 else w_j
+                    c = corr_local * w_eff[None, :, None]              # (2,C,P_loc)
+                    c = jnp.transpose(c, (2, 0, 1)).reshape(p_local, -1)
+                    g = gwb_group[j]
+                    gwb_c[g] = c if gwb_c[g] is None else gwb_c[g] + c
+                coeffs.extend(gwb_c)
         if coeffs:
-            c_all = jnp.concatenate(coeffs, axis=-1)
-            if bases_bf16:
-                c_all = c_all.astype(jnp.bfloat16)
-            res = res + jnp.einsum("ptk,pk->pt", gp_basis_all, c_all,
-                                   preferred_element_type=dtype)
+            with obs.span("gp_project"):
+                c_all = jnp.concatenate(coeffs, axis=-1)
+                if bases_bf16:
+                    c_all = c_all.astype(jnp.bfloat16)
+                res = res + jnp.einsum("ptk,pk->pt", gp_basis_all, c_all,
+                                       preferred_element_type=dtype)
         return jnp.where(batch.mask, res, 0.0)
 
     return jax.vmap(one)(keys)
@@ -648,12 +659,13 @@ def _sampled_roemer(keys, state, scales, pos_local, tag):
     dtype = scales.dtype
 
     def one(key):
-        kz = jax.random.fold_in(jax.random.fold_in(key, 0x77), tag)
-        z = jax.random.normal(kz, (7,), dtype)
-        d = z * scales
-        return roemer_delay_dev(state, pos_local, d_mass=d[0], d_Om=d[1],
-                                d_omega=d[2], d_inc=d[3], d_a=d[4], d_e=d[5],
-                                d_l0=d[6])
+        with obs.span("roemer"):
+            kz = jax.random.fold_in(jax.random.fold_in(key, 0x77), tag)
+            z = jax.random.normal(kz, (7,), dtype)
+            d = z * scales
+            return roemer_delay_dev(state, pos_local, d_mass=d[0], d_Om=d[1],
+                                    d_omega=d[2], d_inc=d[3], d_a=d[4],
+                                    d_e=d[5], d_l0=d[6])
 
     return jax.vmap(one)(keys)
 
@@ -769,11 +781,12 @@ def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
         else:
             pd = jnp.zeros((p_local,), dtype)
         amp_kw = {("log10_h" if mode == "h" else "log10_dist"): v[5]}
-        return jax.vmap(lambda t, p, pdm, pz: cw_delay(
-            t, p, (pdm[0], pdm[1]), cos_gwtheta=v[0], gwphi=v[1], cos_inc=v[2],
-            log10_mc=v[3], log10_fgw=v[4], phase0=v[6], psi=v[7],
-            psrTerm=psrterm, evolve=True, p_dist=pz,
-            **amp_kw))(t_rel, pos_local, pdist_local, pd)
+        with obs.span("cgw"):
+            return jax.vmap(lambda t, p, pdm, pz: cw_delay(
+                t, p, (pdm[0], pdm[1]), cos_gwtheta=v[0], gwphi=v[1],
+                cos_inc=v[2], log10_mc=v[3], log10_fgw=v[4], phase0=v[6],
+                psi=v[7], psrTerm=psrterm, evolve=True, p_dist=pz,
+                **amp_kw))(t_rel, pos_local, pdist_local, pd)
 
     return jax.vmap(one)(keys)
 
@@ -975,9 +988,11 @@ def _correlation_rows(res_local, stats_bf16=False, toa_psum=False):
     """
     if stats_bf16:
         res_local = res_local.astype(jnp.bfloat16)
-    res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
-    corr = jnp.einsum("rpt,rqt->rpq", res_local, res_full,
-                      preferred_element_type=jnp.float32)
+    with obs.span("all_gather"):
+        res_full = lax.all_gather(res_local, PSR_AXIS, axis=1, tiled=True)
+    with obs.span("correlate"):
+        corr = jnp.einsum("rpt,rqt->rpq", res_local, res_full,
+                          preferred_element_type=jnp.float32)
     if toa_psum:
         # sequence parallelism's closing collective: the pair products are a
         # reduction over TOAs, so time shards contribute partial sums and one
@@ -1375,8 +1390,89 @@ class EnsembleSimulator:
                 "(a no-op under use_pallas, whose precision is "
                 "pallas_precision); drop one of the two")
 
+        # --- observability state (fakepta_tpu.obs, docs/OBSERVABILITY.md) ---
+        # span registry filled at trace time (persists so reports from
+        # already-compiled runs still list the program's stages), the retrace
+        # guard's per-signature trace counts, and the one-time cost-analysis
+        # capture cache. last_report is the most recent run()'s RunReport.
+        self._obs_spans: set = set()
+        self._obs_trace_counts: dict = {}
+        self._obs_retraces = 0
+        self._obs_cost = None
+        self._obs_in_capture = False
+        self.last_report = None
+
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
+
+    def _obs_note_trace(self, signature) -> None:
+        """Retrace guard: called from INSIDE the jitted steps, so it executes
+        only when jax (re)traces the program — a cached call never reaches
+        Python. The first trace per static signature is the expected compile;
+        any further trace of the same signature is an unexpected
+        recompilation, counted into ``RunReport.retraces``."""
+        if self._obs_in_capture:
+            return   # the AOT cost-analysis lower() is not a user retrace
+        n = self._obs_trace_counts.get(signature, 0) + 1
+        self._obs_trace_counts[signature] = n
+        obs.count("obs.traces")
+        if n > 1:
+            self._obs_retraces += 1
+            obs.count("obs.retraces")
+            obs.event("retrace", value=list(map(str, signature)),
+                      count=n)
+
+    def _obs_capture_cost(self, base_key, chunk: int, fused: bool) -> dict:
+        """One-time XLA cost/memory analysis of the chunk program (cached per
+        simulator). Uses the AOT path, which compiles a second executable —
+        that one extra compile is the documented price of making the
+        roofline's FLOPs/bytes a recorded artifact; events it emits are
+        sunk into a throwaway collector so they never pollute run metrics."""
+        if self._obs_cost is not None:
+            return self._obs_cost
+        cost: dict = {}
+        self._obs_in_capture = True
+        try:
+            with obs.collect():     # sink capture-compile monitoring events
+                if fused:
+                    lowered = self._step_fused.lower(base_key, 0, chunk)
+                else:
+                    lowered = self._step.lower(base_key, 0, chunk, False)
+                compiled = lowered.compile()
+                ca = compiled.cost_analysis()
+                ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+                flops = float(ca.get("flops", 0.0))
+                nbytes = float(ca.get("bytes accessed", 0.0))
+                if flops > 0:
+                    cost["flops_per_chunk"] = flops
+                if nbytes > 0:
+                    cost["bytes_per_chunk"] = nbytes
+                try:
+                    ma = compiled.memory_analysis()
+                    cost["static_reservation_bytes"] = int(
+                        ma.temp_size_in_bytes + ma.argument_size_in_bytes
+                        + ma.output_size_in_bytes
+                        + ma.generated_code_size_in_bytes)
+                except Exception:
+                    pass
+        except Exception:
+            pass    # best-effort: absent on some backends/jax builds
+        finally:
+            self._obs_in_capture = False
+        self._obs_cost = cost
+        return cost
+
+    def _obs_memory_stats(self) -> dict:
+        """Allocator stats where the backend exposes them (None on CPU)."""
+        try:
+            stats = self.mesh.devices.flat[0].memory_stats()
+        except Exception:
+            return {}
+        if not stats:
+            return {}
+        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                "largest_alloc_size")
+        return {k: int(stats[k]) for k in keep if k in stats}
 
     def _build_step(self):
         mesh = self.mesh
@@ -1440,6 +1536,8 @@ class EnsembleSimulator:
 
         @partial(jax.jit, static_argnums=(2, 3))
         def step(base_key, offset, nreal, with_corr=False):
+            # trace-time only: the retrace guard (see _obs_note_trace)
+            self._obs_note_trace(("step", nreal, with_corr))
             # per-realization keys derived on device: one tiny transfer per chunk
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
@@ -1517,18 +1615,23 @@ class EnsembleSimulator:
                 term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
                                     cgw_ranges[j], stat, tag=j)
                 res = res + jnp.where(batch.mask, term, 0.0)
-            res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
+            with obs.span("all_gather"):
+                res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
             # realization tile capped by the kernel's VMEM working set
             rt = pick_rt(r_local, res.shape[1], res_full.shape[1],
                          res.shape[2], nbins,
                          mxu_binning=self._pallas_mxu_binning)
-            curves_p, autos_p = binned_correlation(
-                res, res_full, weights, nbins=nbins, rt=rt, interpret=interpret,
-                precision=self._pallas_precision,
-                mxu_binning=self._pallas_mxu_binning)
-            # the only other collective: reduce partial bin sums over psr shards
-            return (lax.psum(curves_p, PSR_AXIS), lax.psum(autos_p, PSR_AXIS))
+            with obs.span("correlate"):
+                curves_p, autos_p = binned_correlation(
+                    res, res_full, weights, nbins=nbins, rt=rt,
+                    interpret=interpret, precision=self._pallas_precision,
+                    mxu_binning=self._pallas_mxu_binning)
+                # the only other collective: reduce partial bin sums over
+                # psr shards
+                out = (lax.psum(curves_p, PSR_AXIS),
+                       lax.psum(autos_p, PSR_AXIS))
+            return out
 
         pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
         white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
@@ -1551,6 +1654,8 @@ class EnsembleSimulator:
 
         @partial(jax.jit, static_argnums=(2,))
         def step(base_key, offset, nreal):
+            # trace-time only: the retrace guard (see _obs_note_trace)
+            self._obs_note_trace(("step_fused", nreal))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             curves, autos = shmapped(keys, self.batch, self._chol, self._gwb_w,
@@ -1584,7 +1689,23 @@ class EnsembleSimulator:
         ``progress``: callable ``(done, nreal) -> None`` invoked after each chunk
         (the reference's observability is print statements; this is the hook for
         logging/metrics without baking a sink in).
+
+        Every run attaches a :class:`fakepta_tpu.obs.RunReport` under
+        ``out["report"]`` (also ``self.last_report``): stage spans, per-chunk
+        wall times (``synced`` marks chunks whose wall time included a device
+        sync — checkpoint/progress runs; otherwise chunk walls are dispatch
+        times and ``total_s`` is the device-synced end-to-end figure), the
+        compile-vs-steady split from the ``jax.monitoring`` bridge, the
+        retrace-guard count, one-time XLA cost analysis of the chunk program,
+        and device-memory stats where the backend exposes them. All hooks are
+        zero-overhead in steady state: nothing is read inside the jitted
+        program, only at the chunk boundaries the engine already touches.
         """
+        t_run0 = time.perf_counter()
+        obs.subscribe_jax_monitoring()
+        collector = obs.Collector()
+        retraces_before = self._obs_retraces
+        chunk_records = []
         base = rng_utils.as_key(seed)
         chunk = int(min(chunk, nreal))
         chunk -= chunk % self._n_real_shards
@@ -1618,37 +1739,48 @@ class EnsembleSimulator:
         # device->host round-trips through the remote-TPU tunnel cost ~80 ms
         # flat each, which dominated the chunk time before this.
         sync_each = ckpt is not None
-        while done < nreal:
-            # every step runs at the full chunk size (the final one overshoots and
-            # is truncated below): the steps are jitted with a static realization
-            # count, so a smaller tail chunk would recompile the SPMD program
-            if fused:
-                packed = self._step_fused(base, done, chunk)
-            else:
-                if keep_corr:
-                    packed, corr = self._step(base, done, chunk, True)
-                    corr_out.append(to_host(corr))
+        with obs.collect(collector):
+            while done < nreal:
+                t_chunk0 = time.perf_counter()
+                # every step runs at the full chunk size (the final one
+                # overshoots and is truncated below): the steps are jitted
+                # with a static realization count, so a smaller tail chunk
+                # would recompile the SPMD program
+                if fused:
+                    packed = self._step_fused(base, done, chunk)
                 else:
-                    packed = self._step(base, done, chunk, False)
-            if sync_each:
-                packed = to_host(packed)
-            elif hasattr(packed, "copy_to_host_async"):
-                packed.copy_to_host_async()   # overlap the fetch with compute
-            packed_out.append(packed)
-            done += chunk
-            if ckpt is not None and jax.process_index() == 0:
-                # append-only: each save writes this chunk's arrays, O(chunk)
-                # I/O. Only process 0 writes — to_host replicates outputs to
-                # every host, and concurrent renames of the same checkpoint
-                # files from N processes would race on shared storage
-                c_chunk, a_chunk = unpack_stats(packed_out[-1], nb)
-                ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
-                          corr_out[-1] if keep_corr else None)
-            if progress is not None:
-                if not sync_each:
-                    jax.block_until_ready(packed)   # completion, not dispatch
-                progress(min(done, nreal), nreal)
-        packed_h = np.concatenate([to_host(p) for p in packed_out])[:nreal]
+                    if keep_corr:
+                        packed, corr = self._step(base, done, chunk, True)
+                        corr_out.append(to_host(corr))
+                    else:
+                        packed = self._step(base, done, chunk, False)
+                if sync_each:
+                    packed = to_host(packed)
+                elif hasattr(packed, "copy_to_host_async"):
+                    packed.copy_to_host_async()  # overlap fetch with compute
+                packed_out.append(packed)
+                done += chunk
+                if ckpt is not None and jax.process_index() == 0:
+                    # append-only: each save writes this chunk's arrays,
+                    # O(chunk) I/O. Only process 0 writes — to_host replicates
+                    # outputs to every host, and concurrent renames of the
+                    # same checkpoint files from N processes would race on
+                    # shared storage
+                    c_chunk, a_chunk = unpack_stats(packed_out[-1], nb)
+                    ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
+                              corr_out[-1] if keep_corr else None)
+                if progress is not None:
+                    if not sync_each:
+                        jax.block_until_ready(packed)  # completion, not dispatch
+                    progress(min(done, nreal), nreal)
+                chunk_records.append({
+                    "idx": len(chunk_records),
+                    "wall_s": time.perf_counter() - t_chunk0,
+                    "synced": bool(sync_each or (keep_corr and not fused)
+                                   or progress is not None),
+                })
+            packed_h = np.concatenate([to_host(p) for p in packed_out])[:nreal]
+        total_s = time.perf_counter() - t_run0   # final fetch = device-synced
         curves_h, autos_h = unpack_stats(packed_h, nb)
         out = {
             "curves": curves_h,
@@ -1659,4 +1791,31 @@ class EnsembleSimulator:
             out["corr"] = np.concatenate(corr_out)[:nreal]
         if ckpt is not None and jax.process_index() == 0:
             ckpt.delete()
+
+        # --- RunReport (fakepta_tpu.obs): telemetry only, after all outputs
+        # are already fetched — a failure here must never cost a result
+        self._obs_spans |= set(collector.spans)
+        from ..obs import RunReport
+        meta = {
+            "nreal": int(nreal), "chunk": int(chunk),
+            "keep_corr": bool(keep_corr), "fused": bool(fused),
+            "platform": self.mesh.devices.flat[0].platform,
+            "n_devices": int(self.mesh.devices.size),
+            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
+            "npsr": int(self.batch.npsr),
+            "max_toa": int(self.batch.max_toa),
+        }
+        if isinstance(seed, (int, np.integer)):
+            meta["seed"] = int(seed)
+        collector.count("obs.chunks", len(chunk_records))
+        report = RunReport.from_collector(
+            collector, meta,
+            retraces=self._obs_retraces - retraces_before,
+            total_s=total_s,
+            cost=self._obs_capture_cost(base, chunk, fused),
+            memory=self._obs_memory_stats())
+        report.chunks = chunk_records
+        report.spans = sorted(self._obs_spans)
+        self.last_report = report
+        out["report"] = report
         return out

@@ -1,66 +1,21 @@
-"""Tracing and timing: the TPU-idiomatic observability layer.
+"""Deprecated: absorbed into :mod:`fakepta_tpu.obs` (PR 2).
 
-The reference has no profiling at all (SURVEY.md §5). On TPU the idiomatic
-equivalents are ``jax.profiler`` device traces (viewable in TensorBoard /
-Perfetto) and wall-clock timing that accounts for async dispatch — a naive
-``time.time()`` around a jitted call measures dispatch, not execution, so
-:func:`timed` blocks on the returned arrays.
+This module is a thin back-compat re-export. ``Timer``/``trace``/
+``annotation`` now live in :mod:`fakepta_tpu.obs.timing`, alongside the
+metrics core and the :class:`~fakepta_tpu.obs.RunReport` artifact — and the
+``obs`` Timer fixes this module's old bug where a raising timed block lost
+its measurement entirely (the elapsed time is now recorded in ``finally``).
 """
 
 from __future__ import annotations
 
-import contextlib
-import time
-from dataclasses import dataclass, field
-from typing import Dict, List
+import warnings
 
-import jax
+from ..obs.timing import Timer, annotation, trace  # noqa: F401
 
+warnings.warn(
+    "fakepta_tpu.utils.profiling is deprecated; import Timer/trace/annotation "
+    "from fakepta_tpu.obs instead (docs/OBSERVABILITY.md)",
+    DeprecationWarning, stacklevel=2)
 
-@contextlib.contextmanager
-def trace(logdir: str, annotate: str = ""):
-    """Capture a device trace under ``logdir`` (open with TensorBoard/Perfetto).
-
-    >>> with trace("/tmp/pta_trace"):
-    ...     sim.run(1000, seed=0)
-    """
-    with jax.profiler.trace(str(logdir)):
-        if annotate:
-            with jax.profiler.TraceAnnotation(annotate):
-                yield
-        else:
-            yield
-
-
-annotation = jax.profiler.TraceAnnotation    # named spans inside a trace
-
-
-@dataclass
-class Timer:
-    """Accumulating wall-clock timer with device-sync semantics.
-
-    ``block_until_ready`` is applied to whatever the timed block returns through
-    ``set_result``, so the recorded time includes device execution, not just
-    Python dispatch.
-    """
-
-    times: Dict[str, List[float]] = field(default_factory=dict)
-
-    @contextlib.contextmanager
-    def section(self, name: str):
-        holder = {}
-
-        def set_result(x):
-            holder["out"] = x
-            return x
-
-        t0 = time.perf_counter()
-        yield set_result
-        if "out" in holder:
-            jax.block_until_ready(holder["out"])
-        self.times.setdefault(name, []).append(time.perf_counter() - t0)
-
-    def summary(self) -> Dict[str, dict]:
-        return {name: {"n": len(ts), "total_s": sum(ts),
-                       "mean_s": sum(ts) / len(ts)}
-                for name, ts in self.times.items() if ts}
+__all__ = ["Timer", "annotation", "trace"]

@@ -1,0 +1,287 @@
+"""The observability layer (fakepta_tpu.obs, docs/OBSERVABILITY.md): event-log
+schema round-trip, Timer device-sync semantics, the retrace guard, the
+RunReport acceptance contract on a real 2-chunk ensemble run, and the
+``python -m fakepta_tpu.obs`` CLI smoke (tier-1)."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fakepta_tpu import obs
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def _make_sim(seed=3):
+    batch = PulsarBatch.synthetic(npsr=4, ntoa=48, tspan_years=10.0,
+                                  toaerr=1e-7, n_red=4, n_dm=4, seed=seed)
+    f = np.arange(1, 5) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-13.5, gamma=13 / 3))
+    return EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                             mesh=make_mesh(jax.devices()[:1]))
+
+
+# ---------------------------------------------------------------- metrics core
+
+def test_collector_and_zero_overhead_no_ops():
+    """Module helpers write to the active collector and no-op without one."""
+    obs.count("lost")            # no active collector: must be a silent no-op
+    obs.record_span("lost")
+    with obs.collect() as c:
+        obs.count("chunks", 2)
+        obs.gauge("hbm_gb", 1.5)
+        obs.observe("chunk_s", 0.25)
+        obs.observe("chunk_s", 0.35)
+        obs.record_span("white")
+        obs.record_span("white")           # deduplicated
+        obs.event("retrace", value=1, signature="step")
+    assert obs.active() is None
+    assert c.counters == {"chunks": 2}
+    assert c.gauges == {"hbm_gb": 1.5}
+    assert c.timings == {"chunk_s": [0.25, 0.35]}
+    assert c.spans == ["white"]
+    assert c.events[0]["name"] == "retrace"
+    assert c.timing_summary()["chunk_s"]["n"] == 2
+
+
+def test_event_log_schema_roundtrip(tmp_path):
+    """The JSON-lines sink round-trips exactly and refuses foreign schemas."""
+    log = obs.EventLog(meta={"nreal": 16, "platform": "cpu"})
+    with obs.collect() as c:
+        obs.record_span("white")
+        obs.count("obs.chunks", 2)
+        obs.gauge("cost.bytes_per_chunk", 1.0e8)
+        obs.observe("chunk_wall_s", 0.5)
+        obs.event("retrace", value=1)
+    log.extend_from(c)
+    p = tmp_path / "run.jsonl"
+    log.save(p, summary={"retraces": 0})
+
+    # every line is a self-describing JSON object, header first
+    lines = [json.loads(s) for s in p.read_text().splitlines()]
+    assert lines[0]["kind"] == "header" and lines[0]["schema"] == obs.SCHEMA
+    assert lines[-1] == {"kind": "summary", "metrics": {"retraces": 0}}
+
+    back = obs.EventLog.load(p)
+    assert back.meta == log.meta
+    kinds = {line["kind"] for line in back.lines}
+    assert {"span", "counter", "gauge", "timing", "event",
+            "summary"} <= kinds
+    assert back.summary() == {"retraces": 0}
+
+    bad = p.read_text().replace(obs.SCHEMA, "fakepta_tpu.obs/999")
+    with pytest.raises(ValueError, match="refusing to mix"):
+        obs.EventLog.parse(bad)
+
+
+def test_run_report_roundtrip(tmp_path):
+    rep = obs.RunReport(
+        meta={"nreal": 32, "chunk": 16, "n_devices": 1, "platform": "cpu"},
+        spans=["all_gather", "correlate", "white"],
+        chunks=[{"idx": 0, "wall_s": 1.5, "synced": False},
+                {"idx": 1, "wall_s": 0.1, "synced": False}],
+        counters={"obs.chunks": 2}, gauges={"g": 2.0},
+        timings={"jax.backend_compile_s": [1.0, 0.25]},
+        retraces=1, compile_s=1.25, total_s=2.0,
+        cost={"flops_per_chunk": 10.0, "bytes_per_chunk": 20.0},
+        memory={"peak_bytes_in_use": 123})
+    p = tmp_path / "rep.jsonl"
+    rep.save(p)
+    back = obs.RunReport.load(p)
+    assert back.to_json() == rep.to_json()
+    assert back.summary()["retraces"] == 1
+    assert back.summary()["cost_bytes_per_chunk"] == 20.0
+    # derived timing split
+    assert back.first_chunk_s == 1.5
+    assert back.steady_s == pytest.approx(0.5)
+    assert back.steady_real_per_s() == pytest.approx(16 / 0.5)
+
+
+def test_jax_monitoring_bridge_records_compile_time():
+    """Compiling inside collect() lands backend-compile durations (where the
+    running jax exposes jax.monitoring events; this one does)."""
+    assert obs.subscribe_jax_monitoring()
+    with obs.collect() as c:
+        jax.jit(lambda x: x * 3.0 + 1.0)(jnp.arange(7.0)).block_until_ready()
+    assert sum(c.timings.get("jax.backend_compile_s", [])) > 0.0
+
+
+# --------------------------------------------------------------------- Timer
+
+def test_timer_blocks_on_device_work():
+    """Device-sync semantics: the timed section must cover execution (via the
+    set_result block), not just async dispatch of the jitted call."""
+    @jax.jit
+    def heavy(x):
+        return jax.lax.fori_loop(0, 30, lambda i, a: a @ a / jnp.e, x)
+
+    x = jnp.eye(300) + 0.001
+    jax.block_until_ready(heavy(x))              # compile out of the loop
+    t0 = time.perf_counter()
+    jax.block_until_ready(heavy(x))
+    blocked = time.perf_counter() - t0
+
+    t = obs.Timer()
+    with t.section("jit") as done:
+        done(heavy(x))
+    timed = t.times["jit"][0]
+    # dispatch alone is orders of magnitude below execution; the generous
+    # factor absorbs scheduler noise without admitting a dispatch-only timer
+    assert timed >= 0.5 * blocked
+    assert t.summary()["jit"]["n"] == 1
+
+
+def test_timer_records_elapsed_when_block_raises():
+    """The old utils.profiling.Timer lost the measurement entirely when the
+    timed block raised; the section must now record in finally."""
+    t = obs.Timer()
+    with pytest.raises(RuntimeError, match="boom"):
+        with t.section("fails"):
+            time.sleep(0.01)
+            raise RuntimeError("boom")
+    assert t.summary()["fails"]["n"] == 1
+    assert t.times["fails"][0] >= 0.01
+
+
+def test_profiling_module_is_deprecated_reexport():
+    import importlib
+    import fakepta_tpu.utils.profiling as prof_mod
+    with pytest.warns(DeprecationWarning, match="fakepta_tpu.obs"):
+        prof_mod = importlib.reload(prof_mod)
+    assert prof_mod.Timer is obs.Timer
+    assert prof_mod.trace is obs.trace
+
+
+# ------------------------------------------------- engine RunReport + retrace
+
+@pytest.fixture(scope="module")
+def sim():
+    return _make_sim()
+
+
+@pytest.fixture(scope="module")
+def two_runs(sim, tmp_path_factory):
+    """Two identical 2-chunk runs + their saved report paths (shared by the
+    acceptance and CLI tests so the engine compiles once)."""
+    d = tmp_path_factory.mktemp("obs_reports")
+    out1 = sim.run(16, seed=5, chunk=8)
+    out2 = sim.run(16, seed=5, chunk=8)
+    p1, p2 = d / "run1.jsonl", d / "run2.jsonl"
+    out1["report"].save(p1)
+    out2["report"].save(p2)
+    return out1, out2, p1, p2
+
+
+def test_run_report_acceptance(two_runs):
+    """The ISSUE acceptance contract: spans, chunk count, retraces == 0 on
+    the second same-shape run, cost bytes recorded > 0."""
+    out1, out2, _, _ = two_runs
+    rep1, rep2 = out1["report"], out2["report"]
+    # per-stage spans of the program that actually ran (chrom/sys/cgw/roemer
+    # stages are off in this config, so their spans are legitimately absent)
+    assert {"white", "red", "dm", "gwb", "gp_project", "all_gather",
+            "correlate"} <= set(rep1.spans)
+    assert rep1.nchunks == 2 and rep2.nchunks == 2
+    assert [c["idx"] for c in rep1.chunks] == [0, 1]
+    assert all(c["wall_s"] >= 0 for c in rep1.chunks)
+    # second same-shape run: the retrace guard must count zero recompiles
+    assert rep2.retraces == 0
+    assert rep2.spans == rep1.spans      # span registry persists on the sim
+    # one-time XLA cost capture: the roofline bytes are a recorded artifact
+    assert rep1.cost["bytes_per_chunk"] > 0
+    assert rep1.cost["flops_per_chunk"] > 0
+    assert rep2.cost == rep1.cost        # cached, not re-captured
+    # compile time: first run observed the jax.monitoring compile events
+    assert rep1.compile_s > 0
+    assert rep2.compile_s == 0
+    assert rep1.total_s > 0
+    assert rep1.meta["nreal"] == 16 and rep1.meta["chunk"] == 8
+    assert out1["curves"].shape[0] == 16   # telemetry never costs a result
+
+
+def test_retrace_guard_counts_forced_recompile():
+    """Positive control: clearing jax's caches forces a same-signature
+    retrace, which the guard must count (and runs before it must not)."""
+    s = _make_sim(seed=7)
+    first = s.run(8, seed=1, chunk=8)["report"]
+    assert first.retraces == 0           # first trace is the expected compile
+    jax.clear_caches()
+    again = s.run(8, seed=1, chunk=8)["report"]
+    assert again.retraces >= 1
+    assert again.counters.get("obs.retraces", 0) >= 1
+
+
+def test_keep_corr_and_checkpoint_runs_still_report(sim, tmp_path):
+    out = sim.run(16, seed=2, chunk=8, keep_corr=True)
+    rep = out["report"]
+    assert rep.nchunks == 2 and rep.meta["keep_corr"] is True
+    assert all(c["synced"] for c in rep.chunks)   # per-chunk corr fetch syncs
+
+
+# ------------------------------------------------------------------------ CLI
+
+def _cli(*args):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = str(REPO) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.run([sys.executable, "-m", "fakepta_tpu.obs", *args],
+                          capture_output=True, text=True, timeout=120,
+                          cwd=REPO, env=env)
+
+
+def test_cli_summarize_smoke(two_runs):
+    _, _, p1, _ = two_runs
+    proc = _cli("summarize", str(p1))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "retraces" in proc.stdout and "steady_real_per_s_per_chip" in \
+        proc.stdout
+    proc_json = _cli("summarize", str(p1), "--format", "json")
+    assert proc_json.returncode == 0
+    assert json.loads(proc_json.stdout)["meta"]["nreal"] == 16
+
+
+def test_cli_compare_two_reports(two_runs):
+    """`compare` on two same-shape reports exits 0 and prints the per-metric
+    delta table (the acceptance criterion's diff surface)."""
+    _, _, p1, p2 = two_runs
+    proc = _cli("compare", str(p1), str(p2))
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    for metric in ("retraces", "cost_bytes_per_chunk", "compile_s",
+                   "steady_real_per_s_per_chip", "delta"):
+        assert metric in proc.stdout, f"missing {metric} in:\n{proc.stdout}"
+
+
+def test_cli_compare_flags_regression(tmp_path):
+    a = obs.RunReport(meta={"nreal": 8, "chunk": 8, "n_devices": 1},
+                      chunks=[{"idx": 0, "wall_s": 1.0, "synced": True}],
+                      retraces=0, total_s=1.0)
+    b = obs.RunReport(meta={"nreal": 8, "chunk": 8, "n_devices": 1},
+                      chunks=[{"idx": 0, "wall_s": 2.0, "synced": True}],
+                      retraces=3, total_s=2.0)
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    a.save(pa)
+    b.save(pb)
+    ok = _cli("compare", str(pa), str(pb))
+    assert ok.returncode == 0 and "REGRESSION" in ok.stdout
+    strict = _cli("compare", str(pa), str(pb), "--fail-on-regression")
+    assert strict.returncode == 1
+    assert "retraces" in strict.stdout
+
+
+def test_cli_usage_errors_exit_2(tmp_path):
+    proc = _cli("summarize", str(tmp_path / "missing.jsonl"))
+    assert proc.returncode == 2
+    assert "error:" in proc.stderr
