@@ -21,6 +21,11 @@ RunReport each ``sim.run()`` attaches):
 - ``cost_bytes_per_chunk`` (and ``cost_flops_per_chunk``): XLA cost-analysis
   bytes/FLOPs of one chunk program — the roofline inputs as recorded
   artifacts;
+- ``os_real_per_s_per_chip`` / ``os_bytes_per_chunk``: the detection-lane
+  figures from a second measured run with ``os='hd'`` (the device optimal
+  statistic packed beside curves/autos — the configuration detection studies
+  actually use, no keep_corr and no (R, P, P) fetch), sourced from that
+  run's RunReport; ``obs compare --fail-on-regression`` gates them;
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
 """
 
@@ -98,6 +103,20 @@ def main():
         row["cost_bytes_per_chunk"] = rep.cost["bytes_per_chunk"]
     if rep.cost.get("flops_per_chunk"):
         row["cost_flops_per_chunk"] = rep.cost["flops_per_chunk"]
+
+    # the detection lane (fakepta_tpu.detect): same flagship program with the
+    # on-device optimal statistic packed beside curves/autos — measured
+    # separately because its chunk program differs (one extra contraction)
+    nreal_os = min(nreal, 2 * chunk)
+    sim.run(chunk, seed=98, chunk=chunk, os="hd")        # compile + warm up
+    out_os = sim.run(nreal_os, seed=1, chunk=chunk, os="hd")
+    os_rep = out_os["report"]
+    os_sum = os_rep.summary()
+    row["os_real_per_s_per_chip"] = os_sum.get(
+        "os_real_per_s_per_chip",
+        round(os_rep.steady_real_per_s_per_chip(), 2))
+    if os_sum.get("os_bytes_per_chunk"):
+        row["os_bytes_per_chunk"] = os_sum["os_bytes_per_chunk"]
     if fallback:
         row["fallback"] = "accelerator backend unavailable; CPU stand-in"
     print(json.dumps(row))

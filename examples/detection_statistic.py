@@ -8,8 +8,11 @@ HD-correlated background from one with uncorrelated noise only?
 This script runs both ensembles through the sharded device engine
 (:class:`fakepta_tpu.parallel.montecarlo.EnsembleSimulator`), projects each
 realization's binned correlation curve onto the Hellings-Downs template
-(a matched-filter statistic), and reports the separation of the two
-distributions:
+(a matched-filter statistic), and computes the noise-weighted optimal
+statistic on the device OS lane (``run(os=...)``, ``fakepta_tpu.detect``) —
+per-realization amp2 packed beside curves/autos, with no ``keep_corr=True``
+and no (R, P, P) correlation fetch (``--legacy-host-os`` keeps the old host
+path for A/B). It reports the separation of the two distributions:
 
     python examples/detection_statistic.py                  # defaults
     python examples/detection_statistic.py --npsr 100 --nreal 10000
@@ -64,6 +67,10 @@ def main():
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--platform", default=None,
                     help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--legacy-host-os", action="store_true",
+                    help="A/B path: fetch the full (R, P, P) correlation "
+                         "tensors (keep_corr=True) and run the host "
+                         "optimal_statistic instead of the device OS lane")
     args = ap.parse_args()
     import jax
     if args.platform:
@@ -72,6 +79,7 @@ def main():
     from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.correlated_noises import optimal_statistic
+    from fakepta_tpu.detect import OSSpec
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
 
@@ -86,27 +94,34 @@ def main():
     mask = np.asarray(batch.mask, dtype=np.float64)
     counts = mask @ mask.T
 
-    runs, corrs = {}, {}
+    # the device OS lane (fakepta_tpu.detect): per-realization amp2 computed
+    # inside the chunk program and packed beside curves/autos — no
+    # keep_corr=True, no (R, P, P) fetch, fused Pallas path stays legal.
+    # --legacy-host-os keeps the old host path for A/B.
+    spec = OSSpec(orf="hd", weighting="noise")
+    runs, amp2 = {}, {}
     for name, gwb in (("null", None), ("injected", GWBConfig(psd=psd, orf="hd"))):
         include = ("white", "red", "dm") + (("gwb",) if gwb else ())
         sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh)
         out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
-                      keep_corr=True)
+                      keep_corr=args.legacy_host_os,
+                      os=None if args.legacy_host_os else spec)
         runs[name] = matched_filter(out["curves"], out["autos"],
                                     out["bin_centers"])
-        corrs[name] = out["corr"]
+        if args.legacy_host_os:
+            amp2[name] = optimal_statistic(out["corr"], pos,
+                                           counts=counts)["amp2"]
+        else:
+            amp2[name] = out["os"]["stats"]["hd"]["amp2"]
 
     null, inj = runs["null"], runs["injected"]
     thresh = float(np.percentile(null, 95.0))
     significance = float((inj.mean() - null.mean()) / max(null.std(), 1e-300))
     # the noise-weighted optimal statistic, with sigma calibrated EMPIRICALLY
-    # from the matched null ensemble via null_amp2 (the analytic white-noise
-    # sigma is miscalibrated under red noise; the null run is the yardstick)
-    null_os = optimal_statistic(corrs["null"], pos, counts=counts)["amp2"]
-    os = optimal_statistic(corrs["injected"], pos, counts=counts,
-                           null_amp2=null_os)
-    inj_os = os["amp2"]
-    sigma_emp = float(os["sigma"])
+    # from the matched null ensemble (the analytic white-noise sigma is
+    # miscalibrated under red noise; the null run is the yardstick)
+    null_os, inj_os = amp2["null"], amp2["injected"]
+    sigma_emp = float(np.std(null_os, ddof=1))
     os_significance = float((inj_os.mean() - null_os.mean())
                             / max(sigma_emp, 1e-300))
     print(json.dumps({

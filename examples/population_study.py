@@ -12,7 +12,10 @@ ensemble) for every realization inside the compiled device program.
     python examples/population_study.py --cgw              # add a sampled CW
 
 Prints one JSON line: the empirically-calibrated (null-ensemble) detection
-statistics under full prior marginalization.
+statistics under full prior marginalization. The optimal statistic runs on
+the device OS lane (``run(os=...)``, ``fakepta_tpu.detect``) — packed beside
+curves/autos with no ``keep_corr=True`` and no (R, P, P) fetch;
+``--legacy-host-os`` keeps the old host path for A/B.
 """
 
 import argparse
@@ -48,6 +51,10 @@ def main():
                          "marginalizes the bend frequency lf0 ~ U(-8.8, -8)")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--legacy-host-os", action="store_true",
+                    help="A/B path: fetch the full (R, P, P) correlation "
+                         "tensors (keep_corr=True) and run the host "
+                         "optimal_statistic instead of the device OS lane")
     ap.add_argument("--report", type=pathlib.Path, default=None,
                     help="save the injected ensemble's RunReport (the "
                          "fakepta_tpu.obs JSON-lines telemetry artifact) "
@@ -62,6 +69,7 @@ def main():
     from fakepta_tpu import spectrum as spectrum_lib
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.correlated_noises import optimal_statistic
+    from fakepta_tpu.detect import OSSpec
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (CGWSampling,
                                                  EnsembleSimulator, GWBConfig,
@@ -100,7 +108,11 @@ def main():
         extra.update(cgw_sample=CGWSampling(tref=float(toas_abs[0].mean())),
                      toas_abs=toas_abs)
 
-    runs = {}
+    # the device OS lane (fakepta_tpu.detect): amp2 computed inside the chunk
+    # program, packed beside curves/autos — no keep_corr, no (R, P, P) fetch
+    # (--legacy-host-os keeps the old host path for A/B)
+    spec = OSSpec(orf="hd", weighting="noise")
+    amp2 = {}
     for name, gwb, samp in (
             ("null", None, [red_prior]),
             ("injected", GWBConfig(psd=psd, orf="hd"),
@@ -111,16 +123,21 @@ def main():
         sim = EnsembleSimulator(batch, gwb=gwb, include=include, mesh=mesh,
                                 noise_sample=samp, **extra)
         out = sim.run(args.nreal, seed=args.seed, chunk=args.chunk,
-                      keep_corr=True)
-        runs[name] = out["corr"]
+                      keep_corr=args.legacy_host_os,
+                      os=None if args.legacy_host_os else spec)
+        if args.legacy_host_os:
+            amp2[name] = optimal_statistic(out["corr"], pos,
+                                           counts=counts)["amp2"]
+        else:
+            amp2[name] = out["os"]["stats"]["hd"]["amp2"]
         if args.report is not None and name == "injected":
             # the L5 surface: every run carries its telemetry artifact
             out["report"].save(args.report)
             print(f"saved RunReport -> {args.report}", file=sys.stderr)
 
-    null_os = optimal_statistic(runs["null"], pos, counts=counts)["amp2"]
-    os = optimal_statistic(runs["injected"], pos, counts=counts,
-                           null_amp2=null_os)
+    null_os = amp2["null"]
+    os = {"amp2": amp2["injected"],
+          "sigma": float(np.std(null_os, ddof=1))}
     thresh = float(np.percentile(null_os, 95.0))
     print(json.dumps({
         "npsr": args.npsr, "nreal": args.nreal,

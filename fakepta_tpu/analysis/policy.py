@@ -55,6 +55,14 @@ DTYPE_POLICY = {
     "fakepta_tpu/obs/report.py": "host-f64",
     "fakepta_tpu/obs/cli.py": "host-f64",
     "fakepta_tpu/obs/__main__.py": "host-f64",
+    # the detection-statistics subsystem's host layers: operator precompute
+    # (ORF templates, pair counts, noise weighting) is one-off f64 staging
+    # like the ORF Cholesky; the facade/CLI reduce packed lanes with host
+    # numpy. The device contraction itself lives in parallel/montecarlo.py
+    # under the default device-f32 policy.
+    "fakepta_tpu/detect/operators.py": "host-f64",
+    "fakepta_tpu/detect/run.py": "host-f64",
+    "fakepta_tpu/detect/cli.py": "host-f64",
 }
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"

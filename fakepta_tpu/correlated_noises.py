@@ -124,25 +124,24 @@ def optimal_statistic(corr, pos, orf="hd", sigma2=None, counts=None,
     engine makes thousands of null realizations cheap, which is the point of
     the framework.
     """
+    # the weighting core is single-sourced with the device OS lane
+    # (fakepta_tpu.detect.operators builds the engine's packed-lane weights
+    # from the same function, so the two paths cannot drift)
+    from .detect.operators import pair_weighting
+
     corr = np.asarray(corr)
     if corr.ndim == 2:
         corr = corr[None]
     npsr = corr.shape[1]
     orfs = np.asarray(gwb_ops.build_orf(orf, np.asarray(pos), h_map))
-    a, b = np.triu_indices(npsr, 1)
-    gam = orfs[a, b]
-    rho = corr[:, a, b]
     if sigma2 is None:
         sigma2 = corr[:, np.arange(npsr), np.arange(npsr)].mean(0)
-    sigma2 = np.asarray(sigma2, dtype=np.float64)
-    if counts is None:
-        pair_counts = np.ones(len(a))
-    else:
-        pair_counts = np.asarray(counts, dtype=np.float64)[a, b]
     # inverse variance: pairs with zero shared TOAs carry zero weight (their
     # rho is identically 0; counting them would bias amp2 low and shrink sigma)
-    inv_var = pair_counts / (sigma2[a] * sigma2[b])
-    denom = float((gam ** 2 * inv_var).sum())
+    a, b, gam, inv_var, denom = pair_weighting(
+        orfs, sigma2,
+        np.ones((npsr, npsr)) if counts is None else counts)
+    rho = corr[:, a, b]
     if denom <= 0.0:
         raise ValueError(
             f"ORF {orf!r} has no weighted cross-correlation signal (e.g. "

@@ -1,0 +1,31 @@
+"""fakepta_tpu.detect — on-device detection statistics as an engine lane.
+
+The subsystem that turns the engine's "null vs injected" north star into a
+first-class workload: the per-realization optimal statistic (amp2, SNR,
+sigma) is computed INSIDE the jitted chunk program from the raw pair sums
+and packed beside curves/autos, so detection studies never fetch an
+(R, P, P) correlation tensor and never disable the fused Pallas path.
+
+Layers (docs/DETECTION.md):
+
+- :mod:`operators` — host-f64 precompute: ORF templates, valid-pair TOA
+  counts, noise weighting from the batch's white variances; shared with
+  :func:`fakepta_tpu.correlated_noises.optimal_statistic`.
+- the device lane — ``EnsembleSimulator.run(os=...)`` (an ORF name, a
+  sequence, or an :class:`OSSpec`), including the paired noise-only stream
+  for on-device empirical null calibration (``OSSpec(null=True)``).
+- :class:`DetectionRun` — the host facade: one call runs a null-calibrated
+  detection study and emits a schema-versioned summary artifact that
+  ``python -m fakepta_tpu.obs compare`` can diff.
+- CLI: ``python -m fakepta_tpu.detect run ...``.
+"""
+
+from .operators import (DETECT_SCHEMA, OSOperator, OSSpec, as_spec,
+                        assemble, build_operators, pair_weighting,
+                        pulsar_noise_levels)
+from .run import DetectionRun
+
+__all__ = [
+    "DETECT_SCHEMA", "DetectionRun", "OSOperator", "OSSpec", "as_spec",
+    "assemble", "build_operators", "pair_weighting", "pulsar_noise_levels",
+]

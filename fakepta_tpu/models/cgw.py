@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .. import constants as const
 
@@ -148,19 +149,114 @@ def cw_delay(toas, pos, pdist, cos_gwtheta=0.0, gwphi=0.0, cos_inc=0.0, log10_mc
     cos2i = jnp.cos(2.0 * inc)
     cosi = jnp.cos(inc)
 
-    def polarisation_terms(phase, omega):
-        amp = mc53 / (dist * omega ** (1.0 / 3.0))
-        a_t = -0.5 * jnp.sin(2.0 * phase) * (3.0 + cos2i)
-        b_t = 2.0 * jnp.cos(2.0 * phase) * cosi
-        rplus = amp * (-a_t * jnp.cos(2.0 * psi) + b_t * jnp.sin(2.0 * psi))
-        rcross = amp * (a_t * jnp.sin(2.0 * psi) + b_t * jnp.cos(2.0 * psi))
-        return rplus, rcross
-
-    rplus_e, rcross_e = polarisation_terms(phase_e, omega_e)
+    rplus_e, rcross_e = _polarisation_terms(phase_e, omega_e, mc53, dist,
+                                            cos2i, cosi, psi)
     if psrTerm:
-        rplus_p, rcross_p = polarisation_terms(phase_p, omega_p)
+        rplus_p, rcross_p = _polarisation_terms(phase_p, omega_p, mc53, dist,
+                                                cos2i, cosi, psi)
         return fplus * (rplus_p - rplus_e) + fcross * (rcross_p - rcross_e)
     return -fplus * rplus_e - fcross * rcross_e
+
+
+def _polarisation_terms(phase, omega, mc53, dist, cos2i, cosi, psi):
+    """r+, rx of one term (earth or pulsar) — shared by every delay variant."""
+    amp = mc53 / (dist * omega ** (1.0 / 3.0))
+    a_t = -0.5 * jnp.sin(2.0 * phase) * (3.0 + cos2i)
+    b_t = 2.0 * jnp.cos(2.0 * phase) * cosi
+    rplus = amp * (-a_t * jnp.cos(2.0 * psi) + b_t * jnp.sin(2.0 * psi))
+    rcross = amp * (a_t * jnp.sin(2.0 * psi) + b_t * jnp.cos(2.0 * psi))
+    return rplus, rcross
+
+
+def psrterm_phase_bulk(tau, log10_mc, log10_fgw):
+    """Host-f64 orbital-phase bulk ``dph(-tau)`` of the retarded time, mod 2pi.
+
+    ``tau = L (1 - cos mu)`` is the pulsar term's retardation (seconds) —
+    ~1e11 s, so the orbital phase accumulated over it is ~1e3-1e4 rad. A
+    float32 kernel representing that phase loses ~2e-4 rad per ulp *and* the
+    rounding is compiled-op-order dependent, which is what used to bound
+    cross-mesh reproducibility of sampled pulsar-term CGWs at ~1e-3
+    (CGWSampling docstring, pre-split). This helper evaluates the bulk at
+    float64 on the host (inputs: pdist and positions staged host-f64, the
+    f32-exact sampled sky and frequency upcast) and reduces it mod 2pi, so
+    only the small residual phase — the identity
+    ``dph(t - tau) = dph(-tau) + dph(t; omega0 (1 + k tau)^{-3/8})`` is exact
+    — is left to the f32 kernel (:func:`cw_delay_psrterm_split`).
+
+    Mirrors :func:`_orbital_evolution`'s merger clamp so a pathological draw
+    (negative sampled distance pushing the retarded epoch past merger) stays
+    finite on host and device alike. Broadcasts over any common shape.
+    """
+    mc53 = (10.0 ** np.asarray(log10_mc, dtype=np.float64)
+            * const.Tsun) ** (5.0 / 3.0)
+    omega0 = np.pi * 10.0 ** np.asarray(log10_fgw, dtype=np.float64)
+    k = (256.0 / 5.0) * mc53 * omega0 ** (8.0 / 3.0)
+    x = np.minimum(-k * np.asarray(tau, dtype=np.float64), _MERGER_CLAMP)
+    bulk = (-np.expm1((5.0 / 8.0) * np.log1p(-x))
+            * omega0 ** (-5.0 / 3.0) / (32.0 * mc53))
+    return np.mod(bulk, 2.0 * np.pi)
+
+
+def cw_delay_psrterm_split(toas, pos, pdist, psr_bulk, cos_gwtheta=0.0,
+                           gwphi=0.0, cos_inc=0.0, log10_mc=9.0,
+                           log10_fgw=-8.0, log10_dist=None, log10_h=None,
+                           phase0=0.0, psi=0.0, p_dist=0.0):
+    """Evolving pulsar-term CGW residual with the retarded-phase bulk supplied.
+
+    Float32-stable variant of ``cw_delay(evolve=True, psrTerm=True)`` for the
+    sampled engine path: ``psr_bulk`` is the pulsar term's orbital-phase bulk
+    ``dph(-tau)`` mod 2pi, precomputed at host float64
+    (:func:`psrterm_phase_bulk`). The split is algebraically exact — with
+    ``s0 = 1 + k tau`` the retarded evolution factors as
+
+        dph(t - tau) = dph(-tau) + dph(t; omega0') ,  omega0' = omega0 s0^{-3/8}
+
+    (``omega0'`` is the retarded orbital frequency at t=0) — so the kernel
+    only ever handles phases of order ``omega' t`` ~ tens of radians, where
+    f32 rounding is ~1e-6 rad and compiled-op-order effects are invisible:
+    realization streams become mesh-shape reproducible at the engine's common
+    tolerance instead of the old ~1e-3 pulsar-term bound. ``toas`` are epochs
+    relative to the caller's ``tref`` (the bulk's tau must come from the same
+    sampled sky/frequency/distance draw this call receives).
+    """
+    t = jnp.asarray(toas)
+    mc = 10.0 ** log10_mc * const.Tsun
+    mc53 = mc ** (5.0 / 3.0)
+    fgw = 10.0 ** log10_fgw
+    omega0 = jnp.pi * fgw
+    inc = jnp.arccos(cos_inc)
+    gwtheta = jnp.arccos(cos_gwtheta)
+
+    dist_mean, dist_sigma = pdist[0], pdist[1]
+    p_dist_sec = (dist_mean + dist_sigma * p_dist) * const.kpc / const.c
+
+    if log10_h is not None:
+        dist = 2.0 * mc53 * omega0 ** (2.0 / 3.0) / 10.0 ** log10_h
+    elif log10_dist is not None:
+        dist = 10.0 ** log10_dist * const.Mpc / const.c
+    else:
+        raise ValueError("one of log10_dist or log10_h must be given")
+
+    fplus, fcross, cos_mu = antenna_pattern(pos, gwtheta, gwphi)
+    tau = p_dist_sec * (1.0 - cos_mu)
+    k = (256.0 / 5.0) * mc53 * omega0 ** (8.0 / 3.0)
+    # s0 = 1 - x(-tau), clamped exactly like _orbital_evolution clamps x
+    s0 = jnp.maximum(1.0 + k * tau, 1.0 - _MERGER_CLAMP)
+    omega0_p = omega0 * s0 ** (-3.0 / 8.0)
+
+    phase_orb0 = phase0 / 2.0
+    omega_e, dph_e = _orbital_evolution(t, omega0, mc53)
+    omega_p, dph_p = _orbital_evolution(t, omega0_p, mc53)
+    phase_e = phase_orb0 + dph_e
+    phase_p = phase_orb0 + psr_bulk + dph_p
+
+    cos2i = jnp.cos(2.0 * inc)
+    cosi = jnp.cos(inc)
+    rplus_e, rcross_e = _polarisation_terms(phase_e, omega_e, mc53, dist,
+                                            cos2i, cosi, psi)
+    rplus_p, rcross_p = _polarisation_terms(phase_p, omega_p, mc53, dist,
+                                            cos2i, cosi, psi)
+    return fplus * (rplus_p - rplus_e) + fcross * (rcross_p - rcross_e)
 
 
 def cw_delay_batched(toas, pos, pdist, cos_gwtheta, gwphi, cos_inc, log10_mc,

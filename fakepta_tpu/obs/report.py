@@ -90,6 +90,20 @@ class RunReport:
             m["cost_flops_per_chunk"] = self.cost["flops_per_chunk"]
         if self.memory.get("peak_bytes_in_use"):
             m["peak_bytes_in_use"] = self.memory["peak_bytes_in_use"]
+        if self.meta.get("os"):
+            # an OS-lane run: the same steady rate and chunk cost, under the
+            # names bench.py / benchmarks rows carry for the detection lane —
+            # `compare --fail-on-regression` then gates the OS path too
+            m["os_real_per_s_per_chip"] = round(
+                self.steady_real_per_s_per_chip(), 3)
+            if self.cost.get("bytes_per_chunk"):
+                m["os_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
+        # host-attached metrics (e.g. detect.DetectionRun's significance /
+        # detection-rate summary) round-trip through meta so a loaded
+        # artifact diffs them like any engine metric
+        extra = self.meta.get("extra_metrics")
+        if isinstance(extra, dict):
+            m.update(extra)
         return m
 
     # -- construction ------------------------------------------------------
@@ -187,7 +201,20 @@ def format_delta(a: RunReport, b: RunReport,
     ma, mb = a.summary(), b.summary()
     keys = sorted(set(ma) | set(mb))
     higher_is_better = {"real_per_s", "steady_real_per_s_per_chip"}
-    exempt = {"nreal", "chunks"}   # run-shape facts, not performance metrics
+
+    def _higher_is_better(k: str) -> bool:
+        # suffix rules cover the detect lane's per-ORF metric names
+        # (os_<orf>_significance_sigma, os_<orf>_detection_rate) and any
+        # future *_per_s_per_chip throughput metric
+        return (k in higher_is_better
+                or k.endswith(("_per_s_per_chip", "_significance_sigma",
+                               "_detection_rate")))
+
+    # run-shape facts and distribution-scale diagnostics, not performance or
+    # quality metrics — moving is information, not a regression
+    exempt = {"nreal", "chunks"}
+    exempt_suffixes = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
+                       "_null_q95", "_p_value_median")
     lines = [f"{'metric':<28} {'a':>14} {'b':>14} {'delta':>12}"]
     regressions = []
     for k in keys:
@@ -199,8 +226,9 @@ def format_delta(a: RunReport, b: RunReport,
         delta = vb - va
         rel = delta / abs(va) if va else (1.0 if delta else 0.0)
         flag = ""
-        if k not in exempt and abs(rel) > rel_threshold:
-            worse = rel < 0 if k in higher_is_better else rel > 0
+        if (k not in exempt and not k.endswith(exempt_suffixes)
+                and abs(rel) > rel_threshold):
+            worse = rel < 0 if _higher_is_better(k) else rel > 0
             if worse:
                 flag = "  << REGRESSION"
                 regressions.append(k)

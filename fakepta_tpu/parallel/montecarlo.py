@@ -122,6 +122,12 @@ class NoiseSampling:
       hyperparameters per realization (population marginalization over
       per-pulsar noise uncertainty); the sampled PSD replaces the batch's
       fixed ``<target>_psd`` for that stage.
+    - ``target='sys'``: each (pulsar, backend band) draws independent
+      hyperparameters per realization — the per-system population prior
+      completing the per-pulsar surface; the sampled PSD replaces the
+      batch's ``sys_psd`` while the band TOA membership (``sys_mask``)
+      stays the batch's. Keys fold the GLOBAL pulsar index then the band
+      index, so streams are mesh-shape independent like every other stage.
     - ``target='gwb'``: ONE global draw per realization (the background is
       common); replaces ``GWBConfig.psd``. The ORF and chromatic index still
       come from ``GWBConfig``.
@@ -162,13 +168,18 @@ class NoiseSampling:
 # domain tag for hyperparameter sampling keys (cf. 0x51 noise / 0x6B gwb /
 # 0x77 roemer-sampling); per-target subtags keep multi-target draws independent
 _HYPER_TAG = 0x9C
-_HYPER_SUBTAG = {"red": 0, "dm": 1, "chrom": 2, "gwb": 3}
+_HYPER_SUBTAG = {"red": 0, "dm": 1, "chrom": 2, "gwb": 3, "sys": 4}
 
 # domain tag for per-realization CGW source sampling
 _CGW_TAG = 0xC6
 
 # domain tag for per-realization white-noise/ECORR hyperparameter sampling
 _WHITE_TAG = 0xE1
+
+# domain tag for the OS lane's paired noise-only stream (detect null
+# calibration): null keys are fold_in(realization key, 0xD7), so the null
+# realizations are independent of — and as reproducible as — the signal ones
+_NULL_TAG = 0xD7
 
 
 @dataclasses.dataclass(frozen=True)
@@ -254,13 +265,16 @@ class CGWSampling:
     nuisance ``p_dist ~ N(0, 1)`` (in units of its ``pdist`` sigma, the
     convention the pulsar term's ``pdist=(mean, sigma)`` contract implies,
     ref ``fake_pta.py:436-441``) per realization — keys fold the global
-    pulsar index, so streams stay mesh-shape independent. Note the pulsar
-    term's retarded phase is ~omega L/c ~ 1e3-1e4 rad: at f32 its absolute
-    rounding is ~2e-4 rad, so realizations reproduce across mesh shapes only
-    to ~1e-4 relative (compiler op-ordering changes the rounding). That is
-    exactly the regime where the pulsar-term phase is physically a random
-    nuisance anyway; use the construction-time ``CGWConfig`` path (host
-    float64) when exact pulsar terms matter.
+    pulsar index, so streams stay mesh-shape independent. The pulsar term's
+    retarded phase is ~omega L/c ~ 1e3-1e4 rad — far beyond f32 — so its
+    bulk ``dph(-tau)`` is precomputed per (realization, pulsar) at host
+    float64 from the replicated draw chain and fed to the kernel mod 2pi
+    (``EnsembleSimulator._host_cgw_bulks`` /
+    :func:`fakepta_tpu.models.cgw.psrterm_phase_bulk`); the f32 kernel only
+    evaluates the O(10 rad) residual via the exact split
+    ``dph(t - tau) = dph(-tau) + dph(t; omega0 (1 + k tau)^{-3/8})``.
+    Realizations therefore reproduce across mesh shapes at the engine's
+    common tolerance (~1e-7 measured, vs ~1e-3 pre-split).
     """
 
     # field order: the original fields keep their round-4 positions (appending
@@ -456,6 +470,8 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                 per_psr = target != "gwb"
                 if target == "gwb":
                     nbin = n_gwbs[0]
+                elif target == "sys":
+                    nbin = batch.sys_psd.shape[2]
                 else:
                     nbin = {"red": n_red, "dm": n_dm}.get(target)
                     if nbin is None:
@@ -489,7 +505,20 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                                              else b)
                     return out
 
-                if per_psr:
+                if target == "sys":
+                    # per-(pulsar, band) draws: fold the GLOBAL pulsar index
+                    # (mesh-shape independence), then the band index — each
+                    # backend band is an independent population nuisance
+                    kts = jax.vmap(
+                        lambda g, k=kt: jax.random.fold_in(k, g))(gidx)
+                    kpb = jax.vmap(lambda kp: jax.vmap(
+                        lambda b, kp=kp: jax.random.fold_in(kp, b))(
+                            jnp.arange(n_bands)))(kts)          # (P, B) keys
+                    vals = jax.vmap(jax.vmap(draw_cfg))(kpb)
+                    df = batch.df_own[:, None, None]                # (P,1,1)
+                    kwargs = {n: (vals[n] if pb else vals[n][..., None])
+                              for n, pb in zip(names, per_bin)}
+                elif per_psr:
                     kts = jax.vmap(
                         lambda g, k=kt: jax.random.fold_in(k, g))(gidx)
                     vals = jax.vmap(draw_cfg)(kts)  # (P,) scalars, (P,N) bins
@@ -607,7 +636,8 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
             # loop over the (small) band count so no (R, P, B, T) intermediate
             # is ever materialized under the realization vmap.
             with obs.span("sys"):
-                c = draw(ks, n_bands, 2, n_sys) * sys_w[:, :, None, :]
+                c = draw(ks, n_bands, 2, n_sys) * w_samp.get(
+                    "sys", sys_w)[:, :, None, :]
                 for b in range(n_bands):
                     contrib = jnp.einsum("ptkn,pkn->pt", sys_basis, c[:, b])
                     res = res + jnp.where(batch.sys_mask[:, b], contrib, 0.0)
@@ -745,7 +775,8 @@ def _resolve_noise_sampling(cfg: NoiseSampling):
     return static, [list(ranges[n]) for n in names]
 
 
-def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
+def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag,
+                 bulk=None):
     """(R_local, P_local, T) per-realization CGW delays (shard_map body).
 
     ``t_rel`` is this shard's (P_local, T) epochs relative to the config's
@@ -757,8 +788,16 @@ def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
     index: one sampled source is a global nuisance per realization. The
     per-pulsar ``p_dist`` nuisance (subkey 2) folds the GLOBAL pulsar index,
     so streams stay mesh-shape independent.
+
+    ``bulk`` (psrterm configs only) is this shard's (R_local, P_local) slice
+    of the host-f64 retarded-phase bulk (``EnsembleSimulator._host_cgw_bulks``
+    replicates the same key chain on the host CPU backend — threefry is
+    backend-bit-exact — and evaluates the ~1e4-rad pulsar-term phase offset
+    at float64, mod 2pi). The kernel then only computes O(10 rad) residual
+    phases, which is what makes psrterm realization streams mesh-shape
+    reproducible at the common tolerance (models/cgw.py:psrterm_phase_bulk).
     """
-    from ..models.cgw import cw_delay
+    from ..models.cgw import cw_delay, cw_delay_psrterm_split
 
     psrterm, mode, dists, sample_pdist = static
     dtype = t_rel.dtype
@@ -766,7 +805,7 @@ def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
     norm_mask = np.array([d == "normal" for d in dists])
     gidx = lax.axis_index(PSR_AXIS) * p_local + jnp.arange(p_local)
 
-    def one(key):
+    def one(key, bulk_r):
         kz = jax.random.fold_in(jax.random.fold_in(key, _CGW_TAG), tag)
         u = jax.random.uniform(kz, (8,), dtype)
         v = ranges[:, 0] + u * (ranges[:, 1] - ranges[:, 0])
@@ -782,13 +821,21 @@ def _sampled_cgw(keys, t_rel, pos_local, pdist_local, ranges, static, tag):
             pd = jnp.zeros((p_local,), dtype)
         amp_kw = {("log10_h" if mode == "h" else "log10_dist"): v[5]}
         with obs.span("cgw"):
+            if bulk_r is not None:
+                return jax.vmap(lambda t, p, pdm, pz, br: cw_delay_psrterm_split(
+                    t, p, (pdm[0], pdm[1]), br, cos_gwtheta=v[0], gwphi=v[1],
+                    cos_inc=v[2], log10_mc=v[3], log10_fgw=v[4], phase0=v[6],
+                    psi=v[7], p_dist=pz,
+                    **amp_kw))(t_rel, pos_local, pdist_local, pd, bulk_r)
             return jax.vmap(lambda t, p, pdm, pz: cw_delay(
                 t, p, (pdm[0], pdm[1]), cos_gwtheta=v[0], gwphi=v[1],
                 cos_inc=v[2], log10_mc=v[3], log10_fgw=v[4], phase0=v[6],
                 psi=v[7], psrTerm=psrterm, evolve=True, p_dist=pz,
                 **amp_kw))(t_rel, pos_local, pdist_local, pd)
 
-    return jax.vmap(one)(keys)
+    if bulk is not None:
+        return jax.vmap(one)(keys, bulk)
+    return jax.vmap(lambda k: one(k, None))(keys)
 
 
 def _validated_toas_abs(batch, toas_abs, what: str) -> np.ndarray:
@@ -931,17 +978,20 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
     return jnp.where(batch.mask, det, 0.0)
 
 
-def pack_stats(curves, autos):
-    """Pack per-realization curves+autos into one (n, nbins+1) array.
+def pack_stats(curves, autos, *extras):
+    """Pack per-realization statistic lanes into one (n, nbins+1+...) array.
 
     The single source of truth for the packed statistic layout: lane
-    ``n < nbins`` is curve bin n, lane ``nbins`` is the mean autocorrelation.
-    Curves and autos ride one array so a chunk's outputs are ONE device->host
-    fetch (a round-trip through a remote-TPU tunnel costs ~80 ms flat
-    regardless of size). Works on device and host arrays alike.
+    ``n < nbins`` is curve bin n, lane ``nbins`` is the mean autocorrelation,
+    and any ``extras`` (each (n, K)) follow in order — the OS lane packs its
+    per-ORF amp2 values (and, under null calibration, the paired noise-only
+    amp2 values) here. Curves, autos and detection statistics ride one array
+    so a chunk's outputs are ONE device->host fetch (a round-trip through a
+    remote-TPU tunnel costs ~80 ms flat regardless of size). Works on device
+    and host arrays alike.
     """
     lib = np if isinstance(curves, np.ndarray) else jnp
-    return lib.concatenate([curves, autos[:, None]], axis=1)
+    return lib.concatenate([curves, autos[:, None], *extras], axis=1)
 
 
 def unpack_stats(packed, nbins: int):
@@ -1122,6 +1172,13 @@ class EnsembleSimulator:
             if cfg.target not in include:
                 raise ValueError(f"NoiseSampling target {cfg.target!r} needs "
                                  f"stage {cfg.target!r} in include")
+            if cfg.target == "sys" and not bool(
+                    np.any(np.asarray(batch.sys_mask))):
+                raise ValueError(
+                    "NoiseSampling('sys') needs system-noise bands: build "
+                    "the batch from pulsars with system_noise entries (the "
+                    "band TOA membership comes from sys_mask; only the PSD "
+                    "is replaced by the draws)")
             if cfg.target == "gwb" and not gwb_cfgs:
                 raise ValueError("NoiseSampling('gwb') needs a GWBConfig (its "
                                  "orf/idx and psd length set the program; the "
@@ -1217,7 +1274,8 @@ class EnsembleSimulator:
         has_chrom = bool(np.any(np.asarray(batch.chrom_psd) > 0.0)) \
             or "chrom" in sampled
         has_ecorr = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
-        has_sys = bool(np.any(np.asarray(batch.sys_psd) > 0.0))
+        has_sys = bool(np.any(np.asarray(batch.sys_psd) > 0.0)) \
+            or "sys" in sampled
         self._include = (("white" in include),
                          ("ecorr" in include and has_ecorr),
                          ("red" in include),
@@ -1301,6 +1359,10 @@ class EnsembleSimulator:
                  list(c.phase0), list(c.psi)], dtype))
         self._cgw_static = tuple(cgw_static)
         self._cgw_ranges = tuple(cgw_ranges)
+        # psrterm configs get a host-f64 retarded-phase bulk input per chunk
+        # (see _host_cgw_bulks): record which config indices need one
+        self._cgw_psrterm = tuple(j for j, stat in enumerate(cgw_static)
+                                  if stat[0])
         if cgw_s_list:
             toas64 = _validated_toas_abs(batch, toas_abs, "cgw_sample")
             self._cgw_trel = tuple(
@@ -1309,13 +1371,18 @@ class EnsembleSimulator:
             self._cgw_trel = ()
         if pdist is None:
             pdist = np.zeros((batch.npsr, 2))
-        self._pdist = jnp.asarray(
-            # fakepta: allow[dtype-policy] host staging; jnp cast to dtype
-            np.asarray(pdist, dtype=np.float64).reshape(batch.npsr, 2), dtype)
+        # fakepta: allow[dtype-policy] host staging; jnp cast to dtype below,
+        # f64 copy kept for the psrterm retarded-phase bulk precompute
+        self._pdist_host = np.asarray(pdist, dtype=np.float64).reshape(
+            batch.npsr, 2)
+        self._pdist = jnp.asarray(self._pdist_host, dtype)
 
         # angular bins for the correlation curve (static, from positions)
         # fakepta: allow[dtype-policy] host-f64 angle/bin setup, done once
         pos = np.asarray(batch.pos, dtype=np.float64)
+        # host-f64 positions, shared by the OS-lane operator build and the
+        # psrterm bulk precompute
+        self._pos64 = pos
         ang = np.arccos(np.clip(pos @ pos.T, -1, 1))
         edges = np.linspace(0.0, np.pi, nbins + 1)
         bin_idx = np.clip(np.digitize(ang, edges) - 1, 0, nbins - 1)
@@ -1398,10 +1465,15 @@ class EnsembleSimulator:
         self._obs_spans: set = set()
         self._obs_trace_counts: dict = {}
         self._obs_retraces = 0
-        self._obs_cost = None
+        self._obs_cost: dict = {}
         self._obs_in_capture = False
         self.last_report = None
 
+        # empty OS-weight stack for the plain fused step (the fused builders
+        # share one signature so the n_os=0 path stays byte-compatible)
+        self._w_os_empty = jnp.zeros((0, batch.npsr, batch.npsr), dtype)
+        self._step_os_cache: dict = {}
+        self._step_fused_os_cache: dict = {}
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
 
@@ -1422,22 +1494,41 @@ class EnsembleSimulator:
             obs.event("retrace", value=list(map(str, signature)),
                       count=n)
 
-    def _obs_capture_cost(self, base_key, chunk: int, fused: bool) -> dict:
+    def _obs_capture_cost(self, base_key, chunk: int, fused: bool,
+                          w_os=None, with_null: bool = False) -> dict:
         """One-time XLA cost/memory analysis of the chunk program (cached per
-        simulator). Uses the AOT path, which compiles a second executable —
-        that one extra compile is the documented price of making the
-        roofline's FLOPs/bytes a recorded artifact; events it emits are
-        sunk into a throwaway collector so they never pollute run metrics."""
-        if self._obs_cost is not None:
-            return self._obs_cost
+        simulator and step variant — plain/fused/OS/OS+null programs have
+        genuinely different FLOPs/bytes, and the OS lane's bytes-per-chunk is
+        a recorded benchmark metric). Uses the AOT path, which compiles a
+        second executable — that one extra compile is the documented price of
+        making the roofline's FLOPs/bytes a recorded artifact; events it
+        emits are sunk into a throwaway collector so they never pollute run
+        metrics."""
+        cache_key = (int(chunk), bool(fused),
+                     None if w_os is None else int(w_os.shape[0]),
+                     bool(with_null))
+        if cache_key in self._obs_cost:
+            return self._obs_cost[cache_key]
         cost: dict = {}
         self._obs_in_capture = True
         try:
             with obs.collect():     # sink capture-compile monitoring events
-                if fused:
-                    lowered = self._step_fused.lower(base_key, 0, chunk)
+                bulks = tuple(jnp.zeros((chunk, self.batch.npsr),
+                                        self.batch.t_own.dtype)
+                              for _ in self._cgw_psrterm)
+                if w_os is not None and fused:
+                    lowered = self._get_step_fused_os(
+                        int(w_os.shape[0]), with_null).lower(
+                            base_key, 0, chunk, w_os, bulks)
+                elif w_os is not None:
+                    lowered = self._get_step_os(with_null).lower(
+                        base_key, 0, chunk, w_os, bulks, False)
+                elif fused:
+                    lowered = self._step_fused.lower(
+                        base_key, 0, chunk, self._w_os_empty, bulks)
                 else:
-                    lowered = self._step.lower(base_key, 0, chunk, False)
+                    lowered = self._step.lower(base_key, 0, chunk, bulks,
+                                               False)
                 compiled = lowered.compile()
                 ca = compiled.cost_analysis()
                 ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
@@ -1459,7 +1550,7 @@ class EnsembleSimulator:
             pass    # best-effort: absent on some backends/jax builds
         finally:
             self._obs_in_capture = False
-        self._obs_cost = cost
+        self._obs_cost[cache_key] = cost
         return cost
 
     def _obs_memory_stats(self) -> dict:
@@ -1474,68 +1565,206 @@ class EnsembleSimulator:
                 "largest_alloc_size")
         return {k: int(stats[k]) for k in keep if k in stats}
 
-    def _build_step(self):
-        mesh = self.mesh
+    def _host_cgw_bulks(self, base_key, offset: int, nreal: int):
+        """Per-chunk host-f64 retarded-phase bulks for psrterm CGW sampling.
+
+        Replicates the device draw chain (0xC6 domain tag, per-config index,
+        per-pulsar global-index folds) on the host CPU backend — threefry key
+        streams are backend-bit-exact, so the host sees the same f32 sampled
+        sky, frequency and distance nuisances the kernel will draw — then
+        evaluates each realization's pulsar-term orbital-phase bulk
+        ``dph(-tau)`` at float64 from the host-staged pdist/positions, mod
+        2pi (:func:`fakepta_tpu.models.cgw.psrterm_phase_bulk`). The f32
+        kernel is left only the O(10 rad) residual phase, which is what makes
+        psrterm realization streams mesh-shape reproducible at the engine's
+        common tolerance. Returns one (nreal, npsr) batch-dtype array per
+        psrterm config (empty tuple when none): ordinary (real, psr)-sharded
+        step inputs, ~1e6 host flops per flagship chunk — noise against the
+        chunk's device work.
+        """
+        if not self._cgw_psrterm:
+            return ()
+        from .. import constants as const
+        from ..models.cgw import psrterm_phase_bulk
+
+        npsr = self.batch.npsr
+        ddt = self.batch.t_own.dtype
+        cpu = jax.local_devices(backend="cpu")[0]
+        key_data = np.asarray(jax.random.key_data(base_key))
+        out = []
+        with jax.default_device(cpu):
+            base = jax.random.wrap_key_data(jnp.asarray(key_data))
+            keys = jax.vmap(lambda i: jax.random.fold_in(base, i))(
+                offset + jnp.arange(nreal))
+            for j in self._cgw_psrterm:
+                _, _, dists, sample_pdist = self._cgw_static[j]
+                ranges = jnp.asarray(np.asarray(self._cgw_ranges[j]), ddt)
+                norm_mask = np.array([d == "normal" for d in dists])
+
+                def draw(key, j=j, ranges=ranges, norm_mask=norm_mask,
+                         sample_pdist=sample_pdist):
+                    # mirrors _sampled_cgw's draw chain op for op
+                    kz = jax.random.fold_in(
+                        jax.random.fold_in(key, _CGW_TAG), j)
+                    u = jax.random.uniform(kz, (8,), ddt)
+                    v = ranges[:, 0] + u * (ranges[:, 1] - ranges[:, 0])
+                    if norm_mask.any():
+                        g = jax.random.normal(jax.random.fold_in(kz, 1),
+                                              (8,), ddt)
+                        v = jnp.where(jnp.asarray(norm_mask),
+                                      ranges[:, 0] + g * ranges[:, 1], v)
+                    if sample_pdist:
+                        kpd = jax.random.fold_in(kz, 2)
+                        pd = jax.vmap(lambda gi: jax.random.normal(
+                            jax.random.fold_in(kpd, gi), (),
+                            ddt))(jnp.arange(npsr))
+                    else:
+                        pd = jnp.zeros((npsr,), ddt)
+                    return v, pd
+
+                v, pd = jax.jit(jax.vmap(draw))(keys)
+                # fakepta: allow[dtype-policy] sanctioned host-f64 stage: the
+                # ~1e4 rad retarded phase loses ~2e-4 rad/ulp at f32
+                v = np.asarray(v, np.float64)
+                # fakepta: allow[dtype-policy] same host-f64 bulk stage
+                pd = np.asarray(pd, np.float64)
+                # cos(mu) at f64 from the f32-exact sampled sky (same antenna
+                # geometry as models.cgw.antenna_pattern)
+                sin_t = np.sqrt(np.maximum(1.0 - v[:, 0] ** 2, 0.0))
+                cosmu = (sin_t[:, None] * np.cos(v[:, 1])[:, None]
+                         * self._pos64[None, :, 0]
+                         + sin_t[:, None] * np.sin(v[:, 1])[:, None]
+                         * self._pos64[None, :, 1]
+                         + v[:, 0][:, None] * self._pos64[None, :, 2])
+                dist_sec = ((self._pdist_host[None, :, 0]
+                             + self._pdist_host[None, :, 1] * pd)
+                            * const.kpc / const.c)
+                tau = dist_sec * (1.0 - cosmu)
+                bulk = psrterm_phase_bulk(tau, v[:, 3][:, None],
+                                          v[:, 4][:, None])
+                out.append(np.asarray(bulk, ddt))
+        return tuple(out)
+
+    def _residuals(self, keys, batch, chols, gwb_ws, det, samp_params,
+                   white_params, white_toaerr2, white_bid, cgw_trel,
+                   cgw_pdist, cgw_bulks, roe, *, toa_shards, null=False):
+        """(R_local, P_local, T) residual blocks inside a shard_map body.
+
+        The single signal-assembly path every step variant (XLA, fused
+        Pallas, OS, OS+null) shares, so adding a stage cannot fork the
+        program. Term order is frozen (noise block, deterministic block,
+        sampled Roemer, sampled CGW): f32 addition order is part of the
+        realization-stream contract. ``null=True`` is the OS lane's paired
+        noise-only stream — same noise stages and sampled noise nuisances
+        under the caller's (derived) keys, but no common correlated signal,
+        no deterministic block and no sampled CGW sources.
+        """
+        inc = self._include if not null else self._include[:6] + (False,)
+        res = _simulate_block(keys, batch, chols, gwb_ws, self._gwb_idx,
+                              self._gwb_freqf, *inc,
+                              samp_static=self._samp_static,
+                              samp_params=samp_params,
+                              bases_bf16=self._bases_bf16,
+                              white_static=self._white_static,
+                              white_params=white_params,
+                              white_toaerr2=white_toaerr2,
+                              white_bid=white_bid, white_nb=self._white_nb,
+                              toa_shards=toa_shards)
+        if self._has_det and not null:
+            res = res + det[None]
+        for j in range(len(self._roe_states)):
+            term = _sampled_roemer(keys, roe[j], self._roe_scales[j],
+                                   batch.pos, tag=j)
+            res = res + jnp.where(batch.mask, term, 0.0)
+        if not null:
+            bulks = dict(zip(self._cgw_psrterm, cgw_bulks))
+            for j, stat in enumerate(self._cgw_static):
+                term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
+                                    self._cgw_ranges[j], stat, tag=j,
+                                    bulk=bulks.get(j))
+                res = res + jnp.where(batch.mask, term, 0.0)
+        return res
+
+    def _step_in_specs(self, has_toa):
+        """shard_map in_specs shared by every step variant (after the keys).
+
+        (P, T) side inputs shard over 'toa' like the batch's per-TOA leaves;
+        the no-sampling white dummies are (P, 1) broadcast shapes and stay
+        replicated over 'toa'; psrterm CGW bulk inputs shard (real, psr).
+        """
+        pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
+        white_spec = pt_spec if self._white_static is not None else P(PSR_AXIS)
+        return (_batch_specs(has_toa),
+                tuple(P() for _ in self._chol),
+                tuple(P() for _ in self._gwb_w), pt_spec,
+                tuple(P() for _ in self._samp_params), P(),
+                white_spec, white_spec,
+                tuple(pt_spec for _ in self._cgw_trel), P(PSR_AXIS),
+                tuple(P(REAL_AXIS, PSR_AXIS) for _ in self._cgw_psrterm),
+                *(tuple(_orbit_state_specs(has_toa)
+                        for _ in self._roe_states)))
+
+    def _make_corr_sharded(self, with_null):
+        """shard_map'd raw-pair-sum program behind the XLA step variants.
+
+        Yields corr (R, P, P) sharded over (real, psr) — plus the paired
+        noise-only stream's corr when ``with_null`` (the OS lane's on-device
+        null calibration; per-realization keys derive via the 0xD7 tag, so
+        the null stream is as reproducible as the signal one and never names
+        a mesh axis beyond the declared (real, psr, toa)).
+        """
         has_toa = self._has_toa
         toa_shards = self._n_toa_shards
-        batch_specs = _batch_specs(has_toa)
-        inc = self._include
-        has_det = self._has_det
-        roe_scales = self._roe_scales
-        n_roe = len(self._roe_states)
-        samp_static = self._samp_static
-        cgw_static = self._cgw_static
-        cgw_ranges = self._cgw_ranges
-
-        white_static = self._white_static
-        white_nb = self._white_nb
 
         def sharded(keys, batch, chol, gwb_w, det, samp_params, white_params,
-                    white_toaerr2, white_bid, cgw_trel, cgw_pdist, *roe):
-            res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, *inc,
-                                  samp_static=samp_static,
-                                  samp_params=samp_params,
-                                  bases_bf16=self._bases_bf16,
-                                  white_static=white_static,
-                                  white_params=white_params,
-                                  white_toaerr2=white_toaerr2,
-                                  white_bid=white_bid, white_nb=white_nb,
+                    white_toaerr2, white_bid, cgw_trel, cgw_pdist, cgw_bulks,
+                    *roe):
+            res = self._residuals(keys, batch, chol, gwb_w, det, samp_params,
+                                  white_params, white_toaerr2, white_bid,
+                                  cgw_trel, cgw_pdist, cgw_bulks, roe,
                                   toa_shards=toa_shards)
-            if has_det:
-                res = res + det[None]
-            for j in range(n_roe):
-                term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
-                                       tag=j)
-                res = res + jnp.where(batch.mask, term, 0.0)
-            for j, stat in enumerate(cgw_static):
-                term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
-                                    cgw_ranges[j], stat, tag=j)
-                res = res + jnp.where(batch.mask, term, 0.0)
-            return _correlation_rows(res, stats_bf16=self._stats_bf16,
+            corr = _correlation_rows(res, stats_bf16=self._stats_bf16,
                                      toa_psum=has_toa)
+            if not with_null:
+                return corr
+            with obs.span("null"):
+                nkeys = jax.vmap(
+                    lambda k: jax.random.fold_in(k, _NULL_TAG))(keys)
+                res0 = self._residuals(nkeys, batch, chol, gwb_w, det,
+                                       samp_params, white_params,
+                                       white_toaerr2, white_bid, cgw_trel,
+                                       cgw_pdist, cgw_bulks, roe,
+                                       toa_shards=toa_shards, null=True)
+                corr0 = _correlation_rows(res0, stats_bf16=self._stats_bf16,
+                                          toa_psum=has_toa)
+            return corr, corr0
 
-        # (P, T) side inputs shard over 'toa' like the batch's per-TOA leaves;
-        # the no-sampling white dummies are (P, 1) broadcast shapes and stay
-        # replicated over 'toa'
-        pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
-        white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
-        roe_specs = tuple(_orbit_state_specs(has_toa) for _ in range(n_roe))
-        samp_specs = tuple(P() for _ in self._samp_params)
-        cgw_trel_specs = tuple(pt_spec for _ in self._cgw_trel)
-        shmapped = shard_map(
-            sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs,
-                      tuple(P() for _ in self._chol),
-                      tuple(P() for _ in self._gwb_w), pt_spec,
-                      samp_specs, P(), white_spec, white_spec,
-                      cgw_trel_specs, P(PSR_AXIS), *roe_specs),
-            out_specs=P(REAL_AXIS, PSR_AXIS),
+        out_spec = P(REAL_AXIS, PSR_AXIS)
+        return shard_map(
+            sharded, mesh=self.mesh,
+            in_specs=(P(REAL_AXIS), *self._step_in_specs(has_toa)),
+            out_specs=(out_spec, out_spec) if with_null else out_spec,
         )
-        roe_args = self._roe_states
 
-        @partial(jax.jit, static_argnums=(2, 3))
-        def step(base_key, offset, nreal, with_corr=False):
+    def _stat_lanes(self, corr):
+        """Curve + auto lanes from a (R, P, P) raw pair-sum tensor.
+
+        HIGHEST: these einsums lower to matmuls, and XLA's default TPU
+        matmul rounds f32 operands to bf16 — a free-to-avoid ~4e-3
+        relative error here (the binning is a trivial fraction of the
+        program's FLOPs; the big corr contraction keeps the fast default).
+        """
+        hi = jax.lax.Precision.HIGHEST
+        curves = jnp.einsum("rpq,pqn->rn", corr, self._w_bins, precision=hi)
+        # mean autocorrelation (count-normalized trace / P)
+        autos = jnp.einsum("rpq,pq->r", corr, self._w_auto, precision=hi)
+        return curves, autos
+
+    def _build_step(self):
+        shmapped = self._make_corr_sharded(False)
+
+        @partial(jax.jit, static_argnums=(2, 4))
+        def step(base_key, offset, nreal, cgw_bulks, with_corr=False):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step", nreal, with_corr))
             # per-realization keys derived on device: one tiny transfer per chunk
@@ -1544,16 +1773,9 @@ class EnsembleSimulator:
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
                             self._det, self._samp_params, self._white_params,
                             self._white_toaerr2, self._white_bid,
-                            self._cgw_trel, self._pdist, *roe_args)
-            # HIGHEST: these einsums lower to matmuls, and XLA's default TPU
-            # matmul rounds f32 operands to bf16 — a free-to-avoid ~4e-3
-            # relative error here (the binning is a trivial fraction of the
-            # program's FLOPs; the big corr contraction keeps the fast default)
-            hi = jax.lax.Precision.HIGHEST
-            curves = jnp.einsum("rpq,pqn->rn", corr, self._w_bins,
-                                precision=hi)
-            # mean autocorrelation (count-normalized trace / P)
-            autos = jnp.einsum("rpq,pq->r", corr, self._w_auto, precision=hi)
+                            self._cgw_trel, self._pdist, cgw_bulks,
+                            *self._roe_states)
+            curves, autos = self._stat_lanes(corr)
             # with_corr=False drops the (nreal, P, P) tensor from the program
             # outputs entirely: it stays a fusible intermediate instead of a
             # forced 400 MB HBM output buffer at the flagship size
@@ -1564,118 +1786,206 @@ class EnsembleSimulator:
 
         return step
 
+    def _build_step_os(self, with_null):
+        """XLA step with the OS lane: per-ORF amp2 packed beside curves/autos.
+
+        ``w_os`` is the (K, P, P) stack of ``fakepta_tpu.detect`` operator
+        weight matrices (host-f64 precompute cast to the batch dtype); each
+        realization's optimal statistic is ONE extra einsum against the raw
+        pair sums, so the (R, P, P) tensor stays a fusible intermediate — the
+        detection workload inherits the engine's packed single-fetch contract
+        instead of forcing ``keep_corr=True``. ``with_null`` adds the paired
+        noise-only stream's lanes for on-device null calibration.
+        """
+        shmapped = self._make_corr_sharded(with_null)
+
+        @partial(jax.jit, static_argnums=(2, 5))
+        def step(base_key, offset, nreal, w_os, cgw_bulks, with_corr=False):
+            # trace-time only: the retrace guard (see _obs_note_trace)
+            # w_os.shape[0] is a static Python int at trace time
+            self._obs_note_trace(("step_os", nreal, w_os.shape[0],
+                                  with_null, with_corr))
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                offset + jnp.arange(nreal))
+            out = shmapped(keys, self.batch, self._chol, self._gwb_w,
+                           self._det, self._samp_params, self._white_params,
+                           self._white_toaerr2, self._white_bid,
+                           self._cgw_trel, self._pdist, cgw_bulks,
+                           *self._roe_states)
+            corr, corr0 = out if with_null else (out, None)
+            curves, autos = self._stat_lanes(corr)
+            hi = jax.lax.Precision.HIGHEST
+            with obs.span("os"):
+                extras = [jnp.einsum("rpq,kpq->rk", corr, w_os, precision=hi)]
+                if with_null:
+                    extras.append(jnp.einsum("rpq,kpq->rk", corr0, w_os,
+                                             precision=hi))
+            packed = pack_stats(curves, autos, *extras)
+            if with_corr:
+                return packed, corr / self._counts_dev
+            return packed
+
+        return step
+
+    def _get_step_os(self, with_null):
+        step = self._step_os_cache.get(bool(with_null))
+        if step is None:
+            step = self._build_step_os(bool(with_null))
+            self._step_os_cache[bool(with_null)] = step
+        return step
+
     def _build_step_fused(self):
-        """Pallas statistic path: one kernel computes curves+autos from residuals
-        with the per-realization correlation block kept in VMEM (see
-        :mod:`fakepta_tpu.ops.pallas_kernels`)."""
+        """The plain fused statistic path — the n_os=0 case of
+        :meth:`_build_step_fused_os` (one builder, so the OS lanes cannot
+        fork the kernel program)."""
+        return self._build_step_fused_os(0, False)
+
+    def _build_step_fused_os(self, n_os, with_null):
+        """Pallas statistic path: one kernel computes curves+autos (and any
+        OS lanes) from residuals with the per-realization correlation block
+        kept in VMEM (see :mod:`fakepta_tpu.ops.pallas_kernels`).
+
+        The OS lanes ride the SAME kernel as ``n_os`` extra weight slots
+        between the angular bins and the auto trace — the kernel contract is
+        a plain weighted reduction per slot, so detection statistics are free
+        once the correlation block is in VMEM. Under ``with_null`` the paired
+        noise-only stream runs a second kernel invocation over its own
+        residual blocks with the OS-only weight stack (plus a zero auto slot
+        to keep the (n+1, P, P) weights contract).
+        """
         from ..ops.pallas_kernels import binned_correlation, pick_rt
 
-        # combined statistic weights, single-sourced from the XLA path's
-        # normalization: slot n < nbins is onehot/(pair counts * bin count);
-        # slot nbins is the normalized auto trace. (nbins+1, P, P)
-        self._stat_weights = jnp.concatenate(
-            [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]], axis=0)
+        if not hasattr(self, "_stat_weights"):
+            # combined statistic weights, single-sourced from the XLA path's
+            # normalization: slot n < nbins is onehot/(pair counts * bin
+            # count); slot nbins is the normalized auto trace. (nbins+1, P, P)
+            self._stat_weights = jnp.concatenate(
+                [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]],
+                axis=0)
 
-        mesh = self.mesh
         has_toa = self._has_toa   # size-1 only: toa_shards > 1 raises at init
-        batch_specs = _batch_specs(has_toa)
-        inc = self._include
         nbins = self.nbins
+        nb_eff = nbins + n_os
         interpret = self._pallas_interpret
 
-        has_det = self._has_det
-        roe_scales = self._roe_scales
-        n_roe = len(self._roe_states)
-        samp_static = self._samp_static
-        cgw_static = self._cgw_static
-        cgw_ranges = self._cgw_ranges
-
-        white_static = self._white_static
-        white_nb = self._white_nb
-
-        def sharded(keys, batch, chol, gwb_w, weights, det, samp_params,
-                    white_params, white_toaerr2, white_bid,
-                    cgw_trel, cgw_pdist, *roe):
-            res = _simulate_block(keys, batch, chol, gwb_w, self._gwb_idx,
-                                  self._gwb_freqf, *inc,
-                                  samp_static=samp_static,
-                                  samp_params=samp_params,
-                                  bases_bf16=self._bases_bf16,
-                                  white_static=white_static,
-                                  white_params=white_params,
-                                  white_toaerr2=white_toaerr2,
-                                  white_bid=white_bid, white_nb=white_nb)
-            if has_det:
-                res = res + det[None]
-            for j in range(n_roe):
-                term = _sampled_roemer(keys, roe[j], roe_scales[j], batch.pos,
-                                       tag=j)
-                res = res + jnp.where(batch.mask, term, 0.0)
-            for j, stat in enumerate(cgw_static):
-                term = _sampled_cgw(keys, cgw_trel[j], batch.pos, cgw_pdist,
-                                    cgw_ranges[j], stat, tag=j)
-                res = res + jnp.where(batch.mask, term, 0.0)
+        def sharded(keys, batch, chol, gwb_w, weights, w_null, det,
+                    samp_params, white_params, white_toaerr2, white_bid,
+                    cgw_trel, cgw_pdist, cgw_bulks, *roe):
+            res = self._residuals(keys, batch, chol, gwb_w, det, samp_params,
+                                  white_params, white_toaerr2, white_bid,
+                                  cgw_trel, cgw_pdist, cgw_bulks, roe,
+                                  toa_shards=1)
             with obs.span("all_gather"):
                 res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
             r_local = res.shape[0]
             # realization tile capped by the kernel's VMEM working set
             rt = pick_rt(r_local, res.shape[1], res_full.shape[1],
-                         res.shape[2], nbins,
+                         res.shape[2], nb_eff,
                          mxu_binning=self._pallas_mxu_binning)
             with obs.span("correlate"):
                 curves_p, autos_p = binned_correlation(
-                    res, res_full, weights, nbins=nbins, rt=rt,
+                    res, res_full, weights, nbins=nb_eff, rt=rt,
                     interpret=interpret, precision=self._pallas_precision,
                     mxu_binning=self._pallas_mxu_binning)
                 # the only other collective: reduce partial bin sums over
                 # psr shards
-                out = (lax.psum(curves_p, PSR_AXIS),
-                       lax.psum(autos_p, PSR_AXIS))
-            return out
+                outs = [lax.psum(curves_p, PSR_AXIS),
+                        lax.psum(autos_p, PSR_AXIS)]
+            if with_null:
+                with obs.span("null"):
+                    nkeys = jax.vmap(
+                        lambda k: jax.random.fold_in(k, _NULL_TAG))(keys)
+                    res0 = self._residuals(nkeys, batch, chol, gwb_w, det,
+                                           samp_params, white_params,
+                                           white_toaerr2, white_bid,
+                                           cgw_trel, cgw_pdist, cgw_bulks,
+                                           roe, toa_shards=1, null=True)
+                    res0_full = lax.all_gather(res0, PSR_AXIS, axis=1,
+                                               tiled=True)
+                    rt0 = pick_rt(r_local, res0.shape[1],
+                                  res0_full.shape[1], res0.shape[2], n_os,
+                                  mxu_binning=self._pallas_mxu_binning)
+                    null_p, _ = binned_correlation(
+                        res0, res0_full, w_null, nbins=n_os, rt=rt0,
+                        interpret=interpret,
+                        precision=self._pallas_precision,
+                        mxu_binning=self._pallas_mxu_binning)
+                    outs.append(lax.psum(null_p, PSR_AXIS))
+            return tuple(outs)
 
-        pt_spec = P(PSR_AXIS, TOA_AXIS) if has_toa else P(PSR_AXIS)
-        white_spec = pt_spec if white_static is not None else P(PSR_AXIS)
         shmapped = shard_map(
-            sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), batch_specs,
-                      tuple(P() for _ in self._chol),
-                      tuple(P() for _ in self._gwb_w),
-                      P(None, PSR_AXIS, None), pt_spec,
-                      tuple(P() for _ in self._samp_params),
-                      P(), white_spec, white_spec,
-                      tuple(pt_spec for _ in self._cgw_trel), P(PSR_AXIS),
-                      *(tuple(_orbit_state_specs(has_toa)
-                              for _ in range(n_roe)))),
-            out_specs=(P(REAL_AXIS), P(REAL_AXIS)),
+            sharded, mesh=self.mesh,
+            in_specs=(P(REAL_AXIS), *self._step_in_specs(has_toa)[:3],
+                      P(None, PSR_AXIS, None), P(None, PSR_AXIS, None),
+                      *self._step_in_specs(has_toa)[3:]),
+            out_specs=tuple(P(REAL_AXIS)
+                            for _ in range(2 + int(with_null))),
             # pallas_call does not annotate vma on its outputs; the psum above
             # makes the outputs replicated over 'psr' by construction
             check_vma=False,
         )
 
         @partial(jax.jit, static_argnums=(2,))
-        def step(base_key, offset, nreal):
+        def step(base_key, offset, nreal, w_os, cgw_bulks):
             # trace-time only: the retrace guard (see _obs_note_trace)
-            self._obs_note_trace(("step_fused", nreal))
+            self._obs_note_trace(("step_fused", nreal, n_os, with_null))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
-            curves, autos = shmapped(keys, self.batch, self._chol, self._gwb_w,
-                                     self._stat_weights, self._det,
-                                     self._samp_params, self._white_params,
-                                     self._white_toaerr2, self._white_bid,
-                                     self._cgw_trel,
-                                     self._pdist, *self._roe_states)
+            if n_os:
+                weights = jnp.concatenate(
+                    [self._stat_weights[:nbins], w_os,
+                     self._stat_weights[nbins:]], axis=0)
+                w_null = jnp.concatenate(
+                    [w_os, jnp.zeros_like(w_os[:1])], axis=0)
+            else:
+                weights, w_null = self._stat_weights, w_os
+            out = shmapped(keys, self.batch, self._chol, self._gwb_w,
+                           weights, w_null, self._det, self._samp_params,
+                           self._white_params, self._white_toaerr2,
+                           self._white_bid, self._cgw_trel, self._pdist,
+                           cgw_bulks, *self._roe_states)
+            curves_ext, autos = out[0], out[1]
+            extras = []
+            if n_os:
+                extras.append(curves_ext[:, nbins:])
+            if with_null:
+                extras.append(out[2])
             # same packed single-transfer contract as the XLA step
-            return pack_stats(curves, autos)
+            return pack_stats(curves_ext[:, :nbins], autos, *extras)
 
         return step
 
+    def _get_step_fused_os(self, n_os, with_null):
+        key = (int(n_os), bool(with_null))
+        step = self._step_fused_os_cache.get(key)
+        if step is None:
+            step = (self._step_fused if key == (0, False) else
+                    self._build_step_fused_os(*key))
+            self._step_fused_os_cache[key] = step
+        return step
+
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
-            checkpoint=None, progress=None):
+            checkpoint=None, progress=None, os=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
         mean autocorrelations ``(nreal,)``, bin centers and (optionally) the raw
         pair-correlation matrices.
+
+        ``os``: enable the on-device optimal-statistic lane — an ORF name
+        (``'hd'``/``'monopole'``/``'dipole'``), a sequence of them, or a
+        :class:`fakepta_tpu.detect.OSSpec` (noise weighting, per-pulsar
+        sigma2 override, paired null-stream calibration). Each realization's
+        noise-weighted amp2 is computed INSIDE the jitted chunk program from
+        the raw pair sums and packed beside curves/autos, so detection
+        studies no longer need ``keep_corr=True`` or any (R, P, P) fetch.
+        Results land under ``out["os"]`` (schema ``fakepta_tpu.detect/1``):
+        per ORF ``amp2`` (nreal,), ``sigma`` (empirical from the paired null
+        stream when ``OSSpec(null=True)``, else the analytic white-noise
+        value), ``snr``, and — under null calibration — ``null_amp2``, null
+        quantiles and per-realization ``p_value``. Legal alongside the fused
+        Pallas path (the OS lanes ride the kernel's weight slots) and under
+        any (real, psr, toa) sharding; see docs/DETECTION.md.
 
         ``checkpoint``: a path — after every chunk the run appends that chunk's
         outputs to a sibling ``<path>.c<k>.npz`` file and updates a small
@@ -1714,6 +2024,20 @@ class EnsembleSimulator:
         nb = self.nbins
         done = 0
 
+        # the OS lane: host-f64 operator precompute (detect.operators), one
+        # (P, P) weight matrix per ORF stacked into the step's w_os input
+        os_spec, os_ops, w_os, n_os, n_extra = None, None, None, 0, 0
+        if os is not None:
+            from ..detect import operators as detect_ops
+            os_spec = detect_ops.as_spec(os)
+            os_ops = detect_ops.build_operators(
+                os_spec, self._pos64, np.asarray(self.batch.mask),
+                np.asarray(self.batch.sigma2), pair_counts=self.pair_counts)
+            w_os = jnp.asarray(np.stack([op.weights for op in os_ops]),
+                               self.batch.t_own.dtype)
+            n_os = len(os_ops)
+            n_extra = n_os * (2 if os_spec.null else 1)
+
         ckpt = None
         if checkpoint is not None:
             from ..utils.io import EnsembleCheckpoint
@@ -1721,10 +2045,13 @@ class EnsembleSimulator:
                 raise TypeError("checkpointing requires an integer seed (the "
                                 "checkpoint stores it to validate a resume)")
             ckpt = EnsembleCheckpoint(checkpoint)
-            state = ckpt.load(seed, nreal, chunk, keep_corr=keep_corr)
+            state = ckpt.load(seed, nreal, chunk, keep_corr=keep_corr,
+                              n_extra=n_extra)
             if state is not None:
                 done = int(state["done"])
-                packed_out.append(pack_stats(state["curves"], state["autos"]))
+                extra = ([state["extra"]] if n_extra else [])
+                packed_out.append(pack_stats(state["curves"], state["autos"],
+                                             *extra))
                 if keep_corr:
                     if "corr" not in state:
                         raise ValueError("checkpoint was written without "
@@ -1746,14 +2073,28 @@ class EnsembleSimulator:
                 # overshoots and is truncated below): the steps are jitted
                 # with a static realization count, so a smaller tail chunk
                 # would recompile the SPMD program
-                if fused:
-                    packed = self._step_fused(base, done, chunk)
-                else:
-                    if keep_corr:
-                        packed, corr = self._step(base, done, chunk, True)
+                bulks = self._host_cgw_bulks(base, done, chunk)
+                if os_ops is not None:
+                    if fused:
+                        packed = self._get_step_fused_os(n_os, os_spec.null)(
+                            base, done, chunk, w_os, bulks)
+                    elif keep_corr:
+                        packed, corr = self._get_step_os(os_spec.null)(
+                            base, done, chunk, w_os, bulks, True)
                         corr_out.append(to_host(corr))
                     else:
-                        packed = self._step(base, done, chunk, False)
+                        packed = self._get_step_os(os_spec.null)(
+                            base, done, chunk, w_os, bulks, False)
+                elif fused:
+                    packed = self._step_fused(base, done, chunk,
+                                              self._w_os_empty, bulks)
+                else:
+                    if keep_corr:
+                        packed, corr = self._step(base, done, chunk, bulks,
+                                                  True)
+                        corr_out.append(to_host(corr))
+                    else:
+                        packed = self._step(base, done, chunk, bulks, False)
                 if sync_each:
                     packed = to_host(packed)
                 elif hasattr(packed, "copy_to_host_async"):
@@ -1768,7 +2109,9 @@ class EnsembleSimulator:
                     # shared storage
                     c_chunk, a_chunk = unpack_stats(packed_out[-1], nb)
                     ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
-                              corr_out[-1] if keep_corr else None)
+                              corr_out[-1] if keep_corr else None,
+                              extra=(packed_out[-1][:, nb + 1:]
+                                     if n_extra else None))
                 if progress is not None:
                     if not sync_each:
                         jax.block_until_ready(packed)  # completion, not dispatch
@@ -1787,6 +2130,13 @@ class EnsembleSimulator:
             "autos": autos_h,
             "bin_centers": np.asarray(self.bin_centers),
         }
+        if os_ops is not None:
+            from ..detect import operators as detect_ops
+            os_vals = packed_h[:, nb + 1:nb + 1 + n_os]
+            null_vals = (packed_h[:, nb + 1 + n_os:nb + 1 + 2 * n_os]
+                         if os_spec.null else None)
+            out["os"] = detect_ops.assemble(os_spec, os_ops, os_vals,
+                                            null_vals)
         if keep_corr:
             out["corr"] = np.concatenate(corr_out)[:nreal]
         if ckpt is not None and jax.process_index() == 0:
@@ -1807,12 +2157,18 @@ class EnsembleSimulator:
         }
         if isinstance(seed, (int, np.integer)):
             meta["seed"] = int(seed)
+        if os_spec is not None:
+            meta["os"] = {"orfs": list(os_spec.orfs),
+                          "weighting": os_spec.weighting,
+                          "null": bool(os_spec.null)}
         collector.count("obs.chunks", len(chunk_records))
         report = RunReport.from_collector(
             collector, meta,
             retraces=self._obs_retraces - retraces_before,
             total_s=total_s,
-            cost=self._obs_capture_cost(base, chunk, fused),
+            cost=self._obs_capture_cost(base, chunk, fused, w_os=w_os,
+                                        with_null=bool(os_spec.null)
+                                        if os_spec else False),
             memory=self._obs_memory_stats())
         report.chunks = chunk_records
         report.spans = sorted(self._obs_spans)

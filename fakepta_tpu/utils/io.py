@@ -71,12 +71,15 @@ class EnsembleCheckpoint:
     def _chunk_path(self, k: int) -> Path:
         return self.path.with_name(self.path.name + f".c{k:06d}.npz")
 
-    def load(self, seed, nreal: int, chunk: int,
-             keep_corr: bool = True) -> Optional[dict]:
+    def load(self, seed, nreal: int, chunk: int, keep_corr: bool = True,
+             n_extra: int = 0) -> Optional[dict]:
         """Return accumulated saved state if it matches this run's configuration.
 
         ``keep_corr=False`` skips reading the (large) per-chunk correlation
         tensors that a ``keep_corr=False`` resume would discard anyway.
+        ``n_extra`` is the expected extra packed-lane count (the OS lanes of
+        a ``run(os=...)``); a mismatch means the checkpoint was written by a
+        run with a different detection configuration and must not resume.
         """
         if not self.path.exists():
             return None
@@ -89,6 +92,13 @@ class EnsembleCheckpoint:
                 f"(seed/nreal/chunk = {int(manifest['seed'])}/"
                 f"{int(manifest['nreal'])}/{int(manifest['chunk'])}, requested "
                 f"{seed}/{nreal}/{chunk}); delete it or use a different path")
+        saved_extra = int(manifest.get("n_extra", 0))
+        if saved_extra != int(n_extra):
+            raise ValueError(
+                f"checkpoint {self.path} carries {saved_extra} extra "
+                f"statistic lane(s) but this run expects {n_extra} (a "
+                f"different os= configuration); delete it or use a "
+                f"different path")
         done = int(manifest["done"])
         if done and not self._chunk_path(0).exists():
             raise ValueError(
@@ -107,15 +117,23 @@ class EnsembleCheckpoint:
         }
         if parts and all("corr" in p for p in parts):
             state["corr"] = np.concatenate([p["corr"] for p in parts])
+        if parts and all("extra" in p for p in parts):
+            state["extra"] = np.concatenate([p["extra"] for p in parts])
         return state
 
     def save(self, seed, nreal: int, chunk: int, done: int, curves, autos,
-             corr=None):
-        """Record one completed chunk (its arrays only, not the accumulation)."""
+             corr=None, extra=None):
+        """Record one completed chunk (its arrays only, not the accumulation).
+
+        ``extra`` holds any additional packed statistic lanes (the OS lanes
+        of a ``run(os=...)``) so a resumed detection run keeps them too.
+        """
         self.path.parent.mkdir(parents=True, exist_ok=True)
         payload = dict(curves=curves, autos=autos)
         if corr is not None:
             payload["corr"] = corr
+        if extra is not None:
+            payload["extra"] = extra
         cpath = self._chunk_path(done // chunk - 1)
         tmp = cpath.with_suffix(".tmp.npz")
         np.savez(tmp, **payload)
@@ -123,7 +141,9 @@ class EnsembleCheckpoint:
         # manifest last: a crash between the two writes leaves an unreferenced
         # chunk file that the next save simply overwrites
         manifest = dict(seed=np.int64(seed), nreal=np.int64(nreal),
-                        chunk=np.int64(chunk), done=np.int64(done))
+                        chunk=np.int64(chunk), done=np.int64(done),
+                        n_extra=np.int64(0 if extra is None
+                                         else np.shape(extra)[1]))
         tmp = self.path.with_suffix(".tmp.npz")
         np.savez(tmp, **manifest)
         tmp.replace(self.path)

@@ -1,0 +1,296 @@
+"""fakepta_tpu.detect — the on-device optimal-statistic (OS) lane.
+
+Pins the tentpole contracts: device-OS parity with the host
+``correlated_noises.optimal_statistic`` for every ORF with and without noise
+weighting, mesh invariance across (real, psr, toa) shardings, null-stream
+calibration determinism, fused-Pallas OS acceptance (interpret mode), the
+no-(R,P,P)-fetch packing, checkpoint round-trip of the OS lanes, and the
+DetectionRun facade + CLI artifact that ``obs compare`` diffs.
+"""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.correlated_noises import optimal_statistic
+from fakepta_tpu.detect import (DetectionRun, OSSpec, as_spec,
+                                build_operators, pulsar_noise_levels)
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def batch():
+    return PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                 toaerr=1e-7, n_red=8, n_dm=8, seed=1)
+
+
+def _gwb_cfg(batch, ncomp=8, log10_A=-13.5):
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=log10_A, gamma=13 / 3))
+    return GWBConfig(psd=psd, orf="hd")
+
+
+def _host_inputs(batch):
+    pos = np.asarray(batch.pos)
+    mask = np.asarray(batch.mask, dtype=np.float64)
+    counts = mask @ mask.T
+    sigma2 = pulsar_noise_levels(np.asarray(batch.sigma2), mask)
+    return pos, counts, sigma2
+
+
+def test_os_lane_matches_host_optimal_statistic_every_orf(batch):
+    """Device amp2 must equal the host optimal_statistic on the same run's
+    correlation tensors, for every ORF template, with and without noise
+    weighting — the raw-sum weight algebra cancels counts exactly, so the
+    only difference is the f32 device contraction (documented tolerance)."""
+    mesh = make_mesh(jax.devices()[:1])
+    sim = EnsembleSimulator(batch, gwb=_gwb_cfg(batch), mesh=mesh)
+    pos, counts, sigma2 = _host_inputs(batch)
+    for weighting in ("noise", "none"):
+        spec = OSSpec(orf=("hd", "monopole", "dipole"), weighting=weighting)
+        out = sim.run(16, seed=5, chunk=8, keep_corr=True, os=spec)
+        for orf in spec.orfs:
+            kw = (dict(sigma2=sigma2, counts=counts) if weighting == "noise"
+                  else dict(sigma2=np.ones(batch.npsr)))
+            with np.errstate(all="ignore"):
+                import warnings
+                with warnings.catch_warnings():
+                    warnings.simplefilter("ignore")
+                    host = optimal_statistic(out["corr"], pos, orf=orf, **kw)
+            dev = out["os"]["stats"][orf]
+            scale = np.abs(host["amp2"]).max()
+            np.testing.assert_allclose(dev["amp2"], host["amp2"],
+                                       atol=2e-4 * scale,
+                                       err_msg=f"{orf}/{weighting}")
+            np.testing.assert_allclose(dev["sigma_analytic"], host["sigma"],
+                                       rtol=1e-12)
+            np.testing.assert_allclose(dev["snr"],
+                                       dev["amp2"] / dev["sigma"])
+
+
+def test_os_rejects_curn_like_host(batch):
+    """'curn' is diagonal: both paths must refuse with the same diagnosis."""
+    mesh = make_mesh(jax.devices()[:1])
+    sim = EnsembleSimulator(batch, gwb=_gwb_cfg(batch), mesh=mesh)
+    with pytest.raises(ValueError, match="undefined"):
+        sim.run(8, seed=0, chunk=8, os="curn")
+    corr = np.eye(batch.npsr)[None]
+    with pytest.raises(ValueError, match="undefined"):
+        optimal_statistic(corr, np.asarray(batch.pos), orf="curn",
+                          sigma2=np.ones(batch.npsr))
+
+
+def test_os_no_corr_fetch_and_validation(batch):
+    """os runs keep the packed single-fetch contract: no 'corr' key unless
+    keep_corr is asked; bad specs fail loudly."""
+    mesh = make_mesh(jax.devices()[:1])
+    sim = EnsembleSimulator(batch, gwb=_gwb_cfg(batch), mesh=mesh)
+    out = sim.run(8, seed=1, chunk=8, os="hd")
+    assert "corr" not in out
+    assert out["os"]["stats"]["hd"]["amp2"].shape == (8,)
+    assert out["os"]["schema"] == "fakepta_tpu.detect/1"
+    assert out["curves"].shape == (8, sim.nbins)
+    with pytest.raises(ValueError, match="unknown ORF"):
+        sim.run(8, seed=1, chunk=8, os="bogus")
+    with pytest.raises(ValueError, match="weighting"):
+        sim.run(8, seed=1, chunk=8, os=OSSpec(weighting="fancy"))
+    with pytest.raises(TypeError, match="OSSpec"):
+        sim.run(8, seed=1, chunk=8, os=123)
+    assert as_spec("hd").orfs == ("hd",)
+    assert as_spec(["hd", "dipole"]).orfs == ("hd", "dipole")
+
+
+def test_os_mesh_invariance(batch):
+    """OS lanes under (real, psr, toa) shardings reproduce the single-device
+    run: the contraction closes with the declared psums only."""
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces an 8-device CPU mesh"
+    cfg = _gwb_cfg(batch)
+    spec = OSSpec(orf=("hd", "monopole"), null=True)
+    ref = EnsembleSimulator(batch, gwb=cfg, mesh=make_mesh(devs[:1])).run(
+        16, seed=3, chunk=8, os=spec)
+    shardings = [dict(psr_shards=2), dict(psr_shards=4),
+                 dict(psr_shards=2, toa_shards=2), dict(toa_shards=4)]
+    for shard_kw in shardings:
+        got = EnsembleSimulator(batch, gwb=cfg,
+                                mesh=make_mesh(devs, **shard_kw)).run(
+            16, seed=3, chunk=8, os=spec)
+        for orf in spec.orfs:
+            for k in ("amp2", "null_amp2"):
+                ref_v = ref["os"]["stats"][orf][k]
+                got_v = got["os"]["stats"][orf][k]
+                np.testing.assert_allclose(
+                    got_v, ref_v, rtol=1e-5,
+                    atol=1e-4 * np.abs(ref_v).max(),
+                    err_msg=f"{orf}/{k}/{shard_kw}")
+
+
+def test_os_null_calibration_deterministic(batch):
+    """The paired noise-only stream: deterministic per seed, independent of
+    the signal stream, and its statistics calibrate the p-values."""
+    mesh = make_mesh(jax.devices()[:1])
+    cfg = _gwb_cfg(batch, log10_A=-13.0)
+    sim = EnsembleSimulator(batch, gwb=cfg, mesh=mesh)
+    spec = OSSpec(orf="hd", null=True)
+    a = sim.run(32, seed=11, chunk=16, os=spec)
+    b = sim.run(32, seed=11, chunk=16, os=spec)
+    sa, sb = a["os"]["stats"]["hd"], b["os"]["stats"]["hd"]
+    np.testing.assert_array_equal(sa["null_amp2"], sb["null_amp2"])
+    np.testing.assert_array_equal(sa["amp2"], sb["amp2"])
+    # the null stream must NOT carry the injected signal: its mean amp2 sits
+    # near zero while the injected stream's is positive and far above
+    assert sa["amp2"].mean() > 5.0 * abs(sa["null_amp2"].mean())
+    assert np.all((sa["p_value"] > 0.0) & (sa["p_value"] <= 1.0))
+    # strong injection: most realizations beat the whole null sample
+    assert np.median(sa["p_value"]) <= 1.0 / 33 + 1e-12
+    qs = sa["null_quantiles"]
+    assert qs["q50"] <= qs["q90"] <= qs["q95"] <= qs["q99"]
+    assert sa["sigma"] == sa["sigma_empirical"] > 0.0
+
+
+def test_os_fused_pallas_matches_xla(batch):
+    """The fused Pallas statistic path (interpret mode on CPU) carries the
+    OS lanes as extra kernel weight slots — values must match the XLA path
+    at full-f32 kernel precision, null lanes included."""
+    mesh = make_mesh(jax.devices()[:1])
+    cfg = _gwb_cfg(batch)
+    spec = OSSpec(orf=("hd", "monopole"), null=True)
+    ref = EnsembleSimulator(batch, gwb=cfg, mesh=mesh).run(
+        8, seed=3, chunk=8, os=spec)
+    got = EnsembleSimulator(batch, gwb=cfg, mesh=mesh, use_pallas=True,
+                            pallas_precision="f32").run(
+        8, seed=3, chunk=8, os=spec)
+    assert "corr" not in got
+    for orf in spec.orfs:
+        for k in ("amp2", "null_amp2"):
+            ref_v = ref["os"]["stats"][orf][k]
+            np.testing.assert_allclose(
+                got["os"]["stats"][orf][k], ref_v,
+                atol=1e-4 * np.abs(ref_v).max(), err_msg=f"{orf}/{k}")
+    # curves/autos keep their fused-path contract beside the OS lanes
+    scale = np.abs(ref["curves"]).max()
+    np.testing.assert_allclose(got["curves"], ref["curves"],
+                               atol=1e-5 * scale)
+    np.testing.assert_allclose(got["autos"], ref["autos"], rtol=1e-5)
+
+
+def test_os_checkpoint_resume_keeps_lanes(batch, tmp_path):
+    """A checkpointed os run resumes with its OS lanes intact and equals the
+    uninterrupted run; a mismatched os config refuses to resume."""
+    mesh = make_mesh(jax.devices()[:1])
+    cfg = _gwb_cfg(batch)
+    spec = OSSpec(orf="hd", null=True)
+    full = EnsembleSimulator(batch, gwb=cfg, mesh=mesh).run(
+        16, seed=9, chunk=8, os=spec)
+
+    calls = {"n": 0}
+    sim = EnsembleSimulator(batch, gwb=cfg, mesh=mesh)
+    ckpt = tmp_path / "ck.npz"
+
+    def boom(done, nreal):
+        calls["n"] += 1
+        if done >= 8:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        sim.run(16, seed=9, chunk=8, os=spec, checkpoint=ckpt, progress=boom)
+    with pytest.raises(ValueError, match="extra"):
+        sim.run(16, seed=9, chunk=8, checkpoint=ckpt)   # os config mismatch
+    out = sim.run(16, seed=9, chunk=8, os=spec, checkpoint=ckpt)
+    for k in ("amp2", "null_amp2"):
+        np.testing.assert_allclose(out["os"]["stats"]["hd"][k],
+                                   full["os"]["stats"]["hd"][k], rtol=1e-6)
+    np.testing.assert_allclose(out["curves"], full["curves"], rtol=1e-6)
+
+
+def test_operator_weights_shared_with_host(batch):
+    """build_operators' raw-sum weights reproduce the host statistic exactly
+    at f64 (pair_weighting is the single source): contracting rho*counts
+    against the weight matrix IS the host amp2."""
+    pos, counts, sigma2 = _host_inputs(batch)
+    rng = np.random.default_rng(3)
+    sym = rng.standard_normal((4, batch.npsr, batch.npsr))
+    corr = (sym + np.swapaxes(sym, 1, 2)) / 2.0
+    ops = build_operators(OSSpec(orf=("hd",)), pos, np.asarray(batch.mask),
+                          np.asarray(batch.sigma2))
+    host = optimal_statistic(corr, pos, orf="hd", sigma2=sigma2,
+                             counts=counts)
+    raw = corr * counts[None]
+    np.testing.assert_allclose(ops[0].apply(raw), host["amp2"], rtol=1e-12)
+    np.testing.assert_allclose(ops[0].sigma, host["sigma"], rtol=1e-12)
+
+
+def test_detection_run_facade_and_artifact(batch, tmp_path):
+    """DetectionRun: one call -> null-calibrated summary; the saved artifact
+    loads as a RunReport whose summary carries the detection metrics, and
+    `obs compare` diffs two artifacts (exit 0, no false regressions on
+    identical runs)."""
+    from fakepta_tpu.obs import RunReport
+
+    study = DetectionRun(batch, gwb=_gwb_cfg(batch, log10_A=-13.0),
+                         mesh=make_mesh(jax.devices()[:1]))
+    assert study.spec.null, "null calibration is forced on"
+    out = study.run(32, seed=2, chunk=16)
+    s = out["summary"]
+    assert s["os_hd_significance_sigma"] > 1.0
+    assert 0.0 <= s["os_hd_detection_rate"] <= 1.0
+    p_a = tmp_path / "a.jsonl"
+    p_b = tmp_path / "b.jsonl"
+    study.save(p_a)
+    study.save(p_b)
+    rep = RunReport.load(p_a)
+    assert rep.summary()["os_hd_significance_sigma"] == \
+        s["os_hd_significance_sigma"]
+    assert rep.meta["detect_schema"] == "fakepta_tpu.detect/1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.obs", "compare", str(p_a),
+         str(p_b), "--fail-on-regression"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "os_hd_significance_sigma" in proc.stdout
+
+
+@pytest.mark.slow
+def test_detect_cli_smoke(tmp_path):
+    """`python -m fakepta_tpu.detect run` prints one JSON summary line and
+    writes the artifact."""
+    out = tmp_path / "detect.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.detect", "run", "--platform",
+         "cpu", "--npsr", "10", "--ntoa", "64", "--nreal", "64", "--chunk",
+         "32", "--log10-A", "-13.0", "--out", str(out)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=520)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["os_hd_significance_sigma"] > 1.0
+    assert out.exists()
+
+
+def test_os_weighting_none_and_sigma_override(batch):
+    """weighting='none' drops the noise weighting; an OSSpec.sigma2 override
+    redirects it (both against the host path on the same tensors)."""
+    mesh = make_mesh(jax.devices()[:1])
+    sim = EnsembleSimulator(batch, gwb=_gwb_cfg(batch), mesh=mesh)
+    pos, counts, _ = _host_inputs(batch)
+    override = np.linspace(1.0, 2.0, batch.npsr) * 1e-14
+    out = sim.run(8, seed=4, chunk=8, keep_corr=True,
+                  os=OSSpec(orf="hd", sigma2=override))
+    host = optimal_statistic(out["corr"], pos, sigma2=override, counts=counts)
+    dev = out["os"]["stats"]["hd"]
+    np.testing.assert_allclose(dev["amp2"], host["amp2"],
+                               atol=2e-4 * np.abs(host["amp2"]).max())
+    np.testing.assert_allclose(dev["sigma_analytic"], host["sigma"],
+                               rtol=1e-12)
+    # a dataclass spec survives replace() round-trips (facade uses it)
+    assert dataclasses.replace(OSSpec(), null=True).null

