@@ -26,6 +26,17 @@ RunReport each ``sim.run()`` attaches):
   statistic packed beside curves/autos — the configuration detection studies
   actually use, no keep_corr and no (R, P, P) fetch), sourced from that
   run's RunReport; ``obs compare --fail-on-regression`` gates them;
+- ``lnlike_evals_per_s_per_chip`` / ``lnlike_bytes_per_chunk``: the
+  inference-lane figures from a third measured run with a K=16 CURN
+  (log10_A, gamma) grid (``lnlike=InferSpec(...)``, ``fakepta_tpu.infer``:
+  the GP-marginalized Woodbury lnL per realization per grid point, inside
+  the chunk program). ``lnlike_evals_per_s_per_chip`` is the steady
+  realization rate times K — grid lnL evaluations per second per chip —
+  and ``lnlike_bytes_per_chunk`` that chunk program's XLA cost-analysis
+  bytes; both from the run's RunReport, gated by ``obs compare
+  --fail-on-regression`` like the OS rows. The lnlike run uses a reduced
+  chunk (the per-realization ``T^T N^-1 r`` moments are O(2M) per pulsar,
+  heavier than the packed curves);
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
 """
 
@@ -117,6 +128,30 @@ def main():
         round(os_rep.steady_real_per_s_per_chip(), 2))
     if os_sum.get("os_bytes_per_chunk"):
         row["os_bytes_per_chunk"] = os_sum["os_bytes_per_chunk"]
+
+    # the inference lane (fakepta_tpu.infer): flagship + K=16 CURN grid of
+    # GP-marginalized Woodbury lnL per realization, inside the chunk
+    # program. Reduced chunk: the lane's per-realization moments are O(2M)
+    # per pulsar (see the module docstring schema).
+    from fakepta_tpu.infer import (ComponentSpec, FreeParam, InferSpec,
+                                   LikelihoodSpec, theta_grid)
+    lnl_model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=30, free=(
+            FreeParam("log10_A", np.log10(2e-15) + np.array([-0.5, 0.5])),
+            FreeParam("gamma", (3.0, 6.0)))),
+    ))
+    lnl_spec = InferSpec(model=lnl_model, theta=theta_grid(lnl_model, 4))
+    chunk_lnl = max(n_devices, chunk // 5)
+    nreal_lnl = 2 * chunk_lnl
+    sim.run(chunk_lnl, seed=97, chunk=chunk_lnl, lnlike=lnl_spec)  # warm up
+    out_lnl = sim.run(nreal_lnl, seed=1, chunk=chunk_lnl, lnlike=lnl_spec)
+    lnl_sum = out_lnl["report"].summary()
+    row["lnlike_evals_per_s_per_chip"] = lnl_sum.get(
+        "lnlike_evals_per_s_per_chip", 0.0)
+    if lnl_sum.get("lnlike_bytes_per_chunk"):
+        row["lnlike_bytes_per_chunk"] = lnl_sum["lnlike_bytes_per_chunk"]
     if fallback:
         row["fallback"] = "accelerator backend unavailable; CPU stand-in"
     print(json.dumps(row))

@@ -13,7 +13,11 @@ see the bench.py docstring for the schema), sourced from the RunReport each
 ``sim.run()`` attaches. The flagship row (config 5) additionally carries the
 detection-lane figures ``os_real_per_s_per_chip`` / ``os_bytes_per_chunk``
 from a second measured run with ``os='hd'`` (the device optimal statistic,
-``fakepta_tpu.detect``).
+``fakepta_tpu.detect``) and the inference-lane figures
+``lnlike_evals_per_s_per_chip`` / ``lnlike_bytes_per_chunk`` from a third
+measured run with a K=16 CURN hyperparameter grid (the GP-marginalized
+device likelihood, ``fakepta_tpu.infer`` — see the bench.py docstring for
+the full schema).
 
     python benchmarks/suite.py                 # all configs, default sizes
     python benchmarks/suite.py --configs 1 2   # subset
@@ -405,6 +409,31 @@ def config5():
         row["os_real_per_s_per_chip"] = os_sum["os_real_per_s_per_chip"]
     if os_sum.get("os_bytes_per_chunk"):
         row["os_bytes_per_chunk"] = os_sum["os_bytes_per_chunk"]
+
+    # the inference lane (fakepta_tpu.infer): flagship + K=16 CURN
+    # (log10_A, gamma) grid of GP-marginalized Woodbury lnL per realization
+    # inside the chunk program — grid evaluations/s/chip and chunk bytes
+    # from that run's RunReport (the bench.py line schema; reduced chunk
+    # because the lane's per-realization moments are O(2M) per pulsar)
+    from fakepta_tpu.infer import (ComponentSpec, FreeParam, InferSpec,
+                                   LikelihoodSpec, theta_grid)
+    lnl_model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=30, free=(
+            FreeParam("log10_A", np.log10(2e-15) + np.array([-0.5, 0.5])),
+            FreeParam("gamma", (3.0, 6.0)))),
+    ))
+    lnl_spec = InferSpec(model=lnl_model, theta=theta_grid(lnl_model, 4))
+    chunk_lnl = max(n_dev, chunk // 5)
+    sim.run(chunk_lnl, seed=97, chunk=chunk_lnl, lnlike=lnl_spec)  # warm up
+    lnl_sum = sim.run(2 * chunk_lnl, seed=1, chunk=chunk_lnl,
+                      lnlike=lnl_spec)["report"].summary()
+    if lnl_sum.get("lnlike_evals_per_s_per_chip"):
+        row["lnlike_evals_per_s_per_chip"] = \
+            lnl_sum["lnlike_evals_per_s_per_chip"]
+    if lnl_sum.get("lnlike_bytes_per_chunk"):
+        row["lnlike_bytes_per_chunk"] = lnl_sum["lnlike_bytes_per_chunk"]
 
     # Peak device memory and an MFU estimate, both from the obs RunReport
     # (allocator stats where the plugin provides them, else XLA's static

@@ -1,0 +1,182 @@
+"""CURN amplitude-slope likelihood grid over Monte-Carlo ensembles.
+
+The analysis the engine's simulations exist to feed: for every realization,
+evaluate the GP-marginalized PTA log-likelihood on a (log10_A, gamma) grid
+of the common-process hyperparameters and ask how often the
+maximum-likelihood grid point recovers the injected truth. The device path
+runs the whole grid INSIDE the jitted chunk program
+(``EnsembleSimulator.run(lnlike=...)``, ``fakepta_tpu.infer``): Woodbury
+rank-2N solves, no residual fetch, no host sampler.
+
+``--legacy-host`` is the A/B flag: it runs the reference's own analysis
+route instead — per-pulsar dense ``n_toa x n_toa`` covariances with
+``np.linalg`` solves per grid point (the ``fake_pta.py:515-524`` / SURVEY §E
+pattern), on host-simulated realizations of the same model — and reports
+the same recovery metrics plus wall time, so the two pipelines' answers and
+costs are directly comparable:
+
+    python examples/likelihood_grid.py                   # device lane
+    python examples/likelihood_grid.py --legacy-host     # dense host A/B
+    python examples/likelihood_grid.py --npsr 100 --ntoa 780 --nreal 10000
+
+Prints one JSON line with the grid, recovery metrics and timing.
+"""
+
+import argparse
+import json
+import pathlib
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+
+def run_device(args, batch, psd, model, truth):
+    import jax
+
+    from fakepta_tpu.infer import InferenceRun
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.parallel.montecarlo import GWBConfig
+
+    study = InferenceRun(
+        batch, model, gwb=GWBConfig(psd=psd, orf="curn"),
+        grid_shape=tuple(args.grid), truth=truth,
+        include=("white", "red", "dm", "gwb"),
+        mesh=make_mesh(jax.devices()))
+    t0 = time.perf_counter()
+    out = study.run(args.nreal, seed=args.seed, chunk=args.chunk)
+    return out["summary"], time.perf_counter() - t0
+
+
+def run_legacy_host(args, batch, psd, model, truth):
+    """The reference's dense-covariance analysis route, as the A/B baseline.
+
+    Simulates each realization and evaluates the grid per pulsar through the
+    full n_toa^3 path: C_k = N + T Phi_k T^T built dense, lnL via
+    slogdet + solve — what `fakepta_tpu.infer` replaces with rank-2N
+    Woodbury solves on device.
+    """
+    import jax.numpy as jnp
+
+    from fakepta_tpu.infer import build, theta_grid
+
+    compiled = build(model, batch)
+    theta = theta_grid(model, tuple(args.grid))
+    tmat = np.asarray(compiled.basis(batch), dtype=np.float64)
+    sigma2 = np.asarray(batch.sigma2, dtype=np.float64)
+    npsr, ntoa = sigma2.shape
+    ln2pi = np.log(2.0 * np.pi)
+
+    # dense per-(pulsar, grid-point) covariances of the model
+    phis = [np.asarray(compiled.phi(jnp.asarray(t), batch),
+                       dtype=np.float64) for t in theta]
+    phi_true = np.asarray(
+        compiled.phi(jnp.asarray(np.asarray(truth)), batch),
+        dtype=np.float64)
+
+    rng = np.random.default_rng(args.seed)
+    chols_true = [np.linalg.cholesky(
+        np.diag(sigma2[p]) + (tmat[p] * phi_true[p]) @ tmat[p].T)
+        for p in range(npsr)]
+
+    t0 = time.perf_counter()
+    factors = []
+    for k in range(theta.shape[0]):
+        per_psr = []
+        for p in range(npsr):
+            C = np.diag(sigma2[p]) + (tmat[p] * phis[k][p]) @ tmat[p].T
+            chol = np.linalg.cholesky(C)
+            per_psr.append((chol, 2.0 * np.log(np.diag(chol)).sum()))
+        factors.append(per_psr)
+    lnl = np.zeros((args.nreal, theta.shape[0]))
+    for r in range(args.nreal):
+        res = [chols_true[p] @ rng.standard_normal(ntoa)
+               for p in range(npsr)]
+        for k, per_psr in enumerate(factors):
+            total = 0.0
+            for p, (chol, ld) in enumerate(per_psr):
+                y = np.linalg.solve(chol, res[p])
+                total += -0.5 * (y @ y + ld + ntoa * ln2pi)
+            lnl[r, k] = total
+    wall = time.perf_counter() - t0
+
+    span = np.maximum(theta.max(axis=0) - theta.min(axis=0), 1e-300)
+    z = (theta - np.asarray(truth)[None]) / span[None]
+    truth_idx = int(np.argmin((z ** 2).sum(axis=1)))
+    map_idx = np.argmax(lnl, axis=1)
+    dist = np.sqrt((z[map_idx] ** 2).sum(axis=1))
+    summary = {
+        "lnlike_grid_k": int(theta.shape[0]),
+        "lnlike_lnl_max_mean": float(lnl.max(axis=1).mean()),
+        "lnlike_map_hit_rate": round(
+            float((map_idx == truth_idx).mean()), 4),
+        "lnlike_map_l2_mean": round(float(dist.mean()), 6),
+    }
+    return summary, wall
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--npsr", type=int, default=20)
+    ap.add_argument("--ntoa", type=int, default=260)
+    ap.add_argument("--nreal", type=int, default=1000)
+    ap.add_argument("--chunk", type=int, default=250)
+    ap.add_argument("--log10-A", type=float, default=-13.2,
+                    help="injected CURN amplitude (the grid truth)")
+    ap.add_argument("--gamma", type=float, default=13 / 3)
+    ap.add_argument("--grid", type=int, nargs=2, default=[5, 5],
+                    metavar=("NA", "NG"))
+    ap.add_argument("--ncomp", type=int, default=10,
+                    help="common-process Fourier components")
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--platform", default=None,
+                    help="force a jax platform (e.g. cpu)")
+    ap.add_argument("--legacy-host", action="store_true",
+                    help="A/B path: the reference's dense n_toa^3 "
+                         "covariance grid on host-simulated realizations "
+                         "instead of the device Woodbury lane")
+    args = ap.parse_args()
+    import jax
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+
+    from fakepta_tpu import spectrum as spectrum_lib
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.infer import ComponentSpec, FreeParam, LikelihoodSpec
+
+    # quiet per-pulsar noise so the common-process truth dominates the grid
+    batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
+                                  tspan_years=15.0, toaerr=1e-7,
+                                  n_red=10, n_dm=10, red_log10_A=-14.5,
+                                  dm_log10_A=-14.5, seed=0)
+    f = np.arange(1, args.ncomp + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=args.log10_A,
+                                           gamma=args.gamma))
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=args.ncomp, free=(
+            FreeParam("log10_A", (args.log10_A - 0.6, args.log10_A + 0.6)),
+            FreeParam("gamma", (2.0, 6.0)))),
+    ))
+    truth = (args.log10_A, args.gamma)
+    if args.legacy_host:
+        summary, wall = run_legacy_host(args, batch, psd, model, truth)
+    else:
+        summary, wall = run_device(args, batch, psd, model, truth)
+    print(json.dumps({
+        "npsr": args.npsr, "ntoa": args.ntoa, "nreal": args.nreal,
+        "log10_A": round(args.log10_A, 3), "gamma": round(args.gamma, 3),
+        "grid": list(args.grid),
+        "legacy_host": bool(args.legacy_host),
+        "wall_s": round(wall, 3),
+        "grid_evals_per_s": round(
+            args.nreal * summary["lnlike_grid_k"] / max(wall, 1e-9), 1),
+        **summary,
+    }))
+
+
+if __name__ == "__main__":
+    main()

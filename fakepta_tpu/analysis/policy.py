@@ -63,6 +63,15 @@ DTYPE_POLICY = {
     "fakepta_tpu/detect/operators.py": "host-f64",
     "fakepta_tpu/detect/run.py": "host-f64",
     "fakepta_tpu/detect/cli.py": "host-f64",
+    # the inference subsystem's host layers: the facade/CLI reduce packed
+    # likelihood lanes with host numpy (recovery metrics, Fisher means) and
+    # stage theta grids at f64. The device pieces live elsewhere under the
+    # default device-f32 policy: ops/woodbury.py and infer/model.py's
+    # basis/phi/lnl functions are dtype-polymorphic jnp (they run at the
+    # batch dtype inside the chunk program), and the engine lane is in
+    # parallel/montecarlo.py.
+    "fakepta_tpu/infer/run.py": "host-f64",
+    "fakepta_tpu/infer/cli.py": "host-f64",
 }
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"

@@ -35,6 +35,7 @@ from . import spectrum as spectrum_lib
 from .models import cgw as cgw_model
 from .ops import fourier as fourier_ops
 from .ops import white as white_ops
+from .ops import woodbury as woodbury_ops
 from .utils import rng as rng_utils
 from .utils.masks import bucket_size, pad_1d
 
@@ -395,8 +396,16 @@ def _k_mvn(key, cov, jitter):
 
 @jax.jit
 def _k_wiener(cov, red_cov, residuals):
-    """Conditional mean of the red process given residuals: red^T cov^{-1} r."""
-    return red_cov.T @ jnp.linalg.solve(cov, residuals)
+    """Conditional mean of the red process given residuals: red^T cov^{-1} r.
+
+    ``cov = diag(white) + red_cov`` is symmetric positive definite, so the
+    solve runs through one Cholesky factorization + two triangular solves
+    (:func:`fakepta_tpu.ops.woodbury.cho_solve_psd`) — the library keeps no
+    dense-inverse/LU covariance path anywhere (the reference's
+    ``np.linalg.inv`` smoother, ``fake_pta.py:515-524``, is exactly what
+    ``fakepta_tpu.infer`` replaces; see docs/INFERENCE.md).
+    """
+    return red_cov.T @ woodbury_ops.cho_solve_psd(cov, residuals)
 
 
 class Pulsar:

@@ -1,0 +1,42 @@
+"""fakepta_tpu.infer — batched GP-marginalized likelihood as an engine lane.
+
+The subsystem that lets the engine *evaluate* what it simulates: the
+GP-marginalized PTA log-likelihood (van Haasteren & Vallisneri's Woodbury
+formulation, arXiv:1407.1838 — rank-2N solves instead of the reference's
+dense ``n_toa^3`` ``np.linalg.inv`` path) is computed INSIDE the jitted
+chunk program for a K-point hyperparameter batch against every realization,
+with exact ``jax.grad``/Hessian lanes, and packed beside curves/autos — no
+residual fetch, no host sampler round-trip.
+
+Layers (docs/INFERENCE.md):
+
+- :mod:`fakepta_tpu.ops.woodbury` — the reusable linear-algebra layer:
+  masked white/ECORR inner products, moment assembly, Cholesky-only
+  factorizations (no dense inverse anywhere in the library).
+- :mod:`model` — :class:`LikelihoodSpec`: a declarative model (which
+  red/DM/chrom/sys/CURN spectra and which of their hyperparameters are
+  free, priors as box transforms) compiled against a batch, reusing the
+  registered spectrum library and the engine's Fourier bases.
+- the device lane — ``EnsembleSimulator.run(lnlike=InferSpec(...))``:
+  per-realization lnL (and gradient / Fisher-Hessian lanes) on any
+  (real, psr, toa) sharding.
+- :mod:`reconstruct` — the batched conditional-mean (Wiener) GP
+  reconstruction, shared with the facade's ``draw_noise_model``.
+- :class:`InferenceRun` — the host facade: one call runs a grid recovery
+  study and emits a schema-versioned artifact ``python -m fakepta_tpu.obs
+  compare`` can diff; CLI: ``python -m fakepta_tpu.infer run ...``.
+"""
+
+from .model import (BATCH_SPECTRUM, INFER_SCHEMA, ComponentSpec,
+                    CompiledLikelihood, FreeParam, InferSpec,
+                    LikelihoodSpec, as_spec, assemble, build,
+                    lanes_per_point, theta_grid)
+from .reconstruct import wiener_coefficients, wiener_reconstruct
+from .run import InferenceRun
+
+__all__ = [
+    "BATCH_SPECTRUM", "INFER_SCHEMA", "ComponentSpec", "CompiledLikelihood",
+    "FreeParam", "InferSpec", "InferenceRun", "LikelihoodSpec", "as_spec",
+    "assemble", "build", "lanes_per_point", "theta_grid",
+    "wiener_coefficients", "wiener_reconstruct",
+]

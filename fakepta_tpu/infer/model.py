@@ -1,0 +1,408 @@
+"""Declarative GP-marginalized likelihood models over a PulsarBatch.
+
+A :class:`LikelihoodSpec` names which Gaussian-process components the
+likelihood marginalizes (red / DM / chromatic / per-backend system bands per
+pulsar, plus a common CURN process on the array grid) and which of their
+spectrum hyperparameters are *free* — everything resolves against the same
+registered spectrum library every injector uses
+(:mod:`fakepta_tpu.spectrum`) and the engine's own Fourier bases
+(:func:`fakepta_tpu.batch.fourier_basis_norm`), so the inference model and
+the simulation model cannot drift.
+
+:func:`build` compiles a spec against a batch into a
+:class:`CompiledLikelihood`: a static column layout plus two pure jnp
+functions — ``basis(batch)`` (the concatenated (P, T, 2M) design tensor,
+legal on any (real, psr, toa) shard of the batch) and ``phi(theta, batch)``
+(the (P, 2M) prior diagonal for one hyperparameter point). The likelihood
+itself is assembled from :mod:`fakepta_tpu.ops.woodbury` moments, so a
+K-point batch reuses the data-side moments and ``jax.grad``/HVPs flow
+through ``phi`` alone.
+
+Free parameters are scalars shared across pulsars by default;
+``FreeParam(per_pulsar=True)`` gives every pulsar its own theta slot (the
+per-pulsar noise-surface case). Priors are box transforms: ``bounds``
+feed :func:`theta_grid` and :meth:`CompiledLikelihood.theta_from_unit`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .. import spectrum as spectrum_lib
+from ..batch import fourier_basis_norm
+from ..ops import woodbury
+
+#: schema tag for inference-run artifacts (mirrors fakepta_tpu.detect/1)
+INFER_SCHEMA = "fakepta_tpu.infer/1"
+
+#: GP targets a component may marginalize; 'curn' is the common uncorrelated
+#: red-noise process on the array grid (the standard diagonal approximation
+#: of a common signal — cross-pulsar ORF terms would couple pulsars and
+#: break the per-pulsar Woodbury factorization)
+TARGETS = ("red", "dm", "chrom", "sys", "curn")
+
+#: sentinel spectrum name: take the component's PSD from the batch's stored
+#: arrays (a fixed, fully-marginalized nuisance — no free parameters)
+BATCH_SPECTRUM = "batch"
+
+MODES = ("lnlike", "grad", "fisher")
+
+
+@dataclasses.dataclass(frozen=True)
+class FreeParam:
+    """One free spectrum hyperparameter: name, box bounds, pulsar scope."""
+
+    name: str
+    bounds: Tuple[float, float]
+    per_pulsar: bool = False
+
+    def __post_init__(self):
+        object.__setattr__(self, "bounds", tuple(self.bounds))
+
+
+@dataclasses.dataclass(frozen=True)
+class ComponentSpec:
+    """One GP component of the likelihood model.
+
+    ``spectrum`` names a registered PSD model (free/fixed hyperparameters
+    resolve against its signature), or :data:`BATCH_SPECTRUM` to pin the
+    component at the batch's stored PSD (``red_psd``/``dm_psd``/
+    ``chrom_psd``/``sys_psd``). ``nbin`` defaults to the batch's bin count
+    for the target (CURN: the red bin count).
+    """
+
+    target: str
+    spectrum: str = "powerlaw"
+    free: Tuple[FreeParam, ...] = ()
+    fixed: tuple = ()             # ((name, value), ...); dicts are normalized
+    nbin: Optional[int] = None
+
+    def __post_init__(self):
+        if isinstance(self.fixed, dict):
+            object.__setattr__(self, "fixed",
+                               tuple(sorted(self.fixed.items())))
+        else:
+            object.__setattr__(self, "fixed", tuple(self.fixed))
+        object.__setattr__(self, "free", tuple(self.free))
+
+
+@dataclasses.dataclass(frozen=True)
+class LikelihoodSpec:
+    """The declarative model: an ordered tuple of GP components.
+
+    Hashable by construction (it keys the engine's compiled-step cache).
+    White noise is always in the model, from the batch's ``sigma2`` and —
+    when the simulator's ECORR stage is live — its epoch/amplitude arrays.
+    """
+
+    components: Tuple[ComponentSpec, ...]
+
+    def __post_init__(self):
+        comps = self.components
+        if isinstance(comps, ComponentSpec):
+            comps = (comps,)
+        object.__setattr__(self, "components", tuple(comps))
+
+
+@dataclasses.dataclass(frozen=True, eq=False)
+class InferSpec:
+    """Configuration of the engine lnlike lane (``run(lnlike=...)``).
+
+    ``theta`` is the (K, D) hyperparameter batch evaluated against every
+    realization; ``mode`` selects the packed lanes per point: ``'lnlike'``
+    (1), ``'grad'`` (1 + D: lnL plus its exact gradient), ``'fisher'``
+    (1 + D + D^2: plus the dense Hessian — the per-realization observed
+    Fisher information is ``-H``).
+    """
+
+    model: LikelihoodSpec
+    theta: np.ndarray
+    mode: str = "lnlike"
+
+
+def as_spec(lnlike) -> InferSpec:
+    """Validate a run's ``lnlike=`` argument."""
+    if not isinstance(lnlike, InferSpec):
+        raise TypeError(
+            f"lnlike must be an InferSpec (a LikelihoodSpec plus a (K, D) "
+            f"theta batch and a mode), got {type(lnlike).__name__}")
+    if lnlike.mode not in MODES:
+        raise ValueError(f"InferSpec.mode must be one of {MODES}, got "
+                         f"{lnlike.mode!r}")
+    return lnlike
+
+
+def lanes_per_point(mode: str, d: int) -> int:
+    """Packed statistic lanes per theta point for a mode (see InferSpec)."""
+    return {"lnlike": 1, "grad": 1 + d, "fisher": 1 + d + d * d}[mode]
+
+
+def theta_grid(model: LikelihoodSpec, shape: Union[int, Sequence[int]]):
+    """(K, D) regular grid over every free parameter's box bounds.
+
+    ``shape`` gives the points per free parameter in declaration order (one
+    int broadcasts). Per-pulsar parameters have no sensible dense grid —
+    build ``theta`` explicitly for those models.
+    """
+    params = [fp for comp in model.components for fp in comp.free]
+    if not params:
+        raise ValueError("theta_grid needs at least one free parameter")
+    if any(fp.per_pulsar for fp in params):
+        raise ValueError("theta_grid cannot grid per-pulsar parameters; "
+                         "pass an explicit theta array instead")
+    if isinstance(shape, (int, np.integer)):
+        shape = (int(shape),) * len(params)
+    shape = tuple(int(s) for s in shape)
+    if len(shape) != len(params):
+        raise ValueError(f"grid shape {shape} must give one size per free "
+                         f"parameter ({len(params)})")
+    axes = [np.linspace(fp.bounds[0], fp.bounds[1], s)
+            for fp, s in zip(params, shape)]
+    mesh = np.meshgrid(*axes, indexing="ij")
+    return np.stack([m.ravel() for m in mesh], axis=-1)
+
+
+def _batch_bins(batch, target: str) -> int:
+    if target == "red":
+        return batch.red_psd.shape[1]
+    if target == "dm":
+        return batch.dm_psd.shape[1]
+    if target == "chrom":
+        return batch.chrom_psd.shape[1]
+    if target == "sys":
+        return batch.sys_psd.shape[2]
+    return batch.red_psd.shape[1]          # curn: the red grid's size
+
+
+class CompiledLikelihood:
+    """A LikelihoodSpec resolved against one batch (see :func:`build`)."""
+
+    def __init__(self, spec: LikelihoodSpec, batch):
+        if not spec.components:
+            raise ValueError("LikelihoodSpec needs at least one component")
+        self.spec = spec
+        self.npsr = int(batch.npsr)
+        comps = []
+        names = []
+        bounds = []
+        d = 0
+        for ci, comp in enumerate(spec.components):
+            if comp.target not in TARGETS:
+                raise ValueError(f"unknown likelihood target "
+                                 f"{comp.target!r}; known: {TARGETS}")
+            nbatch = _batch_bins(batch, comp.target)
+            nbin = int(comp.nbin) if comp.nbin is not None else nbatch
+            bands = 1
+            if comp.target == "sys":
+                if not bool(np.any(np.asarray(batch.sys_mask))):
+                    raise ValueError(
+                        "a 'sys' component needs system-noise bands in the "
+                        "batch (build it from pulsars with system_noise "
+                        "entries)")
+                bands = int(batch.sys_psd.shape[1])
+            if comp.spectrum == BATCH_SPECTRUM:
+                if comp.free or comp.fixed:
+                    raise ValueError(
+                        f"spectrum='batch' pins component {ci} "
+                        f"({comp.target}) at the batch's stored PSD; it "
+                        f"takes no free or fixed hyperparameters")
+                if comp.target == "curn":
+                    raise ValueError("the batch stores no common-process "
+                                     "PSD; give the 'curn' component a "
+                                     "parametric spectrum")
+                if nbin > nbatch:
+                    raise ValueError(
+                        f"component {ci} ({comp.target}) asks for {nbin} "
+                        f"bins but the batch stores {nbatch}")
+            else:
+                if comp.spectrum not in spectrum_lib.SPECTRA:
+                    raise ValueError(
+                        f"spectrum {comp.spectrum!r} is not registered; "
+                        f"known: {sorted(spectrum_lib.SPECTRA)}")
+                reg = spectrum_lib.SPECTRA[comp.spectrum]
+                for pname in ([fp.name for fp in comp.free]
+                              + [k for k, _ in comp.fixed]):
+                    if pname not in reg.params:
+                        raise ValueError(
+                            f"{pname!r} is not a hyperparameter of "
+                            f"{comp.spectrum!r} (has {list(reg.params)})")
+                fixed_names = {k for k, _ in comp.fixed}
+                dup = [fp.name for fp in comp.free if fp.name in fixed_names]
+                if dup:
+                    raise ValueError(f"parameters {dup} are both free and "
+                                     f"fixed in component {ci}")
+            free_entries = []
+            for fp in comp.free:
+                if fp.per_pulsar and comp.target == "curn":
+                    raise ValueError("'curn' is a common process; its "
+                                     "hyperparameters cannot be per_pulsar")
+                length = self.npsr if fp.per_pulsar else 1
+                free_entries.append((fp.name, d, fp.per_pulsar))
+                if fp.per_pulsar:
+                    names.extend(f"{comp.target}_{fp.name}[{p}]"
+                                 for p in range(self.npsr))
+                else:
+                    names.append(f"{comp.target}_{fp.name}")
+                bounds.extend([list(fp.bounds)] * length)
+                d += length
+            comps.append({
+                "target": comp.target, "spectrum": comp.spectrum,
+                "nbin": nbin, "bands": bands, "free": tuple(free_entries),
+                "fixed": dict(comp.fixed),
+            })
+        self._comps = comps
+        self.D = d
+        self.param_names = tuple(names)
+        self.bounds = np.asarray(bounds, dtype=float).reshape(d, 2)
+        #: total basis columns (2 quadratures per bin, per band)
+        self.ncols = 2 * sum(c["nbin"] * c["bands"] for c in comps)
+
+    # -- host helpers ------------------------------------------------------
+    def validate_theta(self, theta) -> np.ndarray:
+        """Coerce a theta batch to a host (K, D) float array."""
+        arr = np.asarray(theta, dtype=float)
+        if arr.ndim == 1:
+            arr = arr[None]
+        if arr.ndim != 2 or arr.shape[1] != self.D:
+            raise ValueError(
+                f"theta must be (K, {self.D}) for parameters "
+                f"{list(self.param_names)}; got shape {np.shape(theta)}")
+        if not np.all(np.isfinite(arr)):
+            raise ValueError("theta contains non-finite entries")
+        return arr
+
+    def theta_from_unit(self, u) -> np.ndarray:
+        """Affine box transform from the unit cube to physical parameters."""
+        u = np.asarray(u, dtype=float)
+        lo, hi = self.bounds[:, 0], self.bounds[:, 1]
+        return lo + u * (hi - lo)
+
+    # -- device functions (legal inside jit/shard_map on batch shards) -----
+    def basis(self, batch):
+        """(P, T, 2M) concatenated Fourier design tensor on a batch shard.
+
+        Per-pulsar targets use the pulsar-normalized times (grid
+        ``n/Tspan_p``), CURN the common-origin normalized times (grid
+        ``n/Tspan_array``) — the exact bases the injection kernels project
+        through, so the model marginalizes what the engine injected.
+        """
+        p_local, t_local = batch.t_own.shape
+        blocks = []
+        for c in self._comps:
+            n = c["nbin"]
+            if c["target"] == "curn":
+                b = fourier_basis_norm(batch.t_common, n)
+            elif c["target"] == "dm":
+                b = fourier_basis_norm(batch.t_own, n,
+                                       scale=(1400.0 / batch.freqs) ** 2)
+            elif c["target"] == "chrom":
+                b = fourier_basis_norm(batch.t_own, n,
+                                       scale=(1400.0 / batch.freqs) ** 4)
+            else:                        # 'red' and 'sys' share the own grid
+                b = fourier_basis_norm(batch.t_own, n)
+            if c["target"] == "sys":
+                for band in range(c["bands"]):
+                    masked = b * batch.sys_mask[:, band][:, :, None, None]
+                    blocks.append(masked.reshape(p_local, t_local, -1))
+            else:
+                blocks.append(b.reshape(p_local, t_local, -1))
+        return jnp.concatenate(blocks, axis=-1)
+
+    def phi(self, theta, batch, psr_offset=0):
+        """(P, 2M) prior variance diagonal for ONE theta point.
+
+        ``psr_offset`` is the batch shard's global pulsar offset (slices
+        per-pulsar theta slots so the same theta vector is legal on every
+        psr shard). Layout matches :meth:`basis` column for column.
+        """
+        p_local = batch.t_own.shape[0]
+        dtype = batch.t_own.dtype
+        theta = jnp.asarray(theta, dtype)
+        cols = []
+        for c in self._comps:
+            n = c["nbin"]
+            if c["target"] == "curn":
+                df = 1.0 / batch.tspan_common
+                f = jnp.arange(1, n + 1, dtype=dtype) * df
+            else:
+                df = batch.df_own[:, None]
+                f = jnp.arange(1, n + 1, dtype=dtype) * df       # (P, N)
+            if c["spectrum"] == BATCH_SPECTRUM:
+                stored = {"red": batch.red_psd, "dm": batch.dm_psd,
+                          "chrom": batch.chrom_psd}
+                if c["target"] == "sys":
+                    for band in range(c["bands"]):
+                        pd = batch.sys_psd[:, band, :n] * df
+                        cols.append(jnp.concatenate([pd, pd], axis=-1))
+                    continue
+                pd = stored[c["target"]][:, :n] * df
+                cols.append(jnp.concatenate([pd, pd], axis=-1))
+                continue
+            kwargs = dict(c["fixed"])
+            for pname, start, per_psr in c["free"]:
+                if per_psr:
+                    v = lax.dynamic_slice(theta, (start + psr_offset,),
+                                          (p_local,))
+                    kwargs[pname] = v[:, None]
+                else:
+                    kwargs[pname] = theta[start]
+            psd = spectrum_lib.evaluate(c["spectrum"], f, **kwargs)
+            pd = jnp.broadcast_to(psd * df, (p_local, n))
+            block = jnp.concatenate([pd, pd], axis=-1)
+            for _ in range(c["bands"]):
+                cols.append(block)
+        return jnp.concatenate(cols, axis=-1)
+
+    def lnl_local(self, theta, moments, batch, psr_offset=0):
+        """(R,) local-pulsar partial lnL sums for ONE theta point.
+
+        ``moments = (M, lndetN, n_valid, d0, dT)`` with leading (P,) /
+        (R, P) axes, as the engine lane assembles them from
+        :mod:`fakepta_tpu.ops.woodbury` parts. The caller psums the result
+        over the pulsar mesh axis; differentiating through this function
+        (theta enters only via ``phi``) gives exact gradients and Hessians.
+        """
+        M, lndetN, n_valid, d0, dT = moments
+        phi = self.phi(theta, batch, psr_offset)
+        chol, lnnorm = jax.vmap(woodbury.lnlike_factors)(M, phi)
+        quad = d0 - woodbury.quad_forms(chol, dT)                 # (R, P)
+        lnl = -0.5 * (quad + lndetN[None] + lnnorm[None]
+                      + n_valid[None] * woodbury.LN_2PI)
+        return jnp.sum(lnl, axis=1)
+
+
+def build(spec: LikelihoodSpec, batch) -> CompiledLikelihood:
+    """Compile a LikelihoodSpec against a batch (validates everything)."""
+    return CompiledLikelihood(spec, batch)
+
+
+def assemble(spec: InferSpec, compiled: CompiledLikelihood, lanes) -> dict:
+    """Schema-versioned result dict from the packed lnlike lanes.
+
+    ``lanes`` is the (R, K*L) host block the engine unpacked; returns
+    ``lnl`` (R, K) and, per mode, ``grad`` (R, K, D) / ``fisher``
+    (R, K, D, D) — the Hessian of lnL, so the observed Fisher matrix is
+    ``-fisher`` averaged over realizations.
+    """
+    theta = compiled.validate_theta(spec.theta)
+    k, d = theta.shape[0], compiled.D
+    lanes = np.asarray(lanes, dtype=float).reshape(
+        -1, k, lanes_per_point(spec.mode, d))
+    out = {
+        "schema": INFER_SCHEMA,
+        "mode": spec.mode,
+        "theta": theta,
+        "param_names": list(compiled.param_names),
+        "lnl": lanes[:, :, 0],
+    }
+    if spec.mode in ("grad", "fisher"):
+        out["grad"] = lanes[:, :, 1:1 + d]
+    if spec.mode == "fisher":
+        out["fisher"] = lanes[:, :, 1 + d:].reshape(-1, k, d, d)
+    return out

@@ -1,0 +1,103 @@
+"""Batched conditional-mean (Wiener) GP reconstruction.
+
+The reference's only reconstruction path is the dense smoother
+``red_cov @ inv(C) @ r`` per pulsar (``fake_pta.py:515-524``, SURVEY §E).
+This module is its batched Woodbury replacement: the posterior-mean GP
+coefficients given residuals are ``b = Sigma^{-1} T^T N^{-1} r`` with
+``Sigma = B^{-1} + T^T N^{-1} T`` (rank 2M, never n_toa^3), and the
+conditional-mean signal is ``T b`` — algebraically identical to the dense
+smoother (see :func:`fakepta_tpu.ops.woodbury.conditional_mean`), which is
+also what the facade's ``draw_noise_model(residuals=...)`` now runs through
+(Cholesky ``cho_solve``, no dense inverse).
+
+Everything is dtype-polymorphic and vmapped over (pulsar) and any leading
+realization axes, so one call smooths a whole ensemble's residual blocks.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import woodbury
+from .model import CompiledLikelihood, LikelihoodSpec, build
+
+
+def _compiled(model, batch) -> CompiledLikelihood:
+    if isinstance(model, CompiledLikelihood):
+        return model
+    if isinstance(model, LikelihoodSpec):
+        return build(model, batch)
+    raise TypeError(f"model must be a LikelihoodSpec or CompiledLikelihood, "
+                    f"got {type(model).__name__}")
+
+
+def wiener_coefficients(model, batch, residuals, theta=None,
+                        ecorr: bool = False):
+    """Posterior-mean GP coefficients for (..., P, T) residual blocks.
+
+    ``model`` is a :class:`LikelihoodSpec` (or an already-compiled one);
+    ``theta`` supplies its free parameters (omit for all-fixed models).
+    ``ecorr=True`` includes the batch's per-epoch ECORR blocks in the white
+    noise. Returns (..., P, 2M) coefficients in the model's column layout.
+    """
+    compiled = _compiled(model, batch)
+    if theta is None:
+        if compiled.D:
+            raise ValueError(f"the model has {compiled.D} free parameter(s) "
+                             f"({list(compiled.param_names)}); pass theta")
+        theta_arr = jnp.zeros((0,))
+    else:
+        theta_arr = compiled.validate_theta(theta)[0]
+    tmat = compiled.basis(batch)
+    phi = compiled.phi(theta_arr, batch)
+    num_ep = batch.max_toa if ecorr else 0
+    epoch = batch.epoch_idx if ecorr else None
+    amp = batch.ecorr_amp if ecorr else None
+
+    def one_psr(t, s2, m, e, a):
+        return woodbury.finish_fixed(woodbury.fixed_parts(
+            t, s2, m, e, a, num_epochs=num_ep))
+
+    if ecorr:
+        M, _, _, corr = jax.vmap(one_psr)(tmat, batch.sigma2, batch.mask,
+                                          epoch, amp)
+    else:
+        M, _, _, corr = jax.vmap(
+            lambda t, s2, m: one_psr(t, s2, m, None, None))(
+                tmat, batch.sigma2, batch.mask)
+
+    res = jnp.asarray(residuals, batch.t_own.dtype)
+    lead = res.shape[:-2]
+    res2 = res.reshape((-1,) + res.shape[-2:])
+
+    def one(r_p, t, s2, m, e, a, M_p, phi_p, corr_p):
+        parts = woodbury.res_parts(r_p, t, s2, m, e, a, num_epochs=num_ep)
+        _, dT = woodbury.finish_res(parts, corr_p)
+        return woodbury.conditional_mean(M_p, phi_p, dT)
+
+    if ecorr:
+        per_real = jax.vmap(lambda rr: jax.vmap(one)(
+            rr, tmat, batch.sigma2, batch.mask, epoch, amp, M, phi, corr))
+    else:
+        per_real = jax.vmap(lambda rr: jax.vmap(
+            lambda r_p, t, s2, m, M_p, phi_p: one(r_p, t, s2, m, None, None,
+                                                  M_p, phi_p, None))(
+                rr, tmat, batch.sigma2, batch.mask, M, phi))
+    coeffs = per_real(res2)
+    return coeffs.reshape(lead + coeffs.shape[-2:])
+
+
+def wiener_reconstruct(model, batch, residuals, theta=None,
+                       ecorr: bool = False):
+    """Conditional-mean GP signal ``T b`` for (..., P, T) residual blocks.
+
+    The batched Wiener smoother: what survives after the white noise is
+    optimally filtered out, masked to each pulsar's valid TOAs.
+    """
+    compiled = _compiled(model, batch)
+    coeffs = wiener_coefficients(compiled, batch, residuals, theta=theta,
+                                 ecorr=ecorr)
+    tmat = compiled.basis(batch)
+    recon = jnp.einsum("...pk,ptk->...pt", coeffs, tmat)
+    return jnp.where(batch.mask, recon, 0.0)

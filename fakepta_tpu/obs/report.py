@@ -98,6 +98,16 @@ class RunReport:
                 self.steady_real_per_s_per_chip(), 3)
             if self.cost.get("bytes_per_chunk"):
                 m["os_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
+        if self.meta.get("lnlike"):
+            # a likelihood-lane run (fakepta_tpu.infer): the steady rate
+            # times the grid size is the evaluation throughput bench.py /
+            # benchmarks rows record; chunk cost under the lane's name so
+            # `compare --fail-on-regression` gates the inference path too
+            k = int(self.meta["lnlike"].get("k", 1))
+            m["lnlike_evals_per_s_per_chip"] = round(
+                self.steady_real_per_s_per_chip() * k, 3)
+            if self.cost.get("bytes_per_chunk"):
+                m["lnlike_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
         # host-attached metrics (e.g. detect.DetectionRun's significance /
         # detection-rate summary) round-trip through meta so a loaded
         # artifact diffs them like any engine metric
@@ -204,17 +214,23 @@ def format_delta(a: RunReport, b: RunReport,
 
     def _higher_is_better(k: str) -> bool:
         # suffix rules cover the detect lane's per-ORF metric names
-        # (os_<orf>_significance_sigma, os_<orf>_detection_rate) and any
-        # future *_per_s_per_chip throughput metric
+        # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the
+        # infer lane's recovery metrics (lnlike_map_hit_rate; its
+        # lnlike_map_l2_mean distance and *_bytes_per_chunk costs keep the
+        # lower-is-better default) and any *_per_s_per_chip / evals
+        # throughput metric
         return (k in higher_is_better
                 or k.endswith(("_per_s_per_chip", "_significance_sigma",
-                               "_detection_rate")))
+                               "_detection_rate", "_hit_rate")))
 
     # run-shape facts and distribution-scale diagnostics, not performance or
-    # quality metrics — moving is information, not a regression
+    # quality metrics — moving is information, not a regression (the infer
+    # lane's lnL scale and grid size land here: a model change legitimately
+    # moves absolute lnL without being better or worse)
     exempt = {"nreal", "chunks"}
     exempt_suffixes = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
-                       "_null_q95", "_p_value_median")
+                       "_null_q95", "_p_value_median", "_lnl_max_mean",
+                       "_grid_k")
     lines = [f"{'metric':<28} {'a':>14} {'b':>14} {'delta':>12}"]
     regressions = []
     for k in keys:

@@ -1,1 +1,1 @@
-from . import fourier, white  # noqa: F401
+from . import fourier, white, woodbury  # noqa: F401

@@ -1,0 +1,202 @@
+"""Rank-2N Woodbury kernels for the GP-marginalized PTA likelihood.
+
+The reference's only analysis path is the dense route: build the full
+``n_toa x n_toa`` covariance ``C = N + T B T^T`` and hit it with
+``np.linalg.inv`` (``fake_pta.py:515-524``, SURVEY §E) — O(n_toa^3) per
+pulsar per hyperparameter point. The van Haasteren & Vallisneri Woodbury
+formulation (arXiv:1407.1838) replaces that with solves of the rank-2N
+system ``Sigma = B^{-1} + T^T N^{-1} T`` (2N ~ hundreds, n_toa ~ thousands):
+
+    lnL = -1/2 [ r^T N^{-1} r  -  r^T N^{-1} T Sigma^{-1} T^T N^{-1} r ]
+          -1/2 [ ln det N + ln det B + ln det Sigma ]  -  n/2 ln 2 pi
+
+Everything here is expressed as *moments* so the batched engine lane can
+amortize: ``T^T N^{-1} T`` / ``ln det N`` depend only on the batch (ONE
+evaluation per chunk program), ``T^T N^{-1} r`` / ``r^T N^{-1} r`` are per
+realization, and the hyperparameters enter only through the tiny diagonal
+prior ``B = diag(phi)`` — so a K-point grid costs K Choleskys of Sigma plus
+K batched triangular solves, never K rebuilds of the data-side moments.
+
+``N`` is diagonal white noise plus optional per-epoch ECORR blocks
+``u_e u_e^T`` (``u_i = ecorr_amp_i`` within epoch ``e``), handled exactly by
+per-block Sherman-Morrison on segment sums — no dense block ever exists.
+All parts are plain sums over TOAs, so a time-sharded caller psums the part
+pytrees over its mesh axis before :func:`finish_fixed`/:func:`finish_res`
+(the nonlinear epoch corrections commute with nothing; the additive parts
+commute with everything). Masked padding TOAs carry zero weight throughout.
+
+Dtype-polymorphic by design: the engine lane runs these at the batch dtype
+(device f32), the oracle tests and host operators at f64. No
+``jnp.linalg.inv`` anywhere — Cholesky + triangular solves only (a contract
+``tests/test_infer.py`` enforces for the whole library).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.scipy.linalg import cho_solve, solve_triangular
+
+LN_2PI = 1.8378770664093453
+
+
+def _phi_floor(dtype):
+    """Positive floor for prior variances: a zero-variance (padded/disabled)
+    basis column must contribute nothing, not a division by zero. The pair
+    ``ln phi + ln Sigma_jj -> ln(1 + phi M_jj) -> 0`` and the column's solve
+    contribution vanish as phi -> 0, so flooring at ``4/dtype_max`` (whose
+    reciprocal still fits the dtype) is exact in the limit and inert for any
+    physical phi."""
+    return 4.0 / jnp.finfo(jnp.asarray(0.0, dtype).dtype).max
+
+
+def cho_solve_psd(a, b):
+    """Solve ``a x = b`` for symmetric positive-definite ``a`` via Cholesky.
+
+    The library-wide replacement for dense ``inv``/LU on covariance
+    matrices: one factorization, two triangular solves, no explicit inverse.
+    """
+    chol = jnp.linalg.cholesky(a)
+    return cho_solve((chol, True), b)
+
+
+def _masked_weights(sigma2, mask):
+    """(T,) inverse white variances, exactly zero on padding TOAs."""
+    safe = jnp.where(mask, sigma2, 1.0)
+    return jnp.where(mask, 1.0 / safe, 0.0)
+
+
+def fixed_parts(tmat, sigma2, mask, epoch_idx=None, ecorr_amp=None,
+                num_epochs: int = 0):
+    """Residual-independent moment parts for ONE pulsar (additive over TOAs).
+
+    ``tmat`` (T, 2M) basis, ``sigma2``/``mask`` (T,) white variances and
+    validity. With ``num_epochs > 0``, ``epoch_idx`` (T,) int32 global epoch
+    ids and ``ecorr_amp`` (T,) per-TOA ECORR amplitudes add the per-epoch
+    rank-1 pieces. Returns a dict of plain sums — psum it over a time-shard
+    axis before :func:`finish_fixed`.
+    """
+    w = _masked_weights(sigma2, mask)
+    parts = {
+        "M": jnp.einsum("tj,t,tk->jk", tmat, w, tmat),
+        "lndetN": jnp.sum(jnp.where(mask, jnp.log(jnp.where(mask, sigma2,
+                                                            1.0)), 0.0)),
+        "n_valid": jnp.sum(mask.astype(tmat.dtype)),
+    }
+    if num_epochs:
+        q = w * ecorr_amp                       # D^{-1} u, elementwise
+        parts["a"] = jax.ops.segment_sum(q * ecorr_amp, epoch_idx,
+                                         num_segments=num_epochs)
+        parts["v"] = jax.ops.segment_sum(q[:, None] * tmat, epoch_idx,
+                                         num_segments=num_epochs)
+    return parts
+
+
+def res_parts(r, tmat, sigma2, mask, epoch_idx=None, ecorr_amp=None,
+              num_epochs: int = 0):
+    """Residual-dependent moment parts for ONE pulsar (additive over TOAs)."""
+    w = _masked_weights(sigma2, mask)
+    parts = {
+        "d0": jnp.sum(w * r * r),
+        "dT": jnp.einsum("t,tj->j", w * r, tmat),
+    }
+    if num_epochs:
+        parts["s"] = jax.ops.segment_sum(w * ecorr_amp * r, epoch_idx,
+                                         num_segments=num_epochs)
+    return parts
+
+
+def finish_fixed(parts):
+    """(M, lndetN, n_valid, corr) from summed fixed parts.
+
+    Applies the per-epoch Sherman-Morrison downdate
+    ``M -= sum_e v_e v_e^T / (1 + a_e)`` and the block determinant
+    ``ln det N += sum_e ln(1 + a_e)``; ``corr`` carries ``(a, v)`` for
+    :func:`finish_res` (None when the noise is purely diagonal).
+    """
+    M, lndetN, n_valid = parts["M"], parts["lndetN"], parts["n_valid"]
+    if "a" not in parts:
+        return M, lndetN, n_valid, None
+    a, v = parts["a"], parts["v"]
+    g = 1.0 / (1.0 + a)
+    M = M - jnp.einsum("e,ej,ek->jk", g, v, v)
+    lndetN = lndetN + jnp.sum(jnp.log1p(a))
+    return M, lndetN, n_valid, {"a": a, "v": v}
+
+
+def finish_res(parts, corr=None):
+    """(d0, dT) from summed residual parts (+ the ECORR downdate)."""
+    d0, dT = parts["d0"], parts["dT"]
+    if corr is None:
+        return d0, dT
+    g = 1.0 / (1.0 + corr["a"])
+    s = parts["s"]
+    d0 = d0 - jnp.sum(g * s * s)
+    dT = dT - jnp.einsum("e,e,ej->j", g, s, corr["v"])
+    return d0, dT
+
+
+def lnlike_factors(M, phi):
+    """Hyperparameter-side factorization for ONE pulsar.
+
+    ``Sigma = diag(1/phi) + M`` is factorized once per (pulsar, theta point)
+    and shared by every realization. Returns ``(chol, lnnorm)`` with
+    ``lnnorm = ln det B + ln det Sigma`` (the theta-dependent half of the
+    normalization).
+    """
+    phi = jnp.maximum(phi, _phi_floor(phi.dtype))
+    sigma = M + jnp.diag(1.0 / phi)
+    chol = jnp.linalg.cholesky(sigma)
+    lnnorm = jnp.sum(jnp.log(phi)) + 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(chol)))
+    return chol, lnnorm
+
+
+def quad_forms(chol, dT):
+    """Batched ``dT^T Sigma^{-1} dT`` via one forward triangular solve.
+
+    ``chol`` (P, 2M, 2M) lower factors, ``dT`` (R, P, 2M) per-realization
+    projected residuals -> (R, P). Only the forward solve is needed:
+    ``dT^T Sigma^{-1} dT = ||L^{-1} dT||^2``.
+    """
+    rhs = jnp.moveaxis(dT, 0, -1)                         # (P, 2M, R)
+    y = solve_triangular(chol, rhs, lower=True)
+    return jnp.moveaxis(jnp.sum(y * y, axis=-2), -1, 0)   # (R, P)
+
+
+def lnlike_from_moments(d0, dT, M, lndetN, n_valid, phi):
+    """Woodbury lnL for ONE pulsar from its moments and prior diagonal."""
+    chol, lnnorm = lnlike_factors(M, phi)
+    y = solve_triangular(chol, dT, lower=True)
+    quad = d0 - jnp.sum(y * y)
+    return -0.5 * (quad + lndetN + lnnorm + n_valid * LN_2PI)
+
+
+def conditional_mean(M, phi, dT):
+    """Posterior-mean GP coefficients ``b = Sigma^{-1} T^T N^{-1} r``.
+
+    The Woodbury form of the Wiener filter: the conditional mean of the GP
+    signal given the residuals is ``T b`` — identical to the dense
+    ``(T B T^T) C^{-1} r`` smoother (ref ``fake_pta.py:515-524``) with the
+    n_toa^3 inverse replaced by one rank-2N Cholesky solve.
+    """
+    phi = jnp.maximum(phi, _phi_floor(phi.dtype))
+    chol = jnp.linalg.cholesky(M + jnp.diag(1.0 / phi))
+    return cho_solve((chol, True), dT)
+
+
+def woodbury_lnlike(r, tmat, phi, sigma2, mask=None, epoch_idx=None,
+                    ecorr_amp=None, num_epochs: int = 0):
+    """One-shot lnL for ONE pulsar (tests, host operators, small problems).
+
+    The engine lane composes the split pieces instead so the fixed moments
+    amortize over realizations and theta points.
+    """
+    mask = jnp.ones(r.shape, bool) if mask is None else mask
+    fparts = fixed_parts(tmat, sigma2, mask, epoch_idx, ecorr_amp,
+                         num_epochs=num_epochs)
+    rparts = res_parts(r, tmat, sigma2, mask, epoch_idx, ecorr_amp,
+                       num_epochs=num_epochs)
+    M, lndetN, n_valid, corr = finish_fixed(fparts)
+    d0, dT = finish_res(rparts, corr)
+    return lnlike_from_moments(d0, dT, M, lndetN, n_valid, phi)

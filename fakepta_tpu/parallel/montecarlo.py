@@ -1474,6 +1474,10 @@ class EnsembleSimulator:
         self._w_os_empty = jnp.zeros((0, batch.npsr, batch.npsr), dtype)
         self._step_os_cache: dict = {}
         self._step_fused_os_cache: dict = {}
+        # lnlike lane (fakepta_tpu.infer): compiled models and step variants,
+        # keyed by the (hashable) LikelihoodSpec + mode + path
+        self._lnlike_compiled_cache: dict = {}
+        self._step_lnlike_cache: dict = {}
         self._step = self._build_step()
         self._step_fused = self._build_step_fused() if self._use_pallas else None
 
@@ -1495,7 +1499,8 @@ class EnsembleSimulator:
                       count=n)
 
     def _obs_capture_cost(self, base_key, chunk: int, fused: bool,
-                          w_os=None, with_null: bool = False) -> dict:
+                          w_os=None, with_null: bool = False,
+                          lnl=None) -> dict:
         """One-time XLA cost/memory analysis of the chunk program (cached per
         simulator and step variant — plain/fused/OS/OS+null programs have
         genuinely different FLOPs/bytes, and the OS lane's bytes-per-chunk is
@@ -1506,7 +1511,8 @@ class EnsembleSimulator:
         metrics."""
         cache_key = (int(chunk), bool(fused),
                      None if w_os is None else int(w_os.shape[0]),
-                     bool(with_null))
+                     bool(with_null),
+                     None if lnl is None else lnl[2])
         if cache_key in self._obs_cost:
             return self._obs_cost[cache_key]
         cost: dict = {}
@@ -1516,7 +1522,15 @@ class EnsembleSimulator:
                 bulks = tuple(jnp.zeros((chunk, self.batch.npsr),
                                         self.batch.t_own.dtype)
                               for _ in self._cgw_psrterm)
-                if w_os is not None and fused:
+                if lnl is not None:
+                    lnl_step, lnl_theta, _ = lnl
+                    if fused:
+                        lowered = lnl_step.lower(base_key, 0, chunk,
+                                                 lnl_theta, bulks)
+                    else:
+                        lowered = lnl_step.lower(base_key, 0, chunk,
+                                                 lnl_theta, bulks, False)
+                elif w_os is not None and fused:
                     lowered = self._get_step_fused_os(
                         int(w_os.shape[0]), with_null).lower(
                             base_key, 0, chunk, w_os, bulks)
@@ -1964,8 +1978,202 @@ class EnsembleSimulator:
             self._step_fused_os_cache[key] = step
         return step
 
+    def _lnlike_lanes(self, res, batch, theta, compiled, mode):
+        """(R_local, K*L) GP-marginalized likelihood lanes (shard_map body).
+
+        The ``fakepta_tpu.infer`` lane: per-pulsar Woodbury moments are
+        assembled from the residual blocks (``ops/woodbury.py``) — the
+        residual-independent half (``T^T N^-1 T``, ``ln det N``) once per
+        chunk program, the per-realization half (``T^T N^-1 r``, ``r^T N^-1
+        r``) once per realization — then every theta point costs only a
+        rank-2M Cholesky per pulsar plus batched triangular solves. All
+        moment parts are plain TOA sums, so under time sharding they psum
+        over 'toa' BEFORE the nonlinear ECORR corrections and the
+        factorization — the lane is bit-meaningful on any (real, psr, toa)
+        mesh. Local pulsar partial lnLs close with one psum over 'psr'.
+        ``mode`` adds exact-gradient (jacrev) and Hessian (jacfwd∘jacrev)
+        lanes; theta enters only through the prior diagonal ``phi``, so the
+        data-side moments are shared by value, grad and Fisher lanes alike.
+        """
+        from ..ops import woodbury
+
+        ecorr_on = self._include[1]
+        num_ep = self.batch.max_toa if ecorr_on else 0
+        pidx = lax.axis_index(PSR_AXIS)
+        p_local = batch.t_own.shape[0]
+        off = pidx * p_local
+        with obs.span("lnlike_moments"):
+            tmat = compiled.basis(batch)
+
+            def fparts(t, s2, m, e, a):
+                return woodbury.fixed_parts(t, s2, m, e, a,
+                                            num_epochs=num_ep)
+
+            def rparts(r, t, s2, m, e, a):
+                return woodbury.res_parts(r, t, s2, m, e, a,
+                                          num_epochs=num_ep)
+
+            fixed = jax.vmap(fparts)(tmat, batch.sigma2, batch.mask,
+                                     batch.epoch_idx, batch.ecorr_amp)
+            resp = jax.vmap(lambda rr: jax.vmap(rparts)(
+                rr, tmat, batch.sigma2, batch.mask, batch.epoch_idx,
+                batch.ecorr_amp))(res)
+            if self._has_toa:
+                # every part is a plain sum over TOAs: close the time axis
+                # here, then the (nonlinear) ECORR corrections and the
+                # Cholesky run on replicated full-width moments
+                fixed = jax.tree_util.tree_map(
+                    lambda x: lax.psum(x, TOA_AXIS), fixed)
+                resp = jax.tree_util.tree_map(
+                    lambda x: lax.psum(x, TOA_AXIS), resp)
+            M, lndetN, nv, corr = jax.vmap(woodbury.finish_fixed)(fixed)
+            d0, dT = jax.vmap(lambda rp: jax.vmap(woodbury.finish_res)(
+                rp, corr))(resp)
+        moments = (M, lndetN, nv, d0, dT)
+        with obs.span("lnlike"):
+            def one_theta(th):
+                if mode == "lnlike":
+                    return compiled.lnl_local(th, moments, batch, off)[:, None]
+
+                def f(t):
+                    return compiled.lnl_local(t, moments, batch, off)
+
+                val = f(th)
+                grad = jax.jacrev(f)(th)                        # (R, D)
+                if mode == "grad":
+                    return jnp.concatenate([val[:, None], grad], axis=1)
+                hess = jax.jacfwd(jax.jacrev(f))(th)            # (R, D, D)
+                return jnp.concatenate(
+                    [val[:, None], grad,
+                     hess.reshape(val.shape[0], -1)], axis=1)
+
+            lanes = jax.vmap(one_theta)(theta)                  # (K, R, L)
+            lanes = jnp.moveaxis(lanes, 0, 1).reshape(res.shape[0], -1)
+            lanes = lax.psum(lanes, PSR_AXIS)
+        return lanes
+
+    def _build_step_lnlike(self, compiled, mode, fused):
+        """Step with the lnlike lane packed beside curves/autos.
+
+        The XLA variant mirrors :meth:`_build_step_os` (the lanes are extra
+        ``pack_stats`` slots, so checkpointing/resume carry them via the
+        ``n_extra`` manifest unchanged); the fused variant runs the Pallas
+        statistic kernel for curves/autos while the likelihood lanes are
+        computed from the same residual blocks in the same program.
+        """
+        has_toa = self._has_toa
+        toa_shards = self._n_toa_shards
+        specs = self._step_in_specs(has_toa)
+
+        if not fused:
+            def sharded(keys, batch, chol, gwb_w, theta, det, samp_params,
+                        white_params, white_toaerr2, white_bid, cgw_trel,
+                        cgw_pdist, cgw_bulks, *roe):
+                res = self._residuals(keys, batch, chol, gwb_w, det,
+                                      samp_params, white_params,
+                                      white_toaerr2, white_bid, cgw_trel,
+                                      cgw_pdist, cgw_bulks, roe,
+                                      toa_shards=toa_shards)
+                corr = _correlation_rows(res, stats_bf16=self._stats_bf16,
+                                         toa_psum=has_toa)
+                lanes = self._lnlike_lanes(res, batch, theta, compiled, mode)
+                return corr, lanes
+
+            shmapped = shard_map(
+                sharded, mesh=self.mesh,
+                in_specs=(P(REAL_AXIS), specs[0], specs[1], specs[2], P(),
+                          *specs[3:]),
+                out_specs=(P(REAL_AXIS, PSR_AXIS), P(REAL_AXIS)),
+            )
+
+            @partial(jax.jit, static_argnums=(2, 5))
+            def step(base_key, offset, nreal, theta, cgw_bulks,
+                     with_corr=False):
+                # trace-time only: the retrace guard (see _obs_note_trace)
+                self._obs_note_trace(("step_lnlike", nreal, theta.shape,
+                                      mode, with_corr))
+                keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                    offset + jnp.arange(nreal))
+                corr, lanes = shmapped(
+                    keys, self.batch, self._chol, self._gwb_w, theta,
+                    self._det, self._samp_params, self._white_params,
+                    self._white_toaerr2, self._white_bid, self._cgw_trel,
+                    self._pdist, cgw_bulks, *self._roe_states)
+                curves, autos = self._stat_lanes(corr)
+                packed = pack_stats(curves, autos, lanes)
+                if with_corr:
+                    return packed, corr / self._counts_dev
+                return packed
+
+            return step
+
+        from ..ops.pallas_kernels import binned_correlation, pick_rt
+
+        if not hasattr(self, "_stat_weights"):
+            self._stat_weights = jnp.concatenate(
+                [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]],
+                axis=0)
+        nbins = self.nbins
+        interpret = self._pallas_interpret
+
+        def sharded(keys, batch, chol, gwb_w, theta, weights, det,
+                    samp_params, white_params, white_toaerr2, white_bid,
+                    cgw_trel, cgw_pdist, cgw_bulks, *roe):
+            res = self._residuals(keys, batch, chol, gwb_w, det, samp_params,
+                                  white_params, white_toaerr2, white_bid,
+                                  cgw_trel, cgw_pdist, cgw_bulks, roe,
+                                  toa_shards=1)
+            with obs.span("all_gather"):
+                res_full = lax.all_gather(res, PSR_AXIS, axis=1, tiled=True)
+            rt = pick_rt(res.shape[0], res.shape[1], res_full.shape[1],
+                         res.shape[2], nbins,
+                         mxu_binning=self._pallas_mxu_binning)
+            with obs.span("correlate"):
+                curves_p, autos_p = binned_correlation(
+                    res, res_full, weights, nbins=nbins, rt=rt,
+                    interpret=interpret, precision=self._pallas_precision,
+                    mxu_binning=self._pallas_mxu_binning)
+                curves = lax.psum(curves_p, PSR_AXIS)
+                autos = lax.psum(autos_p, PSR_AXIS)
+            lanes = self._lnlike_lanes(res, batch, theta, compiled, mode)
+            return curves, autos, lanes
+
+        shmapped = shard_map(
+            sharded, mesh=self.mesh,
+            in_specs=(P(REAL_AXIS), specs[0], specs[1], specs[2], P(),
+                      P(None, PSR_AXIS, None), *specs[3:]),
+            out_specs=(P(REAL_AXIS), P(REAL_AXIS), P(REAL_AXIS)),
+            # pallas_call does not annotate vma on its outputs; the psums
+            # above make them replicated over 'psr' by construction
+            check_vma=False,
+        )
+
+        @partial(jax.jit, static_argnums=(2,))
+        def step(base_key, offset, nreal, theta, cgw_bulks):
+            # trace-time only: the retrace guard (see _obs_note_trace)
+            self._obs_note_trace(("step_fused_lnlike", nreal, theta.shape,
+                                  mode))
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                offset + jnp.arange(nreal))
+            curves, autos, lanes = shmapped(
+                keys, self.batch, self._chol, self._gwb_w, theta,
+                self._stat_weights, self._det, self._samp_params,
+                self._white_params, self._white_toaerr2, self._white_bid,
+                self._cgw_trel, self._pdist, cgw_bulks, *self._roe_states)
+            return pack_stats(curves, autos, lanes)
+
+        return step
+
+    def _get_step_lnlike(self, model, mode, fused, compiled):
+        key = (model, str(mode), bool(fused))
+        step = self._step_lnlike_cache.get(key)
+        if step is None:
+            step = self._build_step_lnlike(compiled, mode, fused)
+            self._step_lnlike_cache[key] = step
+        return step
+
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
-            checkpoint=None, progress=None, os=None):
+            checkpoint=None, progress=None, os=None, lnlike=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
@@ -1986,6 +2194,19 @@ class EnsembleSimulator:
         quantiles and per-realization ``p_value``. Legal alongside the fused
         Pallas path (the OS lanes ride the kernel's weight slots) and under
         any (real, psr, toa) sharding; see docs/DETECTION.md.
+
+        ``lnlike``: enable the on-device GP-marginalized likelihood lane —
+        an :class:`fakepta_tpu.infer.InferSpec` (a declarative
+        :class:`~fakepta_tpu.infer.LikelihoodSpec` plus a (K, D)
+        hyperparameter batch and a mode). Each realization's Woodbury lnL
+        (and, per mode, exact gradient / Hessian lanes) is evaluated at
+        every theta point INSIDE the jitted chunk program and packed beside
+        curves/autos — no residual fetch, no host sampler. Results land
+        under ``out["lnlike"]`` (schema ``fakepta_tpu.infer/1``): ``lnl``
+        (nreal, K) and per mode ``grad`` (nreal, K, D) / ``fisher``
+        (nreal, K, D, D). Legal under any (real, psr, toa) sharding and
+        beside the fused Pallas statistic path; mutually exclusive with
+        ``os`` (one packed-extras layout per run); see docs/INFERENCE.md.
 
         ``checkpoint``: a path — after every chunk the run appends that chunk's
         outputs to a sibling ``<path>.c<k>.npz`` file and updates a small
@@ -2027,6 +2248,27 @@ class EnsembleSimulator:
         # the OS lane: host-f64 operator precompute (detect.operators), one
         # (P, P) weight matrix per ORF stacked into the step's w_os input
         os_spec, os_ops, w_os, n_os, n_extra = None, None, None, 0, 0
+        # the lnlike lane: model compiled against the batch (fakepta_tpu
+        # .infer), theta staged once to device at the batch dtype
+        lnl_spec, lnl_compiled, lnl_theta, lnl_k, lnl_l = None, None, None, 0, 0
+        if lnlike is not None:
+            if os is not None:
+                raise ValueError(
+                    "run(os=..., lnlike=...) cannot combine the detection "
+                    "and likelihood lanes in one run (one packed-extras "
+                    "layout per run); run them separately")
+            from ..infer import model as infer_model
+            lnl_spec = infer_model.as_spec(lnlike)
+            lnl_compiled = self._lnlike_compiled_cache.get(lnl_spec.model)
+            if lnl_compiled is None:
+                lnl_compiled = infer_model.build(lnl_spec.model, self.batch)
+                self._lnlike_compiled_cache[lnl_spec.model] = lnl_compiled
+            theta_host = lnl_compiled.validate_theta(lnl_spec.theta)
+            lnl_theta = jnp.asarray(theta_host, self.batch.t_own.dtype)
+            lnl_k = theta_host.shape[0]
+            lnl_l = infer_model.lanes_per_point(lnl_spec.mode,
+                                                lnl_compiled.D)
+            n_extra = lnl_k * lnl_l
         if os is not None:
             from ..detect import operators as detect_ops
             os_spec = detect_ops.as_spec(os)
@@ -2074,7 +2316,20 @@ class EnsembleSimulator:
                 # with a static realization count, so a smaller tail chunk
                 # would recompile the SPMD program
                 bulks = self._host_cgw_bulks(base, done, chunk)
-                if os_ops is not None:
+                if lnl_compiled is not None:
+                    lnl_step = self._get_step_lnlike(
+                        lnl_spec.model, lnl_spec.mode, fused, lnl_compiled)
+                    if fused:
+                        packed = lnl_step(base, done, chunk, lnl_theta,
+                                          bulks)
+                    elif keep_corr:
+                        packed, corr = lnl_step(base, done, chunk, lnl_theta,
+                                                bulks, True)
+                        corr_out.append(to_host(corr))
+                    else:
+                        packed = lnl_step(base, done, chunk, lnl_theta,
+                                          bulks, False)
+                elif os_ops is not None:
                     if fused:
                         packed = self._get_step_fused_os(n_os, os_spec.null)(
                             base, done, chunk, w_os, bulks)
@@ -2137,6 +2392,10 @@ class EnsembleSimulator:
                          if os_spec.null else None)
             out["os"] = detect_ops.assemble(os_spec, os_ops, os_vals,
                                             null_vals)
+        if lnl_compiled is not None:
+            from ..infer import model as infer_model
+            out["lnlike"] = infer_model.assemble(
+                lnl_spec, lnl_compiled, packed_h[:, nb + 1:])
         if keep_corr:
             out["corr"] = np.concatenate(corr_out)[:nreal]
         if ckpt is not None and jax.process_index() == 0:
@@ -2161,14 +2420,23 @@ class EnsembleSimulator:
             meta["os"] = {"orfs": list(os_spec.orfs),
                           "weighting": os_spec.weighting,
                           "null": bool(os_spec.null)}
+        if lnl_spec is not None:
+            meta["lnlike"] = {"k": int(lnl_k), "d": int(lnl_compiled.D),
+                              "mode": lnl_spec.mode,
+                              "params": list(lnl_compiled.param_names)}
         collector.count("obs.chunks", len(chunk_records))
+        lnl_cost = (None if lnl_compiled is None else
+                    (self._get_step_lnlike(lnl_spec.model, lnl_spec.mode,
+                                           fused, lnl_compiled),
+                     lnl_theta, (lnl_k, lnl_l, lnl_spec.mode)))
         report = RunReport.from_collector(
             collector, meta,
             retraces=self._obs_retraces - retraces_before,
             total_s=total_s,
             cost=self._obs_capture_cost(base, chunk, fused, w_os=w_os,
                                         with_null=bool(os_spec.null)
-                                        if os_spec else False),
+                                        if os_spec else False,
+                                        lnl=lnl_cost),
             memory=self._obs_memory_stats())
         report.chunks = chunk_records
         report.spans = sorted(self._obs_spans)

@@ -89,6 +89,37 @@ def test_detection_statistic_example_runs(tmp_path):
     assert 0.0 <= row["detection_rate_at_5pct_false_alarm"] <= 1.0
 
 
+def test_likelihood_grid_example_runs(tmp_path):
+    """CURN grid example: the device Woodbury lane and the --legacy-host
+    dense-covariance A/B both run as shipped, recover the injected truth,
+    and report a consistent lnL scale."""
+    common = ["--platform", "cpu", "--npsr", "8", "--ntoa", "64",
+              "--grid", "3", "3"]
+    dev = subprocess.run(
+        [sys.executable, str(EXAMPLES / "likelihood_grid.py"), *common,
+         "--nreal", "100", "--chunk", "50"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert dev.returncode == 0, dev.stderr[-2000:]
+    row_dev = json.loads(dev.stdout.strip().splitlines()[-1])
+    assert row_dev["legacy_host"] is False
+    assert row_dev["lnlike_map_hit_rate"] > 0.5
+
+    legacy = subprocess.run(
+        [sys.executable, str(EXAMPLES / "likelihood_grid.py"), *common,
+         "--nreal", "20", "--legacy-host"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert legacy.returncode == 0, legacy.stderr[-2000:]
+    row_leg = json.loads(legacy.stdout.strip().splitlines()[-1])
+    assert row_leg["legacy_host"] is True
+    assert row_leg["lnlike_map_hit_rate"] > 0.5
+    # same model, same truth: the two pipelines' lnL scales must agree to
+    # the Monte-Carlo scatter (they use independent realizations)
+    a, b = row_dev["lnlike_lnl_max_mean"], row_leg["lnlike_lnl_max_mean"]
+    assert abs(a - b) / abs(b) < 0.05
+
+
 def test_population_study_example_runs(tmp_path):
     """Prior-marginalized study: runs as shipped with sampled red noise + GWB
     amplitude (and a sampled CW source), empirically-calibrated detection."""

@@ -1,0 +1,529 @@
+"""fakepta_tpu.infer — the GP-marginalized likelihood lane.
+
+Pins the tentpole contracts: Woodbury lnL against the dense-covariance f64
+oracle (diagonal and ECORR-block N, per pulsar and summed), exact gradients
+against finite differences, lane parity with a host oracle on deterministic
+residuals, mesh invariance across (real, psr, toa) shardings, fused-Pallas
+acceptance, checkpoint resume of the ``n_extra`` lnlike slots, the
+Wiener-reconstruction equivalence, the facade/CLI artifact that ``obs
+compare`` diffs direction-aware, and the library-wide no-dense-inverse
+contract behind the facade's Cholesky smoother.
+"""
+
+import json
+import re
+import subprocess
+import sys
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.infer import (ComponentSpec, FreeParam, InferSpec,
+                               InferenceRun, LikelihoodSpec, build,
+                               theta_grid, wiener_reconstruct)
+from fakepta_tpu.ops import woodbury
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+@pytest.fixture
+def batch64():
+    return PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                 toaerr=1e-7, n_red=8, n_dm=8, seed=1,
+                                 dtype=jnp.float64)
+
+
+def _curn_model(nbin=8):
+    return LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=nbin, free=(
+            FreeParam("log10_A", (-13.8, -12.6)),
+            FreeParam("gamma", (2.0, 6.0)))),
+    ))
+
+
+def _gwb_cfg(batch, ncomp=8, log10_A=-13.2, orf="curn"):
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=log10_A, gamma=13 / 3))
+    return GWBConfig(psd=psd, orf=orf)
+
+
+def _dense_lnl(r, tmat, phi, sigma2, mask, blocks=()):
+    """f64 dense-covariance oracle: C = N + T diag(phi) T^T over valid TOAs."""
+    v = np.asarray(mask, bool)
+    N = np.diag(np.asarray(sigma2)[v])
+    for sel, u in blocks:                 # ECORR rank-1 epoch blocks
+        idx = np.flatnonzero(sel[v])
+        N[np.ix_(idx, idx)] += np.outer(u, u)
+    Tm = np.asarray(tmat)[v]
+    C = N + Tm @ np.diag(np.asarray(phi)) @ Tm.T
+    _, ld = np.linalg.slogdet(C)
+    x = np.linalg.solve(C, np.asarray(r)[v])
+    return -0.5 * (np.asarray(r)[v] @ x + ld + v.sum() * np.log(2 * np.pi))
+
+
+def test_woodbury_matches_dense_oracle_per_pulsar(batch64, rng):
+    """Acceptance: Woodbury lnL == dense f64 oracle to <= 1e-8 relative per
+    pulsar (and summed), on the real batch bases with padding masks."""
+    batch = batch64
+    model = _curn_model()
+    compiled = build(model, batch)
+    tmat = np.asarray(compiled.basis(batch))
+    theta = np.array([-13.2, 4.0])
+    phi = np.asarray(compiled.phi(jnp.asarray(theta), batch))
+    mask = np.asarray(batch.mask).copy()
+    mask[:, -7:] = False                       # exercise the padding path
+    r = rng.standard_normal(batch.t_own.shape) * 1e-7
+    total_got, total_want = 0.0, 0.0
+    for p in range(batch.npsr):
+        got = float(woodbury.woodbury_lnlike(
+            jnp.asarray(r[p]), jnp.asarray(tmat[p]), jnp.asarray(phi[p]),
+            batch.sigma2[p], jnp.asarray(mask[p])))
+        want = _dense_lnl(r[p], tmat[p], phi[p], np.asarray(batch.sigma2[p]),
+                          mask[p])
+        np.testing.assert_allclose(got, want, rtol=1e-8, err_msg=f"psr {p}")
+        total_got += got
+        total_want += want
+    np.testing.assert_allclose(total_got, total_want, rtol=1e-8)
+
+
+def test_woodbury_ecorr_matches_dense_oracle(rng):
+    """ECORR epoch blocks via per-block Sherman-Morrison == dense blocks."""
+    T, M2, n_ep = 48, 10, 12
+    mask = np.ones(T, bool)
+    mask[-6:] = False
+    sigma2 = rng.uniform(0.5, 2.0, T) * 1e-14
+    tmat = rng.standard_normal((T, M2)) * 1e-4
+    phi = 10.0 ** rng.uniform(-16, -13, M2)
+    r = rng.standard_normal(T) * 1e-7
+    epoch = np.repeat(np.arange(n_ep), T // n_ep).astype(np.int32)
+    u = np.zeros(T)
+    for e in range(n_ep):
+        if e % 3 != 0:                        # some epochs have no ECORR
+            u[epoch == e] = rng.uniform(1e-8, 1e-7)
+    u[~mask] = 0.0
+    got = float(woodbury.woodbury_lnlike(
+        jnp.asarray(r), jnp.asarray(tmat), jnp.asarray(phi),
+        jnp.asarray(sigma2), jnp.asarray(mask), jnp.asarray(epoch),
+        jnp.asarray(u), num_epochs=T))
+    blocks = [((epoch == e) & mask, u[(epoch == e) & mask])
+              for e in range(n_ep)]
+    want = _dense_lnl(r, tmat, phi, sigma2, mask, blocks=blocks)
+    np.testing.assert_allclose(got, want, rtol=1e-8)
+
+
+def test_grad_matches_finite_differences(batch64, rng):
+    """Acceptance: jax.grad of the Woodbury lnL through the spectrum library
+    matches central finite differences to <= 1e-5 on 3 hyperparameters."""
+    batch = batch64
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", free=(
+            FreeParam("log10_A", (-15.0, -13.0)),),
+            fixed={"gamma": 13 / 3}),
+        ComponentSpec(target="curn", nbin=8, free=(
+            FreeParam("log10_A", (-13.8, -12.6)),
+            FreeParam("gamma", (2.0, 6.0)))),
+    ))
+    compiled = build(model, batch)
+    assert compiled.D == 3
+    tmat = compiled.basis(batch)
+    r = jnp.asarray(rng.standard_normal(batch.t_own.shape) * 1e-7)
+
+    def lnl(theta):
+        phi = compiled.phi(theta, batch)
+        return jnp.sum(jax.vmap(woodbury.woodbury_lnlike)(
+            r, tmat, phi, batch.sigma2, batch.mask))
+
+    theta0 = jnp.asarray([-14.0, -13.2, 4.0])
+    grad = np.asarray(jax.grad(lnl)(theta0))
+    eps = 1e-6
+    for d in range(3):
+        e = np.zeros(3)
+        e[d] = eps
+        fd = (float(lnl(theta0 + e)) - float(lnl(theta0 - e))) / (2 * eps)
+        np.testing.assert_allclose(grad[d], fd, rtol=1e-5, err_msg=f"d={d}")
+
+
+def test_lnlike_lane_matches_host_oracle(batch64):
+    """The engine lane on deterministic residuals (include=('det',) with a
+    fixed waveform) equals the host Woodbury composition exactly — lane
+    packing, basis and phi all pinned in one shot."""
+    batch = batch64
+    rng = np.random.default_rng(5)
+    W = rng.standard_normal(batch.t_own.shape) * 1e-7
+    model = _curn_model()
+    theta = theta_grid(model, (3, 3))
+    sim = EnsembleSimulator(batch, include=("det",), waveform=W,
+                            mesh=make_mesh(jax.devices()[:1]))
+    out = sim.run(4, seed=0, chunk=4,
+                  lnlike=InferSpec(model=model, theta=theta))
+    lnl = out["lnlike"]["lnl"]
+    assert lnl.shape == (4, 9)
+    np.testing.assert_allclose(lnl, np.broadcast_to(lnl[:1], lnl.shape),
+                               rtol=1e-12)                # det: all equal
+    compiled = build(model, batch)
+    tmat = compiled.basis(batch)
+    for k in (0, 4, 8):
+        phi = compiled.phi(jnp.asarray(theta[k]), batch)
+        want = sum(float(woodbury.woodbury_lnlike(
+            jnp.asarray(W[p]), tmat[p], phi[p], batch.sigma2[p],
+            batch.mask[p])) for p in range(batch.npsr))
+        np.testing.assert_allclose(lnl[0, k], want, rtol=1e-10)
+
+
+def test_lnlike_lane_mesh_invariance(batch64):
+    """Acceptance: the lnlike lane is mesh-invariant across (real, psr, toa)
+    shardings — 1x1x1 vs 2x2x2 and the single-axis extremes — for value AND
+    gradient lanes (f64 batch: resharding moves only summation order)."""
+    devs = jax.devices()
+    assert len(devs) >= 8, "conftest forces an 8-device CPU mesh"
+    batch = batch64
+    cfg = _gwb_cfg(batch)
+    model = _curn_model()
+    spec = InferSpec(model=model, theta=theta_grid(model, (2, 2)),
+                     mode="grad")
+    include = ("white", "red", "dm", "gwb")
+    ref = EnsembleSimulator(batch, gwb=cfg, include=include,
+                            mesh=make_mesh(devs[:1])).run(
+        8, seed=3, chunk=4, lnlike=spec)
+    shardings = [dict(psr_shards=2, toa_shards=2), dict(psr_shards=4),
+                 dict(toa_shards=4)]
+    for shard_kw in shardings:
+        got = EnsembleSimulator(batch, gwb=cfg, include=include,
+                                mesh=make_mesh(devs, **shard_kw)).run(
+            8, seed=3, chunk=4, lnlike=spec)
+        for key in ("lnl", "grad"):
+            ref_v, got_v = ref["lnlike"][key], got["lnlike"][key]
+            np.testing.assert_allclose(
+                got_v, ref_v, rtol=1e-9, atol=1e-9 * np.abs(ref_v).max(),
+                err_msg=f"{key}/{shard_kw}")
+
+
+def test_lnlike_lane_mesh_invariance_with_ecorr():
+    """ECORR epoch blocks under time sharding: the per-epoch segment sums
+    psum over 'toa' before the nonlinear correction, so epochs straddling a
+    shard boundary reproduce the unsharded lane."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.fake_pta import Pulsar
+
+    day = 86400.0
+    toas = np.concatenate([k * 30 * day + np.arange(2) * 600.0
+                           for k in range(16)])
+    psrs = []
+    for k in range(4):
+        p = Pulsar(toas, 1e-7, np.arccos(1 - 2 * (k + 0.5) / 4),
+                   2.39996 * k % (2 * np.pi), seed=k,
+                   backends=["A.1400", "B.600"])
+        for backend in p.backends:
+            p.noisedict[f"{p.name}_{backend}_log10_ecorr"] = -6.8
+        p.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.0,
+                        seed=k)
+        psrs.append(p)
+    batch = PulsarBatch.from_pulsars(psrs, n_red=6, n_dm=6, ecorr=True,
+                                     dtype=jnp.float64)
+    assert bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", nbin=6, free=(
+            FreeParam("log10_A", (-14.0, -13.0)),),
+            fixed={"gamma": 3.0}),
+    ))
+    spec = InferSpec(model=model, theta=np.array([[-13.5], [-13.0]]))
+    include = ("white", "ecorr", "red")
+    devs = jax.devices()
+    ref = EnsembleSimulator(batch, include=include,
+                            mesh=make_mesh(devs[:1])).run(
+        4, seed=7, chunk=4, lnlike=spec)
+    for shard_kw in (dict(toa_shards=2), dict(psr_shards=2, toa_shards=2)):
+        got = EnsembleSimulator(batch, include=include,
+                                mesh=make_mesh(devs, **shard_kw)).run(
+            4, seed=7, chunk=4, lnlike=spec)
+        np.testing.assert_allclose(got["lnlike"]["lnl"], ref["lnlike"]["lnl"],
+                                   rtol=1e-9, err_msg=str(shard_kw))
+
+
+def test_lnlike_checkpoint_resume_keeps_lanes(batch64, tmp_path):
+    """A checkpointed lnlike run resumes with its n_extra slots intact and
+    equals the uninterrupted run; a config without the lane refuses."""
+    batch = batch64
+    cfg = _gwb_cfg(batch)
+    model = _curn_model()
+    spec = InferSpec(model=model, theta=theta_grid(model, (2, 2)))
+    mesh = make_mesh(jax.devices()[:1])
+    include = ("white", "red", "dm", "gwb")
+    full = EnsembleSimulator(batch, gwb=cfg, include=include,
+                             mesh=mesh).run(8, seed=9, chunk=4, lnlike=spec)
+
+    sim = EnsembleSimulator(batch, gwb=cfg, include=include, mesh=mesh)
+    ckpt = tmp_path / "ck.npz"
+
+    def boom(done, nreal):
+        if done >= 4:
+            raise KeyboardInterrupt
+
+    with pytest.raises(KeyboardInterrupt):
+        sim.run(8, seed=9, chunk=4, lnlike=spec, checkpoint=ckpt,
+                progress=boom)
+    with pytest.raises(ValueError, match="extra"):
+        sim.run(8, seed=9, chunk=4, checkpoint=ckpt)    # lane mismatch
+    out = sim.run(8, seed=9, chunk=4, lnlike=spec, checkpoint=ckpt)
+    np.testing.assert_allclose(out["lnlike"]["lnl"], full["lnlike"]["lnl"],
+                               rtol=1e-9)
+    np.testing.assert_allclose(out["curves"], full["curves"], rtol=1e-9)
+
+
+def test_lnlike_fused_pallas_matches_xla(batch64):
+    """Fused-path acceptance: under use_pallas the likelihood lanes ride the
+    same chunk program as the Pallas statistic kernel (interpret mode on
+    CPU) and match the XLA path; curves keep their fused-path contract."""
+    batch = batch64
+    cfg = _gwb_cfg(batch)
+    model = _curn_model()
+    spec = InferSpec(model=model, theta=theta_grid(model, (2, 2)))
+    mesh = make_mesh(jax.devices()[:1])
+    include = ("white", "red", "dm", "gwb")
+    ref = EnsembleSimulator(batch, gwb=cfg, include=include, mesh=mesh).run(
+        4, seed=3, chunk=4, lnlike=spec)
+    got = EnsembleSimulator(batch, gwb=cfg, include=include, mesh=mesh,
+                            use_pallas=True, pallas_precision="f32").run(
+        4, seed=3, chunk=4, lnlike=spec)
+    assert "corr" not in got
+    np.testing.assert_allclose(got["lnlike"]["lnl"], ref["lnlike"]["lnl"],
+                               rtol=1e-9)
+    scale = np.abs(ref["curves"]).max()
+    np.testing.assert_allclose(got["curves"], ref["curves"],
+                               atol=1e-5 * scale)
+
+
+def test_fisher_lanes_consistent(batch64):
+    """mode='fisher' packs lnL + grad + Hessian; the Hessian is symmetric
+    and its grad block matches the grad-mode run exactly (same moments)."""
+    batch = batch64
+    rng = np.random.default_rng(11)
+    W = rng.standard_normal(batch.t_own.shape) * 1e-7
+    model = _curn_model()
+    theta = np.array([[-13.2, 4.0]])
+    sim = EnsembleSimulator(batch, include=("det",), waveform=W,
+                            mesh=make_mesh(jax.devices()[:1]))
+    fi = sim.run(2, seed=0, chunk=2,
+                 lnlike=InferSpec(model=model, theta=theta, mode="fisher"))
+    gr = sim.run(2, seed=0, chunk=2,
+                 lnlike=InferSpec(model=model, theta=theta, mode="grad"))
+    H = fi["lnlike"]["fisher"][0, 0]
+    assert H.shape == (2, 2)
+    np.testing.assert_allclose(H, H.T, rtol=1e-8)
+    np.testing.assert_allclose(fi["lnlike"]["grad"], gr["lnlike"]["grad"],
+                               rtol=1e-10)
+    np.testing.assert_allclose(fi["lnlike"]["lnl"], gr["lnlike"]["lnl"],
+                               rtol=1e-12)
+    # FD check of one Hessian entry through lnlike-mode runs
+    eps = 1e-4
+    tp, tm = theta.copy(), theta.copy()
+    tp[0, 0] += eps
+    tm[0, 0] -= eps
+    gp = sim.run(1, seed=0, chunk=1, lnlike=InferSpec(
+        model=model, theta=tp, mode="grad"))["lnlike"]["grad"][0, 0, 0]
+    gm = sim.run(1, seed=0, chunk=1, lnlike=InferSpec(
+        model=model, theta=tm, mode="grad"))["lnlike"]["grad"][0, 0, 0]
+    np.testing.assert_allclose(H[0, 0], (gp - gm) / (2 * eps), rtol=1e-4)
+
+
+def test_wiener_reconstruct_matches_dense(batch64, rng):
+    """The batched Woodbury Wiener filter equals the dense smoother
+    T B T^T C^{-1} r (the facade's draw_noise_model algebra) at f64."""
+    batch = batch64
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+    ))
+    compiled = build(model, batch)
+    r = rng.standard_normal((3,) + batch.t_own.shape) * 1e-7
+    recon = np.asarray(wiener_reconstruct(compiled, batch, r))
+    assert recon.shape == r.shape
+    tmat = np.asarray(compiled.basis(batch))
+    phi = np.asarray(compiled.phi(jnp.zeros((0,)), batch))
+    for p in range(0, batch.npsr, 3):
+        C = (np.diag(np.asarray(batch.sigma2[p]))
+             + tmat[p] @ np.diag(phi[p]) @ tmat[p].T)
+        S = tmat[p] @ np.diag(phi[p]) @ tmat[p].T
+        want = S @ np.linalg.solve(C, r[:, p].T)
+        np.testing.assert_allclose(recon[:, p], want.T, rtol=1e-8,
+                                   atol=1e-12 * np.abs(want).max())
+
+
+def test_facade_wiener_is_cholesky_and_unchanged():
+    """Satellite: draw_noise_model's smoother now runs through
+    ops.woodbury.cho_solve_psd — the conditional mean must equal the dense
+    f64 solve reference."""
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.fake_pta import Pulsar
+
+    psr = Pulsar(np.linspace(0, 6 * const.yr, 80), 1e-7, 1.0, 1.0, seed=0)
+    psr.add_red_noise(spectrum="powerlaw", log10_A=-13.5, gamma=3.5, seed=1)
+    psr.add_white_noise(seed=2)
+    r = psr.residuals
+    white, red_cov = psr.make_noise_covariance_matrix()
+    cov = np.diag(white) + red_cov
+    want = red_cov.T @ np.linalg.solve(cov, r)
+    got = psr.draw_noise_model(residuals=r)
+    np.testing.assert_allclose(got, want, rtol=1e-10,
+                               atol=1e-12 * np.abs(want).max())
+
+
+def test_no_dense_inverse_in_library():
+    """Linter-enforceable satellite: no ``linalg.inv`` (or ``linalg.solve``
+    on covariances' LU path in the smoother) remains anywhere in the
+    library — covariance algebra goes through Cholesky factorizations."""
+    offenders = []
+    for path in sorted((REPO / "fakepta_tpu").rglob("*.py")):
+        src = path.read_text()
+        for i, line in enumerate(src.splitlines(), 1):
+            if re.search(r"linalg\s*\.\s*inv\s*\(", line):
+                offenders.append(f"{path.relative_to(REPO)}:{i}")
+    assert not offenders, f"dense inverses in library code: {offenders}"
+
+
+def test_validation_errors(batch64):
+    batch = batch64
+    mesh = make_mesh(jax.devices()[:1])
+    sim = EnsembleSimulator(batch, gwb=_gwb_cfg(batch), mesh=mesh,
+                            include=("white", "red", "dm", "gwb"))
+    model = _curn_model()
+    spec = InferSpec(model=model, theta=theta_grid(model, (2, 2)))
+    with pytest.raises(ValueError, match="cannot combine"):
+        sim.run(4, seed=0, chunk=4, os="hd", lnlike=spec)
+    with pytest.raises(TypeError, match="InferSpec"):
+        sim.run(4, seed=0, chunk=4, lnlike=model)
+    with pytest.raises(ValueError, match="mode"):
+        sim.run(4, seed=0, chunk=4,
+                lnlike=InferSpec(model=model, theta=spec.theta, mode="hmc"))
+    with pytest.raises(ValueError, match="theta must be"):
+        sim.run(4, seed=0, chunk=4,
+                lnlike=InferSpec(model=model, theta=np.zeros((2, 5))))
+    with pytest.raises(ValueError, match="unknown likelihood target"):
+        build(LikelihoodSpec(components=(ComponentSpec(target="gwb"),)),
+              batch)
+    with pytest.raises(ValueError, match="not a hyperparameter"):
+        build(LikelihoodSpec(components=(ComponentSpec(
+            target="red", free=(FreeParam("log10_a", (-15, -13)),)),)),
+            batch)
+    with pytest.raises(ValueError, match="batch"):
+        build(LikelihoodSpec(components=(ComponentSpec(
+            target="red", spectrum="batch",
+            free=(FreeParam("log10_A", (-15, -13)),)),)), batch)
+    with pytest.raises(ValueError, match="common process"):
+        build(LikelihoodSpec(components=(ComponentSpec(
+            target="curn", free=(FreeParam("log10_A", (-15, -13),
+                                           per_pulsar=True),)),)), batch)
+    with pytest.raises(ValueError, match="per-pulsar"):
+        theta_grid(LikelihoodSpec(components=(ComponentSpec(
+            target="red", free=(FreeParam("log10_A", (-15, -13),
+                                          per_pulsar=True),)),)), 3)
+    with pytest.raises(ValueError, match="system-noise"):
+        build(LikelihoodSpec(components=(ComponentSpec(target="sys"),)),
+              batch)
+    with pytest.raises(ValueError, match="no common-process"):
+        build(LikelihoodSpec(components=(ComponentSpec(
+            target="curn", spectrum="batch"),)), batch)
+
+
+def test_per_pulsar_free_params(batch64, rng):
+    """per_pulsar=True gives every pulsar its own theta slot; the sliced
+    phi on a psr shard must reproduce the single-device evaluation."""
+    batch = batch64
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", free=(
+            FreeParam("log10_A", (-15.0, -13.0), per_pulsar=True),),
+            fixed={"gamma": 13 / 3}),
+    ))
+    compiled = build(model, batch)
+    assert compiled.D == batch.npsr
+    assert compiled.param_names[0] == "red_log10_A[0]"
+    theta = rng.uniform(-15.0, -13.0, (1, batch.npsr))
+    spec = InferSpec(model=model, theta=theta)
+    devs = jax.devices()
+    include = ("white", "red")
+    ref = EnsembleSimulator(batch, include=include,
+                            mesh=make_mesh(devs[:1])).run(
+        4, seed=2, chunk=4, lnlike=spec)
+    got = EnsembleSimulator(batch, include=include,
+                            mesh=make_mesh(devs, psr_shards=4)).run(
+        4, seed=2, chunk=4, lnlike=spec)
+    np.testing.assert_allclose(got["lnlike"]["lnl"], ref["lnlike"]["lnl"],
+                               rtol=1e-9)
+
+
+def test_inference_run_facade_and_artifact(batch64, tmp_path):
+    """InferenceRun: one call -> grid recovery summary; the saved artifact
+    loads as a RunReport whose summary carries the lnlike metrics, and
+    `obs compare` diffs two artifacts (exit 0 on identical runs)."""
+    from fakepta_tpu.obs import RunReport
+
+    batch = batch64
+    study = InferenceRun(batch, _curn_model(), gwb=_gwb_cfg(batch),
+                         grid_shape=(3, 3), truth=(-13.2, 13 / 3),
+                         mesh=make_mesh(jax.devices()[:1]))
+    out = study.run(16, seed=2, chunk=8)
+    s = out["summary"]
+    assert s["lnlike_grid_k"] == 9
+    assert s["lnlike_map_hit_rate"] >= 0.5     # strong injection, wide grid
+    assert 0.0 <= s["lnlike_map_l2_mean"] <= np.sqrt(2.0)
+    p_a, p_b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    study.save(p_a)
+    study.save(p_b)
+    rep = RunReport.load(p_a)
+    assert rep.summary()["lnlike_map_hit_rate"] == s["lnlike_map_hit_rate"]
+    assert "lnlike_evals_per_s_per_chip" in rep.summary()
+    assert rep.meta["infer_schema"] == "fakepta_tpu.infer/1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.obs", "compare", str(p_a),
+         str(p_b), "--fail-on-regression"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "lnlike_map_hit_rate" in proc.stdout
+
+
+def test_obs_compare_direction_aware_for_lnlike_metrics():
+    """Satellite: `obs compare` knows which way each lnlike_* metric points
+    — hit rate / eval throughput down is a regression, MAP distance /
+    chunk bytes up is a regression, the lnL scale is exempt."""
+    from fakepta_tpu.obs.report import RunReport, format_delta
+
+    def rep(hit, l2, evals, nbytes, lnlmax):
+        return RunReport(meta={"nreal": 4, "extra_metrics": {
+            "lnlike_map_hit_rate": hit, "lnlike_map_l2_mean": l2,
+            "lnlike_evals_per_s_per_chip": evals,
+            "lnlike_bytes_per_chunk": nbytes,
+            "lnlike_lnl_max_mean": lnlmax}})
+
+    a = rep(0.9, 0.1, 1000.0, 1e6, 5000.0)
+    _, regs = format_delta(a, rep(0.5, 0.3, 500.0, 2e6, 9000.0))
+    assert set(regs) == {"lnlike_map_hit_rate", "lnlike_map_l2_mean",
+                         "lnlike_evals_per_s_per_chip",
+                         "lnlike_bytes_per_chunk"}
+    # every metric moving the GOOD way (or exempt) flags nothing
+    _, regs = format_delta(a, rep(1.0, 0.05, 2000.0, 5e5, 1000.0))
+    assert regs == []
+
+
+@pytest.mark.slow
+def test_infer_cli_smoke(tmp_path):
+    """`python -m fakepta_tpu.infer run` prints one JSON summary line and
+    writes the artifact."""
+    out = tmp_path / "infer.jsonl"
+    proc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.infer", "run", "--platform",
+         "cpu", "--npsr", "8", "--ntoa", "64", "--nreal", "64", "--chunk",
+         "32", "--grid", "3", "3", "--out", str(out)],
+        cwd=str(REPO), capture_output=True, text=True, timeout=520)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["lnlike_map_hit_rate"] > 0.5
+    assert out.exists()
