@@ -37,7 +37,18 @@ RunReport each ``sim.run()`` attaches):
   --fail-on-regression`` like the OS rows. The lnlike run uses a reduced
   chunk (the per-realization ``T^T N^-1 r`` moments are O(2M) per pulsar,
   heavier than the packed curves);
+- ``pipeline_depth`` / ``pipeline_stall_s`` / ``ckpt_wait_s``: the async
+  chunk-pipeline figures from the measured run's RunReport
+  (docs/PERFORMANCE.md) — the executed depth, total host time the dispatch
+  loop actually waited on (first-chunk staging + depth-bound waits), and
+  total checkpoint-append time (overlapped on the writer thread when
+  pipelined). Both timings are lower-is-better under ``obs compare``;
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
+
+Backend selection: the dead-tunnel probe verdict is cached to a temp file
+scoped to this process tree, and ``FAKEPTA_TPU_BENCH_BACKEND=cpu`` (or any
+backend name) skips the probe entirely — see ``__graft_entry__`` — so
+CPU-fallback bench runs no longer pay minutes of probe dead air.
 """
 
 import json
@@ -114,6 +125,10 @@ def main():
         row["cost_bytes_per_chunk"] = rep.cost["bytes_per_chunk"]
     if rep.cost.get("flops_per_chunk"):
         row["cost_flops_per_chunk"] = rep.cost["flops_per_chunk"]
+    rep_sum = rep.summary()
+    row["pipeline_depth"] = rep_sum.get("pipeline_depth", 0)
+    row["pipeline_stall_s"] = rep_sum.get("pipeline_stall_s", 0.0)
+    row["ckpt_wait_s"] = rep_sum.get("ckpt_wait_s", 0.0)
 
     # the detection lane (fakepta_tpu.detect): same flagship program with the
     # on-device optimal statistic packed beside curves/autos — measured

@@ -82,11 +82,18 @@ def _ensemble_rate(sim, nreal, chunk):
     out = sim.run(nreal, seed=1, chunk=chunk)
     rate = nreal / (time.perf_counter() - t0)
     rep = out["report"]
+    rep_sum = rep.summary()
     fields = {
         "compile_s": round(warm["report"].compile_s, 3),
         "steady_real_per_s_per_chip": round(
             rep.steady_real_per_s_per_chip(), 2),
         "retraces": rep.retraces,
+        # async chunk-pipeline overlap figures (bench.py docstring schema:
+        # executed depth, host time the dispatch loop waited on, checkpoint
+        # append time — both timings lower-is-better under `obs compare`)
+        "pipeline_depth": rep_sum.get("pipeline_depth", 0),
+        "pipeline_stall_s": rep_sum.get("pipeline_stall_s", 0.0),
+        "ckpt_wait_s": rep_sum.get("ckpt_wait_s", 0.0),
     }
     if rep.cost.get("bytes_per_chunk"):
         fields["cost_bytes_per_chunk"] = rep.cost["bytes_per_chunk"]

@@ -1,0 +1,134 @@
+"""donated-buffer-reuse: reading an array after it was donated to a jit.
+
+``jax.jit(..., donate_argnums=...)`` hands the argument's buffer to XLA for
+in-place reuse (the chunk pipeline recycles each drained chunk's packed
+output as the next dispatch's scratch this way, docs/PERFORMANCE.md). The
+caller's array is dead the moment the call dispatches: reading it afterwards
+raises ``RuntimeError: Array has been deleted`` on backends that honor the
+donation — and silently *works* on backends that don't, which is how the bug
+ships. Flags, in library code, any later read of a name that was passed at a
+donated positional slot of a function known (module-locally) to donate it,
+unless the name is re-bound first or the read sits in a diverging branch arm.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Set, Tuple
+
+from ..engine import Finding, ModuleContext
+from .common import (NameResolver, branch_paths, call_name, function_scopes,
+                     last_component, paths_diverge, walk_scope)
+
+RULE_ID = "donated-buffer-reuse"
+
+_JIT_NAMES = {"jit", "pjit"}
+
+
+def _literal_argnums(node: ast.AST):
+    """Resolve a donate_argnums literal (int or tuple of ints), else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (node.value,)
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if not (isinstance(elt, ast.Constant)
+                    and isinstance(elt.value, int)):
+                return None
+            out.append(elt.value)
+        return tuple(out)
+    return None
+
+
+def _donating_functions(tree: ast.AST,
+                        resolver: NameResolver) -> Dict[str, Tuple[int, ...]]:
+    """Map local callable names to their donated positional indices.
+
+    Detected forms: ``g = jax.jit(f, donate_argnums=...)`` (the bound name
+    ``g`` donates) and ``@jax.jit(donate_argnums=...)`` /
+    ``@partial(jax.jit, donate_argnums=...)`` decorators (the decorated
+    function's own name donates).
+    """
+
+    def donate_spec(call: ast.Call):
+        fn = resolver.resolve(call.func)
+        inner = call
+        if last_component(fn) == "partial" and call.args:
+            if last_component(resolver.resolve(call.args[0])) \
+                    not in _JIT_NAMES:
+                return None
+        elif last_component(fn) not in _JIT_NAMES:
+            return None
+        for kw in inner.keywords:
+            if kw.arg == "donate_argnums":
+                return _literal_argnums(kw.value)
+        return None
+
+    donors: Dict[str, Tuple[int, ...]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            spec = donate_spec(node.value)
+            if spec:
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        donors[t.id] = spec
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if isinstance(dec, ast.Call):
+                    spec = donate_spec(dec)
+                    if spec:
+                        donors[node.name] = spec
+    return donors
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    if not ctx.is_library:
+        return []   # tests deliberately poke deleted buffers to prove safety
+    resolver = NameResolver(ctx.tree)
+    donors = _donating_functions(ctx.tree, resolver)
+    if not donors:
+        return []
+    findings: List[Finding] = []
+    for scope in function_scopes(ctx.tree):
+        paths = branch_paths(scope)
+        # names stored anywhere in the scope, by line — a re-bind between
+        # the donating call and a later read stages a fresh buffer
+        stores: Dict[str, List[int]] = {}
+        loads: Dict[str, List[ast.Name]] = {}
+        for node in walk_scope(scope):
+            if isinstance(node, ast.Name):
+                if isinstance(node.ctx, ast.Store):
+                    stores.setdefault(node.id, []).append(node.lineno)
+                elif isinstance(node.ctx, ast.Load):
+                    loads.setdefault(node.id, []).append(node)
+        for node in walk_scope(scope):
+            if not isinstance(node, ast.Call):
+                continue
+            spec = donors.get(call_name(resolver, node))
+            if not spec:
+                continue
+            donated: Set[str] = set()
+            for idx in spec:
+                if idx < len(node.args) and \
+                        isinstance(node.args[idx], ast.Name):
+                    donated.add(node.args[idx].id)
+            for name in donated:
+                rebinds = [ln for ln in stores.get(name, [])
+                           if ln > node.lineno]
+                for use in loads.get(name, []):
+                    if use.lineno <= node.lineno:
+                        continue
+                    if any(ln <= use.lineno for ln in rebinds):
+                        continue   # re-bound first: a fresh buffer
+                    if paths_diverge(paths.get(id(node), ()),
+                                     paths.get(id(use), ())):
+                        continue   # mutually-exclusive branch arms
+                    findings.append(ctx.finding(
+                        RULE_ID, use,
+                        f"'{name}' was donated to "
+                        f"'{call_name(resolver, node)}' on line "
+                        f"{node.lineno} (donate_argnums) and its buffer may "
+                        f"already be reused in place; copy before the call "
+                        f"or re-stage a fresh array"))
+                    break   # one finding per (call, name): the first reuse
+    return sorted(set(findings))

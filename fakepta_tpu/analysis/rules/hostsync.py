@@ -7,6 +7,16 @@ value force a device->host sync (or a ConcretizationTypeError) inside a
 avoid exactly that), and in the best case it silently pins a constant at
 trace time. Flags those calls inside functions that are decorated with or
 wrapped by a jit-family transform (nested defs included).
+
+Second clause (library code only): a bare ``to_host(...)`` /
+``block_until_ready(...)`` inside a ``for``/``while`` loop body — the
+chunk-loop shape — serializes fetch behind compute on every iteration,
+which is exactly the stall the async pipeline exists to hide
+(docs/PERFORMANCE.md). The sanctioned path is structural: drains live in
+functions outside the loop (``montecarlo._drain_chunk``) and run on the
+pipeline's writer thread; a deliberate in-loop sync takes a pragma with its
+justification. Comprehensions are not flagged — a single post-loop gather
+(``[to_host(p) for p in out]``) is the intended final fetch.
 """
 
 from __future__ import annotations
@@ -15,7 +25,8 @@ import ast
 from typing import List
 
 from ..engine import Finding, ModuleContext
-from .common import NameResolver, call_name, jitted_functions
+from .common import (NameResolver, call_name, jitted_functions,
+                     last_component)
 
 RULE_ID = "host-sync-in-jit"
 
@@ -23,10 +34,43 @@ _HOST_CASTS = {"float", "int", "bool", "complex"}
 _HOST_METHODS = {"item", "tolist"}
 _NUMPY_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
 
+# blocking fetch/sync helpers that must not sit in a chunk-loop body:
+# the engine's to_host (parallel.mesh) and jax.block_until_ready (matched
+# as a bare call or a method on an array)
+_LOOP_SYNCS = {"to_host", "block_until_ready"}
+
+
+def _loop_sync_findings(ctx: ModuleContext,
+                        resolver: NameResolver) -> List[Finding]:
+    findings: List[Finding] = []
+    for loop in ast.walk(ctx.tree):
+        if not isinstance(loop, (ast.For, ast.While, ast.AsyncFor)):
+            continue
+        for node in ast.walk(loop):
+            if node is loop or not isinstance(node, ast.Call):
+                continue
+            name = call_name(resolver, node)
+            is_sync = last_component(name) in _LOOP_SYNCS if name else False
+            if not is_sync and isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "block_until_ready":
+                is_sync = True
+                name = node.func.attr
+            if is_sync:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{last_component(name)}() inside a loop body blocks "
+                    f"the dispatch loop on a device sync every iteration; "
+                    f"route the fetch through the async chunk pipeline's "
+                    f"writer (parallel/pipeline.py, copy_to_host_async + "
+                    f"drain) or pragma the deliberate sync"))
+    return findings
+
 
 def check(ctx: ModuleContext) -> List[Finding]:
     resolver = NameResolver(ctx.tree)
     findings: List[Finding] = []
+    if ctx.is_library:
+        findings.extend(_loop_sync_findings(ctx, resolver))
     for fn in jitted_functions(ctx.tree, resolver):
         for node in ast.walk(fn):
             if not isinstance(node, ast.Call):
@@ -52,4 +96,5 @@ def check(ctx: ModuleContext) -> List[Finding]:
                     RULE_ID, node,
                     f".{node.func.attr}() inside jitted '{fn.name}' is a "
                     f"blocking device->host sync; keep the value on device"))
-    return findings
+    # dedupe: nested loops walk the same call once per enclosing loop
+    return sorted(set(findings))

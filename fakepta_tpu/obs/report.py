@@ -90,6 +90,17 @@ class RunReport:
             m["cost_flops_per_chunk"] = self.cost["flops_per_chunk"]
         if self.memory.get("peak_bytes_in_use"):
             m["peak_bytes_in_use"] = self.memory["peak_bytes_in_use"]
+        if self.meta.get("pipeline_depth") is not None:
+            # the async chunk pipeline's overlap figures (docs/PERFORMANCE
+            # .md): stall_s is host work the dispatch actually waited on,
+            # ckpt_wait_s the checkpoint appends (overlapped on the writer
+            # thread when pipelined, inside the chunk wall when serial).
+            # Both are lower-is-better in `compare` — the default direction
+            m["pipeline_depth"] = int(self.meta["pipeline_depth"])
+            m["pipeline_stall_s"] = round(
+                sum(c.get("stall_s", 0.0) for c in self.chunks), 6)
+            m["ckpt_wait_s"] = round(
+                sum(c.get("ckpt_wait_s", 0.0) for c in self.chunks), 6)
         if self.meta.get("os"):
             # an OS-lane run: the same steady rate and chunk cost, under the
             # names bench.py / benchmarks rows carry for the detection lane —
@@ -226,8 +237,11 @@ def format_delta(a: RunReport, b: RunReport,
     # run-shape facts and distribution-scale diagnostics, not performance or
     # quality metrics — moving is information, not a regression (the infer
     # lane's lnL scale and grid size land here: a model change legitimately
-    # moves absolute lnL without being better or worse)
-    exempt = {"nreal", "chunks"}
+    # moves absolute lnL without being better or worse). The pipeline's
+    # overlap timings (pipeline_stall_s / ckpt_wait_s) stay REGRESSABLE and
+    # lower-is-better — the default direction — but the depth itself is a
+    # run-shape fact.
+    exempt = {"nreal", "chunks", "pipeline_depth"}
     exempt_suffixes = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                        "_null_q95", "_p_value_median", "_lnl_max_mean",
                        "_grid_k")

@@ -25,7 +25,9 @@ a few hundred MB regardless of the total realization count.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
+import threading
 import time
 from functools import partial
 from typing import Optional, Sequence, Tuple, Union
@@ -34,6 +36,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
+from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
 from .. import obs
@@ -41,6 +44,7 @@ from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
 from ..utils import rng as rng_utils
 from ..utils.compat import enable_x64, shard_map
+from . import pipeline as pipeline_mod
 from .mesh import PSR_AXIS, REAL_AXIS, TOA_AXIS, make_mesh, to_host
 
 # PulsarBatch fields whose LAST axis is the TOA dimension (shard over 'toa');
@@ -1068,7 +1072,7 @@ class EnsembleSimulator:
                  cgw=None, roemer=None, roemer_sample=None, ephem=None,
                  toas_abs=None, pdist=None, noise_sample=None,
                  cgw_sample=None, white_sample=None, toaerr2=None,
-                 backend_id=None, waveform=None):
+                 backend_id=None, waveform=None, compile_cache_dir=None):
         """``noise_sample`` takes :class:`NoiseSampling` config(s) — per-
         realization (log10_A, gamma) draws replacing the fixed PSD of the
         red/dm/chrom/gwb stages. ``use_pallas`` enables the fused statistic kernel
@@ -1081,7 +1085,15 @@ class EnsembleSimulator:
         — the same ~4e-3 pair-correlation bound); the angular-binning einsums
         are pinned to full f32 precision. Wrap construction AND the ``run``
         call in ``jax.default_matmul_precision('highest')`` for a full-f32
-        program at roughly half the matmul rate."""
+        program at roughly half the matmul rate.
+
+        ``compile_cache_dir`` wires jax's persistent compilation cache so
+        the chunk-program compile amortizes across processes and rounds
+        (the ``FAKEPTA_TPU_COMPILE_CACHE`` env var is the opt-in default;
+        see :func:`fakepta_tpu.parallel.pipeline.configure_compile_cache`
+        and :meth:`warm_start` for the AOT warm path, docs/PERFORMANCE.md).
+        """
+        pipeline_mod.configure_compile_cache(compile_cache_dir)
         self.mesh = mesh if mesh is not None else make_mesh(jax.devices()[:1])
         n_real_shards = self.mesh.shape[REAL_AXIS]
         n_psr_shards = self.mesh.shape[PSR_AXIS]
@@ -1522,27 +1534,30 @@ class EnsembleSimulator:
                 bulks = tuple(jnp.zeros((chunk, self.batch.npsr),
                                         self.batch.t_own.dtype)
                               for _ in self._cgw_psrterm)
+                # scratch=None: the cost capture measures the program's
+                # FLOPs/bytes, which donation aliasing does not change
                 if lnl is not None:
                     lnl_step, lnl_theta, _ = lnl
                     if fused:
                         lowered = lnl_step.lower(base_key, 0, chunk,
-                                                 lnl_theta, bulks)
+                                                 lnl_theta, bulks, None)
                     else:
                         lowered = lnl_step.lower(base_key, 0, chunk,
-                                                 lnl_theta, bulks, False)
+                                                 lnl_theta, bulks, None,
+                                                 False)
                 elif w_os is not None and fused:
                     lowered = self._get_step_fused_os(
                         int(w_os.shape[0]), with_null).lower(
-                            base_key, 0, chunk, w_os, bulks)
+                            base_key, 0, chunk, w_os, bulks, None)
                 elif w_os is not None:
                     lowered = self._get_step_os(with_null).lower(
-                        base_key, 0, chunk, w_os, bulks, False)
+                        base_key, 0, chunk, w_os, bulks, None, False)
                 elif fused:
                     lowered = self._step_fused.lower(
-                        base_key, 0, chunk, self._w_os_empty, bulks)
+                        base_key, 0, chunk, self._w_os_empty, bulks, None)
                 else:
                     lowered = self._step.lower(base_key, 0, chunk, bulks,
-                                               False)
+                                               None, False)
                 compiled = lowered.compile()
                 ca = compiled.cost_analysis()
                 ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
@@ -1777,10 +1792,20 @@ class EnsembleSimulator:
     def _build_step(self):
         shmapped = self._make_corr_sharded(False)
 
-        @partial(jax.jit, static_argnums=(2, 4))
-        def step(base_key, offset, nreal, cgw_bulks, with_corr=False):
+        # ``scratch`` is the donated output-recycling buffer (the pipelined
+        # run loop hands back a drained chunk's packed array): same shape,
+        # dtype and sharding as the packed output, so XLA aliases the two and
+        # the executable writes in place — one packed buffer per in-flight
+        # chunk instead of one per dispatch. keep_unused keeps the (otherwise
+        # dataflow-dead) parameter alive so the aliasing can attach; None
+        # disables donation (the serial path and direct step calls).
+        @partial(jax.jit, static_argnums=(2, 5), donate_argnums=(4,),
+                 keep_unused=True)
+        def step(base_key, offset, nreal, cgw_bulks, scratch,
+                 with_corr=False):
             # trace-time only: the retrace guard (see _obs_note_trace)
-            self._obs_note_trace(("step", nreal, with_corr))
+            self._obs_note_trace(("step", nreal, with_corr,
+                                  scratch is not None))
             # per-realization keys derived on device: one tiny transfer per chunk
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
@@ -1813,12 +1838,16 @@ class EnsembleSimulator:
         """
         shmapped = self._make_corr_sharded(with_null)
 
-        @partial(jax.jit, static_argnums=(2, 5))
-        def step(base_key, offset, nreal, w_os, cgw_bulks, with_corr=False):
+        # scratch: donated packed-output recycling buffer (see _build_step)
+        @partial(jax.jit, static_argnums=(2, 6), donate_argnums=(5,),
+                 keep_unused=True)
+        def step(base_key, offset, nreal, w_os, cgw_bulks, scratch,
+                 with_corr=False):
             # trace-time only: the retrace guard (see _obs_note_trace)
             # w_os.shape[0] is a static Python int at trace time
             self._obs_note_trace(("step_os", nreal, w_os.shape[0],
-                                  with_null, with_corr))
+                                  with_null, with_corr,
+                                  scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             out = shmapped(keys, self.batch, self._chol, self._gwb_w,
@@ -1939,10 +1968,13 @@ class EnsembleSimulator:
             check_vma=False,
         )
 
-        @partial(jax.jit, static_argnums=(2,))
-        def step(base_key, offset, nreal, w_os, cgw_bulks):
+        # scratch: donated packed-output recycling buffer (see _build_step)
+        @partial(jax.jit, static_argnums=(2,), donate_argnums=(5,),
+                 keep_unused=True)
+        def step(base_key, offset, nreal, w_os, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
-            self._obs_note_trace(("step_fused", nreal, n_os, with_null))
+            self._obs_note_trace(("step_fused", nreal, n_os, with_null,
+                                  scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             if n_os:
@@ -2086,12 +2118,15 @@ class EnsembleSimulator:
                 out_specs=(P(REAL_AXIS, PSR_AXIS), P(REAL_AXIS)),
             )
 
-            @partial(jax.jit, static_argnums=(2, 5))
-            def step(base_key, offset, nreal, theta, cgw_bulks,
+            # scratch: donated packed-output recycling (see _build_step)
+            @partial(jax.jit, static_argnums=(2, 6), donate_argnums=(5,),
+                     keep_unused=True)
+            def step(base_key, offset, nreal, theta, cgw_bulks, scratch,
                      with_corr=False):
                 # trace-time only: the retrace guard (see _obs_note_trace)
                 self._obs_note_trace(("step_lnlike", nreal, theta.shape,
-                                      mode, with_corr))
+                                      mode, with_corr,
+                                      scratch is not None))
                 keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                     offset + jnp.arange(nreal))
                 corr, lanes = shmapped(
@@ -2148,11 +2183,13 @@ class EnsembleSimulator:
             check_vma=False,
         )
 
-        @partial(jax.jit, static_argnums=(2,))
-        def step(base_key, offset, nreal, theta, cgw_bulks):
+        # scratch: donated packed-output recycling buffer (see _build_step)
+        @partial(jax.jit, static_argnums=(2,), donate_argnums=(5,),
+                 keep_unused=True)
+        def step(base_key, offset, nreal, theta, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_fused_lnlike", nreal, theta.shape,
-                                  mode))
+                                  mode, scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             curves, autos, lanes = shmapped(
@@ -2172,8 +2209,172 @@ class EnsembleSimulator:
             self._step_lnlike_cache[key] = step
         return step
 
+    def _prepare_lanes(self, os, lnlike) -> dict:
+        """Resolve the optional packed statistic lanes a run carries.
+
+        The OS lane's host-f64 operator precompute (:mod:`fakepta_tpu
+        .detect.operators`) and the lnlike lane's compiled model
+        (:mod:`fakepta_tpu.infer.model`) — shared by :meth:`run` and
+        :meth:`warm_start` so the two select the identical step executable.
+        """
+        lanes = dict(os_spec=None, os_ops=None, w_os=None, n_os=0,
+                     lnl_spec=None, lnl_compiled=None, lnl_theta=None,
+                     lnl_k=0, lnl_l=0, n_extra=0)
+        if lnlike is not None:
+            if os is not None:
+                raise ValueError(
+                    "run(os=..., lnlike=...) cannot combine the detection "
+                    "and likelihood lanes in one run (one packed-extras "
+                    "layout per run); run them separately")
+            from ..infer import model as infer_model
+            lnl_spec = infer_model.as_spec(lnlike)
+            lnl_compiled = self._lnlike_compiled_cache.get(lnl_spec.model)
+            if lnl_compiled is None:
+                lnl_compiled = infer_model.build(lnl_spec.model, self.batch)
+                self._lnlike_compiled_cache[lnl_spec.model] = lnl_compiled
+            theta_host = lnl_compiled.validate_theta(lnl_spec.theta)
+            lanes["lnl_spec"] = lnl_spec
+            lanes["lnl_compiled"] = lnl_compiled
+            lanes["lnl_theta"] = jnp.asarray(theta_host,
+                                             self.batch.t_own.dtype)
+            lanes["lnl_k"] = theta_host.shape[0]
+            lanes["lnl_l"] = infer_model.lanes_per_point(lnl_spec.mode,
+                                                         lnl_compiled.D)
+            lanes["n_extra"] = lanes["lnl_k"] * lanes["lnl_l"]
+        if os is not None:
+            from ..detect import operators as detect_ops
+            os_spec = detect_ops.as_spec(os)
+            os_ops = detect_ops.build_operators(
+                os_spec, self._pos64, np.asarray(self.batch.mask),
+                np.asarray(self.batch.sigma2), pair_counts=self.pair_counts)
+            lanes["os_spec"] = os_spec
+            lanes["os_ops"] = os_ops
+            lanes["w_os"] = jnp.asarray(
+                np.stack([op.weights for op in os_ops]),
+                self.batch.t_own.dtype)
+            lanes["n_os"] = len(os_ops)
+            lanes["n_extra"] = lanes["n_os"] * (2 if os_spec.null else 1)
+        return lanes
+
+    def _normalize_chunk(self, chunk: int, nreal: int) -> int:
+        """Clamp the chunk size to the realization-shard contract."""
+        chunk = int(min(chunk, nreal))
+        chunk -= chunk % self._n_real_shards
+        return max(chunk, self._n_real_shards)
+
+    def _drain_chunk(self, packed, corr, rec, packed_out, slot, corr_out,
+                     ckpt, seed, nreal, chunk, done, progress, nb, n_extra,
+                     materialize, ev=None):
+        """Host-side completion work for ONE dispatched chunk.
+
+        Runs on the pipeline's writer thread (pipelined runs) or inline at
+        submit (the serial fallback), in the serial loop's exact order:
+        materialize outputs -> append the checkpoint chunk (process 0 only)
+        -> invoke the progress callback. ``materialize`` forces the packed
+        lanes onto the host; the copy is forced (``np.array``) because the
+        pipelined loop recycles the device buffer as a donated scratch for
+        a later chunk, and ``np.asarray`` of a CPU-backend array can be a
+        zero-copy view into that very buffer. ``rec['ckpt_wait_s']`` records
+        the checkpoint append (inline in the chunk wall on the serial path;
+        overlapped with device compute when pipelined). ``ev`` (pipelined
+        only) signals the dispatch loop that this chunk's buffers are free
+        to recycle — set even on failure so the loop cannot deadlock.
+        """
+        try:
+            if materialize:
+                arr = np.array(to_host(packed))
+                packed_out[slot] = arr
+            else:
+                arr = None
+                packed_out[slot] = packed
+            if corr_out is not None:
+                corr_out[slot] = to_host(corr)
+            if ckpt is not None and jax.process_index() == 0:
+                # append-only: each save writes this chunk's arrays,
+                # O(chunk) I/O. Only process 0 writes — to_host replicates
+                # outputs to every host, and concurrent renames of the same
+                # checkpoint files from N processes would race on shared
+                # storage.
+                if arr is None:
+                    arr = to_host(packed)
+                    packed_out[slot] = arr
+                t_ck = time.perf_counter()
+                c_chunk, a_chunk = unpack_stats(arr, nb)
+                ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
+                          corr_out[slot] if corr_out is not None else None,
+                          extra=(arr[:, nb + 1:] if n_extra else None))
+                rec["ckpt_wait_s"] = time.perf_counter() - t_ck
+            if progress is not None:
+                if arr is None:
+                    jax.block_until_ready(packed)  # completion, not dispatch
+                progress(min(done, nreal), nreal)
+        finally:
+            if ev is not None:
+                ev.set()
+
+    def warm_start(self, chunk: int, *, keep_corr: bool = False, os=None,
+                   lnlike=None) -> float:
+        """AOT-compile the chunk program ahead of the first :meth:`run`.
+
+        Lowers and compiles the exact step executable ``run(chunk=chunk,
+        ...)`` would dispatch for this lane configuration (same shapes,
+        same donated-scratch aliasing), without executing it. With the
+        persistent compile cache wired (``compile_cache_dir=`` /
+        ``FAKEPTA_TPU_COMPILE_CACHE``), the executable lands in the on-disk
+        cache, so the first run() chunk — in this process and in every other
+        process or later round sharing the cache dir — loads it instead of
+        recompiling, and the obs-measured ``compile_s`` amortizes instead of
+        being paid per process. Returns the wall seconds spent.
+        """
+        t0 = time.perf_counter()
+        chunk = self._normalize_chunk(chunk, chunk)
+        lanes = self._prepare_lanes(os, lnlike)
+        fused = self._step_fused is not None and not keep_corr
+        base = rng_utils.as_key(0)
+        dtype = self.batch.t_own.dtype
+        n_lanes = self.nbins + 1 + lanes["n_extra"]
+        bulks = tuple(jax.ShapeDtypeStruct((chunk, self.batch.npsr), dtype)
+                      for _ in self._cgw_psrterm)
+        scratch = jax.ShapeDtypeStruct(
+            (chunk, n_lanes), dtype,
+            sharding=NamedSharding(self.mesh, P(REAL_AXIS)))
+        prev = self._obs_in_capture
+        self._obs_in_capture = True     # an AOT lower is not a user retrace
+        try:
+            if lanes["lnl_compiled"] is not None:
+                step = self._get_step_lnlike(
+                    lanes["lnl_spec"].model, lanes["lnl_spec"].mode, fused,
+                    lanes["lnl_compiled"])
+                if fused:
+                    lowered = step.lower(base, 0, chunk, lanes["lnl_theta"],
+                                         bulks, scratch)
+                else:
+                    lowered = step.lower(base, 0, chunk, lanes["lnl_theta"],
+                                         bulks, scratch, keep_corr)
+            elif lanes["os_ops"] is not None:
+                null = lanes["os_spec"].null
+                if fused:
+                    lowered = self._get_step_fused_os(
+                        lanes["n_os"], null).lower(
+                            base, 0, chunk, lanes["w_os"], bulks, scratch)
+                else:
+                    lowered = self._get_step_os(null).lower(
+                        base, 0, chunk, lanes["w_os"], bulks, scratch,
+                        keep_corr)
+            elif fused:
+                lowered = self._step_fused.lower(
+                    base, 0, chunk, self._w_os_empty, bulks, scratch)
+            else:
+                lowered = self._step.lower(base, 0, chunk, bulks, scratch,
+                                           keep_corr)
+            lowered.compile()
+        finally:
+            self._obs_in_capture = prev
+        return time.perf_counter() - t0
+
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
-            checkpoint=None, progress=None, os=None, lnlike=None):
+            checkpoint=None, progress=None, os=None, lnlike=None,
+            pipeline_depth: int = 2):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
@@ -2221,11 +2422,32 @@ class EnsembleSimulator:
         (the reference's observability is print statements; this is the hook for
         logging/metrics without baking a sink in).
 
+        ``pipeline_depth``: how many dispatched chunks may be in flight
+        before the loop waits for the oldest one's host drain (default 2 —
+        one chunk computing while the previous drains). Under the pipeline
+        the per-chunk host work overlaps device compute: the next chunk's
+        CGW bulks precompute while this one runs, checkpoint appends and
+        progress callbacks drain on a single background writer thread
+        (order and append-only/process-0 semantics unchanged), packed
+        outputs stream back via ``copy_to_host_async``, and each drained
+        chunk's packed buffer is recycled as the donated scratch of a later
+        dispatch (``donate_argnums``), so peak HBM holds ``depth`` packed
+        buffers regardless of the chunk count. ``pipeline_depth=0`` is the
+        serial fallback (the pre-pipeline loop, one sync per chunk when
+        checkpointing); multi-process runs always take it, because a
+        background thread issuing ``process_allgather`` collectives could
+        reorder collective launches across processes. Realization streams
+        are bit-identical at every depth. See docs/PERFORMANCE.md.
+
         Every run attaches a :class:`fakepta_tpu.obs.RunReport` under
         ``out["report"]`` (also ``self.last_report``): stage spans, per-chunk
         wall times (``synced`` marks chunks whose wall time included a device
-        sync — checkpoint/progress runs; otherwise chunk walls are dispatch
-        times and ``total_s`` is the device-synced end-to-end figure), the
+        sync — serial checkpoint/progress runs; pipelined chunk walls are
+        dispatch times and ``total_s`` is the device-synced end-to-end
+        figure), per-chunk ``stall_s`` (dispatch waited on host work:
+        first-chunk staging, depth-bound waits) and ``ckpt_wait_s`` (the
+        checkpoint append — inside the chunk wall on the serial path,
+        overlapped on the writer thread when pipelined), the
         compile-vs-steady split from the ``jax.monitoring`` bridge, the
         retrace-guard count, one-time XLA cost analysis of the chunk program,
         and device-memory stats where the backend exposes them. All hooks are
@@ -2238,47 +2460,21 @@ class EnsembleSimulator:
         retraces_before = self._obs_retraces
         chunk_records = []
         base = rng_utils.as_key(seed)
-        chunk = int(min(chunk, nreal))
-        chunk -= chunk % self._n_real_shards
-        chunk = max(chunk, self._n_real_shards)
+        chunk = self._normalize_chunk(chunk, nreal)
         packed_out, corr_out = [], []
         nb = self.nbins
         done = 0
 
-        # the OS lane: host-f64 operator precompute (detect.operators), one
-        # (P, P) weight matrix per ORF stacked into the step's w_os input
-        os_spec, os_ops, w_os, n_os, n_extra = None, None, None, 0, 0
-        # the lnlike lane: model compiled against the batch (fakepta_tpu
-        # .infer), theta staged once to device at the batch dtype
-        lnl_spec, lnl_compiled, lnl_theta, lnl_k, lnl_l = None, None, None, 0, 0
-        if lnlike is not None:
-            if os is not None:
-                raise ValueError(
-                    "run(os=..., lnlike=...) cannot combine the detection "
-                    "and likelihood lanes in one run (one packed-extras "
-                    "layout per run); run them separately")
-            from ..infer import model as infer_model
-            lnl_spec = infer_model.as_spec(lnlike)
-            lnl_compiled = self._lnlike_compiled_cache.get(lnl_spec.model)
-            if lnl_compiled is None:
-                lnl_compiled = infer_model.build(lnl_spec.model, self.batch)
-                self._lnlike_compiled_cache[lnl_spec.model] = lnl_compiled
-            theta_host = lnl_compiled.validate_theta(lnl_spec.theta)
-            lnl_theta = jnp.asarray(theta_host, self.batch.t_own.dtype)
-            lnl_k = theta_host.shape[0]
-            lnl_l = infer_model.lanes_per_point(lnl_spec.mode,
-                                                lnl_compiled.D)
-            n_extra = lnl_k * lnl_l
-        if os is not None:
-            from ..detect import operators as detect_ops
-            os_spec = detect_ops.as_spec(os)
-            os_ops = detect_ops.build_operators(
-                os_spec, self._pos64, np.asarray(self.batch.mask),
-                np.asarray(self.batch.sigma2), pair_counts=self.pair_counts)
-            w_os = jnp.asarray(np.stack([op.weights for op in os_ops]),
-                               self.batch.t_own.dtype)
-            n_os = len(os_ops)
-            n_extra = n_os * (2 if os_spec.null else 1)
+        # the OS lane's host-f64 operator precompute / the lnlike lane's
+        # compiled model + staged theta (shared with warm_start)
+        lanes = self._prepare_lanes(os, lnlike)
+        os_spec, os_ops, w_os, n_os = (lanes["os_spec"], lanes["os_ops"],
+                                       lanes["w_os"], lanes["n_os"])
+        lnl_spec, lnl_compiled, lnl_theta = (lanes["lnl_spec"],
+                                             lanes["lnl_compiled"],
+                                             lanes["lnl_theta"])
+        lnl_k, lnl_l, n_extra = lanes["lnl_k"], lanes["lnl_l"], \
+            lanes["n_extra"]
 
         ckpt = None
         if checkpoint is not None:
@@ -2301,83 +2497,131 @@ class EnsembleSimulator:
                     corr_out.append(state["corr"])
 
         fused = self._step_fused is not None and not keep_corr
-        # Per-chunk host materialization is only needed when somebody consumes
-        # host data mid-run (checkpointing). Otherwise chunks stay device-side:
-        # the jitted steps dispatch asynchronously, so the loop pipelines all
-        # chunks' compute, and the packed outputs are fetched once at the end —
-        # device->host round-trips through the remote-TPU tunnel cost ~80 ms
-        # flat each, which dominated the chunk time before this.
-        sync_each = ckpt is not None
-        with obs.collect(collector):
-            while done < nreal:
-                t_chunk0 = time.perf_counter()
-                # every step runs at the full chunk size (the final one
-                # overshoots and is truncated below): the steps are jitted
-                # with a static realization count, so a smaller tail chunk
-                # would recompile the SPMD program
-                bulks = self._host_cgw_bulks(base, done, chunk)
-                if lnl_compiled is not None:
-                    lnl_step = self._get_step_lnlike(
-                        lnl_spec.model, lnl_spec.mode, fused, lnl_compiled)
-                    if fused:
-                        packed = lnl_step(base, done, chunk, lnl_theta,
-                                          bulks)
-                    elif keep_corr:
-                        packed, corr = lnl_step(base, done, chunk, lnl_theta,
-                                                bulks, True)
-                        corr_out.append(to_host(corr))
-                    else:
-                        packed = lnl_step(base, done, chunk, lnl_theta,
-                                          bulks, False)
-                elif os_ops is not None:
-                    if fused:
-                        packed = self._get_step_fused_os(n_os, os_spec.null)(
-                            base, done, chunk, w_os, bulks)
-                    elif keep_corr:
-                        packed, corr = self._get_step_os(os_spec.null)(
-                            base, done, chunk, w_os, bulks, True)
-                        corr_out.append(to_host(corr))
-                    else:
-                        packed = self._get_step_os(os_spec.null)(
-                            base, done, chunk, w_os, bulks, False)
-                elif fused:
-                    packed = self._step_fused(base, done, chunk,
-                                              self._w_os_empty, bulks)
-                else:
+        # The chunk executor (fakepta_tpu.parallel.pipeline): dispatches are
+        # async either way; the *pipelined* loop additionally (a) precomputes
+        # the NEXT chunk's CGW bulks while this one computes, (b) drains all
+        # per-chunk host work (materialize / checkpoint append / progress) on
+        # one background writer thread in FIFO order, and (c) recycles each
+        # drained chunk's packed buffer as the donated scratch of a later
+        # dispatch — the drained-event wait on the recycling ring IS the
+        # depth bound. The serial fallback (depth 0 / multi-process) keeps
+        # the pre-pipeline semantics: one blocking sync per chunk when a
+        # checkpoint or progress consumer needs host data, device->host
+        # round-trips otherwise deferred to the single final fetch (~80 ms
+        # flat each through a remote-TPU tunnel).
+        depth = max(int(pipeline_depth), 0)
+        pipelined = depth > 0 and jax.process_count() == 1
+        ring: collections.deque = collections.deque()   # (packed, drained ev)
+        ring_size = max(depth, 1)
+        sync_each = ckpt is not None and not pipelined
+        n_lanes = nb + 1 + n_extra
+        dtype = self.batch.t_own.dtype
+        scratch_sharding = NamedSharding(self.mesh, P(REAL_AXIS))
+
+        def dispatch(offset, bulks, scratch):
+            """One async chunk dispatch -> (packed, corr-or-None)."""
+            if lnl_compiled is not None:
+                lnl_step = self._get_step_lnlike(
+                    lnl_spec.model, lnl_spec.mode, fused, lnl_compiled)
+                if fused:
+                    return lnl_step(base, offset, chunk, lnl_theta, bulks,
+                                    scratch), None
+                if keep_corr:
+                    return lnl_step(base, offset, chunk, lnl_theta, bulks,
+                                    scratch, True)
+                return lnl_step(base, offset, chunk, lnl_theta, bulks,
+                                scratch, False), None
+            if os_ops is not None:
+                if fused:
+                    return self._get_step_fused_os(n_os, os_spec.null)(
+                        base, offset, chunk, w_os, bulks, scratch), None
+                if keep_corr:
+                    return self._get_step_os(os_spec.null)(
+                        base, offset, chunk, w_os, bulks, scratch, True)
+                return self._get_step_os(os_spec.null)(
+                    base, offset, chunk, w_os, bulks, scratch, False), None
+            if fused:
+                return self._step_fused(base, offset, chunk,
+                                        self._w_os_empty, bulks,
+                                        scratch), None
+            if keep_corr:
+                return self._step(base, offset, chunk, bulks, scratch, True)
+            return self._step(base, offset, chunk, bulks, scratch,
+                              False), None
+
+        # chunk 0's staged host inputs are the one precompute the first
+        # dispatch genuinely waits on (recorded as its stall_s); every later
+        # chunk's bulks precompute below, overlapped with device execution
+        t_pre0 = time.perf_counter()
+        bulks = self._host_cgw_bulks(base, done, chunk)
+        pre_stall = time.perf_counter() - t_pre0
+        # created last before the loop so no earlier failure leaks the thread
+        writer = pipeline_mod.make_writer(pipelined)
+        try:
+            with obs.collect(collector):
+                while done < nreal:
+                    t_chunk0 = time.perf_counter()
+                    # every step runs at the full chunk size (the final one
+                    # overshoots and is truncated below): the steps are
+                    # jitted with a static realization count, so a smaller
+                    # tail chunk would recompile the SPMD program
+                    rec = {"idx": len(chunk_records), "wall_s": 0.0,
+                           "stall_s": pre_stall, "ckpt_wait_s": 0.0,
+                           "synced": bool(sync_each or (
+                               not pipelined
+                               and ((keep_corr and not fused)
+                                    or progress is not None)))}
+                    pre_stall = 0.0
+                    scratch = None
+                    if pipelined:
+                        if len(ring) >= ring_size:
+                            # depth bound + donation: wait for the oldest
+                            # in-flight chunk's drain, then hand its packed
+                            # buffer to this dispatch as donated scratch
+                            prev_packed, ev = ring.popleft()
+                            t_wait = time.perf_counter()
+                            ev.wait()
+                            rec["stall_s"] += time.perf_counter() - t_wait
+                            scratch = prev_packed
+                        else:
+                            scratch = jax.device_put(
+                                np.zeros((chunk, n_lanes), dtype),
+                                scratch_sharding)
+                    packed, corr = dispatch(done, bulks, scratch)
+                    collector.count("pipeline.d2h_async",
+                                    pipeline_mod.start_d2h(packed, corr))
+                    done += chunk
+                    this_done = done
+                    if done < nreal:
+                        # the NEXT chunk's host-f64 staging overlaps this
+                        # chunk's device execution (the dispatch above
+                        # returned immediately)
+                        bulks = self._host_cgw_bulks(base, done, chunk)
+                        if self._cgw_psrterm:
+                            collector.count("pipeline.h2d_prefetch")
+                    slot = len(packed_out)
+                    packed_out.append(None)
                     if keep_corr:
-                        packed, corr = self._step(base, done, chunk, bulks,
-                                                  True)
-                        corr_out.append(to_host(corr))
+                        corr_out.append(None)
+                    ev = threading.Event()
+                    drain = partial(
+                        self._drain_chunk, packed, corr, rec, packed_out,
+                        slot, corr_out if keep_corr else None, ckpt, seed,
+                        nreal, chunk, this_done, progress, nb, n_extra,
+                        pipelined or sync_each, ev)
+                    if pipelined:
+                        rec["stall_s"] += writer.submit(drain, ev.set)
+                        ring.append((packed, ev))
                     else:
-                        packed = self._step(base, done, chunk, bulks, False)
-                if sync_each:
-                    packed = to_host(packed)
-                elif hasattr(packed, "copy_to_host_async"):
-                    packed.copy_to_host_async()  # overlap fetch with compute
-                packed_out.append(packed)
-                done += chunk
-                if ckpt is not None and jax.process_index() == 0:
-                    # append-only: each save writes this chunk's arrays,
-                    # O(chunk) I/O. Only process 0 writes — to_host replicates
-                    # outputs to every host, and concurrent renames of the
-                    # same checkpoint files from N processes would race on
-                    # shared storage
-                    c_chunk, a_chunk = unpack_stats(packed_out[-1], nb)
-                    ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
-                              corr_out[-1] if keep_corr else None,
-                              extra=(packed_out[-1][:, nb + 1:]
-                                     if n_extra else None))
-                if progress is not None:
-                    if not sync_each:
-                        jax.block_until_ready(packed)  # completion, not dispatch
-                    progress(min(done, nreal), nreal)
-                chunk_records.append({
-                    "idx": len(chunk_records),
-                    "wall_s": time.perf_counter() - t_chunk0,
-                    "synced": bool(sync_each or (keep_corr and not fused)
-                                   or progress is not None),
-                })
-            packed_h = np.concatenate([to_host(p) for p in packed_out])[:nreal]
+                        writer.submit(drain)
+                    rec["wall_s"] = time.perf_counter() - t_chunk0
+                    chunk_records.append(rec)
+                writer.close()
+                packed_h = np.concatenate(
+                    [to_host(p) for p in packed_out])[:nreal]
+        except BaseException:
+            writer.abort()
+            raise
         total_s = time.perf_counter() - t_run0   # final fetch = device-synced
         curves_h, autos_h = unpack_stats(packed_h, nb)
         out = {
@@ -2413,6 +2657,9 @@ class EnsembleSimulator:
             "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
             "npsr": int(self.batch.npsr),
             "max_toa": int(self.batch.max_toa),
+            # the depth the run actually executed at (0 = serial fallback,
+            # forced for multi-process runs regardless of the kwarg)
+            "pipeline_depth": int(depth if pipelined else 0),
         }
         if isinstance(seed, (int, np.integer)):
             meta["seed"] = int(seed)

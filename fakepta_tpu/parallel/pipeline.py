@@ -1,0 +1,193 @@
+"""Asynchronous bounded-depth chunk pipeline (docs/PERFORMANCE.md).
+
+The chunk loop of :meth:`EnsembleSimulator.run` is memory/latency-bound, not
+FLOP-bound (BASELINE round 5: 7.1 FLOP/B against a v5e ridge of 240), so the
+throughput win left on the table is hiding everything that is *not* the chunk
+program: host precompute of the next chunk's staged inputs, checkpoint I/O,
+progress syncs, and device->host fetches. This module holds the host-side
+machinery the run loop pipelines through:
+
+- a **single background writer thread** draining a FIFO of per-chunk drain
+  thunks (materialize outputs via the already-started ``copy_to_host_async``,
+  append the checkpoint chunk, invoke the progress callback) in the serial
+  loop's exact order — checkpoint semantics are unchanged: append-only,
+  process-0-only, resume-compatible with the existing manifest;
+- an **inline writer** with the same interface for the serial fallback
+  (``run(pipeline_depth=0)``) and for multi-process runs, where a background
+  thread issuing ``process_allgather`` collectives could reorder collective
+  launches across processes and deadlock the pod;
+- the **persistent compile cache** wiring (``FAKEPTA_TPU_COMPILE_CACHE`` env
+  var / ``EnsembleSimulator(compile_cache_dir=...)``) so the obs-measured
+  ``compile_s`` amortizes across processes and rounds instead of being paid
+  per process.
+
+Exceptions raised by a drain (a checkpoint write failing, a progress callback
+aborting the run) propagate to the ``run()`` caller exactly as in the serial
+loop: the writer records the first exception, skips the remaining queued
+drains (matching the serial loop's abort-at-failure semantics), and re-raises
+it at the next ``submit``/``close``. Depth bounding and donated-buffer
+recycling live in the run loop itself (see montecarlo.run), which hands each
+chunk's previous packed output back to the jitted step as a donated scratch
+buffer once its drain has materialized it.
+"""
+
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+import jax
+
+# opt-in env var for the persistent XLA compile cache; the kwarg
+# EnsembleSimulator(compile_cache_dir=...) takes precedence
+COMPILE_CACHE_ENV = "FAKEPTA_TPU_COMPILE_CACHE"
+
+_STOP = object()
+
+
+def configure_compile_cache(path=None) -> Optional[str]:
+    """Wire jax's persistent compilation cache (opt-in, idempotent).
+
+    ``path`` wins; otherwise the ``FAKEPTA_TPU_COMPILE_CACHE`` env var is
+    honored; with neither set this is a no-op (returns None). The thresholds
+    are dropped to zero so even the fast CPU-mesh compiles of tests and
+    small runs persist — the flagship chunk program's multi-second compile
+    then loads from disk on every later process/round instead of recompiling
+    (the AOT warm-start :meth:`EnsembleSimulator.warm_start` populates the
+    same cache ahead of the first run).
+    """
+    if path is None:
+        path = os.environ.get(COMPILE_CACHE_ENV)
+    if not path:
+        return None
+    path = str(path)
+    jax.config.update("jax_compilation_cache_dir", path)
+    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
+        try:
+            jax.config.update(opt, val)
+        except Exception:
+            pass   # knob missing in this jax version; the cache still works
+    try:
+        # jax memoizes the cache-used decision at the FIRST compile of the
+        # process; a sim constructed after any compile would silently get no
+        # cache without this re-evaluation
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+    except Exception:
+        pass
+    return path
+
+
+class InlineWriter:
+    """Degenerate writer: drains run synchronously at submit time.
+
+    The serial fallback (``pipeline_depth=0``) and the multi-process path —
+    a background thread issuing collectives (``process_allgather`` inside
+    ``to_host``) could interleave with the main thread's chunk dispatches in
+    a different order on different processes, which deadlocks multi-host
+    collectives; inline drains keep the per-process launch order identical.
+    """
+
+    pipelined = False
+
+    def submit(self, drain: Callable[[], None]) -> float:
+        drain()
+        return 0.0
+
+    def close(self) -> None:
+        pass
+
+    def abort(self) -> None:
+        pass
+
+
+class ThreadWriter:
+    """One background thread draining per-chunk thunks in FIFO order.
+
+    The queue is unbounded — in-flight depth is bounded by the run loop's
+    donated-buffer ring (the dispatch of chunk ``i`` waits for chunk
+    ``i - depth``'s drain before reusing its output buffer), so the queue
+    never grows past ``depth + 1`` entries in practice. The first exception a
+    drain raises is recorded, the remaining queued drains are *cancelled*
+    (their completion events still fire so the dispatch loop cannot
+    deadlock), and the exception re-raises at the next ``submit``/``close``
+    — the pipelined analog of the serial loop aborting mid-run.
+    """
+
+    pipelined = True
+
+    def __init__(self):
+        self._q: "queue.Queue" = queue.Queue()
+        self._exc: Optional[BaseException] = None
+        self._thread = threading.Thread(
+            target=self._loop, name="fakepta-chunk-writer", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is _STOP:
+                return
+            drain, cancel = item
+            if self._exc is None:
+                try:
+                    drain()
+                except BaseException as exc:   # noqa: BLE001 — re-raised
+                    self._exc = exc            # in the dispatch thread
+                    cancel()
+            else:
+                cancel()
+
+    def submit(self, drain: Callable[[], None],
+               cancel: Callable[[], None] = lambda: None) -> float:
+        """Enqueue a drain; returns seconds blocked (0 — unbounded queue).
+
+        Raises the writer's pending exception instead of enqueueing more
+        work, so the dispatch loop stops at most one chunk after a failure.
+        """
+        self._raise_pending()
+        t0 = time.perf_counter()
+        self._q.put((drain, cancel))
+        return time.perf_counter() - t0
+
+    def _raise_pending(self) -> None:
+        if self._exc is not None:
+            exc, self._exc = self._exc, None
+            raise exc
+
+    def close(self) -> None:
+        """Flush the queue, join the thread, re-raise any drain exception."""
+        self._q.put(_STOP)
+        self._thread.join()
+        self._raise_pending()
+
+    def abort(self) -> None:
+        """Stop the thread without re-raising (error-path cleanup)."""
+        self._q.put(_STOP)
+        self._thread.join(timeout=60.0)
+        self._exc = None
+
+
+def make_writer(pipelined: bool):
+    """The writer the run loop drains through: threaded iff pipelined."""
+    return ThreadWriter() if pipelined else InlineWriter()
+
+
+def start_d2h(*arrays) -> int:
+    """Start non-blocking device->host copies; returns how many were issued.
+
+    ``jax.Array.copy_to_host_async`` overlaps the transfer with subsequent
+    device work; the later ``to_host``/``np.asarray`` then only waits for
+    completion instead of serializing fetch behind compute. Host/numpy
+    inputs (and jax builds without the method) are skipped.
+    """
+    n = 0
+    for x in arrays:
+        if x is not None and hasattr(x, "copy_to_host_async"):
+            x.copy_to_host_async()
+            n += 1
+    return n

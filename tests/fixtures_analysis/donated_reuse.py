@@ -1,0 +1,50 @@
+"""Seeded donated-buffer-reuse violations (library placement)."""
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+def _impl(x, scratch):
+    return x * 2.0
+
+
+step = jax.jit(_impl, donate_argnums=(1,))
+
+
+def bad_reuse(x):
+    buf = jnp.zeros((4,))
+    out = step(x, buf)
+    return out + buf                     # line 18: read after donation
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def consume(b):
+    return b.sum()
+
+
+def bad_decorated(b):
+    s = consume(b)
+    return s + b.mean()                  # line 28: read after donation
+
+
+def ok_rebound(x):
+    buf = jnp.zeros((4,))
+    out = step(x, buf)
+    buf = jnp.ones((4,))                 # re-staged: a fresh buffer
+    return out + buf
+
+
+def ok_diverging(x, flag):
+    buf = jnp.zeros((4,))
+    if flag:
+        out = step(x, buf)
+    else:
+        out = buf * 1.0                  # other branch arm: no donation ran
+    return out
+
+
+def ok_not_donated(x):
+    buf = jnp.zeros((4,))
+    out = step(buf, x)                   # buf rides argnum 0 (not donated)
+    return out + buf

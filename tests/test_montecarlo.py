@@ -184,7 +184,7 @@ def test_to_host_materializes_sharded_outputs(small_batch):
 
     sim = EnsembleSimulator(small_batch, gwb=_gwb_cfg(small_batch),
                             mesh=make_mesh(jax.devices(), psr_shards=2))
-    packed = sim._step(jax.random.key(0), 0, 8, ())
+    packed = sim._step(jax.random.key(0), 0, 8, (), None)
     got = to_host(packed)
     assert isinstance(got, np.ndarray) and got.shape == (8, 16)
     np.testing.assert_array_equal(got, np.asarray(packed))

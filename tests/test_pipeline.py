@@ -1,0 +1,328 @@
+"""The asynchronous chunk pipeline (docs/PERFORMANCE.md).
+
+Pins the tentpole contracts: pipelined-vs-serial BIT-identity across every
+packed lane (plain, os, lnlike, keep_corr; checkpointed and not; 1x1x1 and
+2x2x2 meshes), checkpoint resume after a mid-pipeline kill, donated-buffer
+safety (the recycled scratch really is donated, and the engine never reads
+one after dispatch), depth equivalence (2-deep == 1-deep == serial), the
+overlap acceptance criterion (checkpointed per-chunk wall within 15% of the
+uncheckpointed pipeline, checkpoint appends overlapped on the writer
+thread), and the persistent-compile-cache / AOT warm-start wiring.
+"""
+
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.parallel import pipeline as pipeline_mod
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import (CGWSampling, EnsembleSimulator,
+                                             GWBConfig)
+from fakepta_tpu.utils import io as io_utils
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return PulsarBatch.synthetic(npsr=8, ntoa=64, tspan_years=10.0,
+                                 toaerr=1e-7, n_red=8, n_dm=8, seed=1)
+
+
+def _gwb_cfg(batch, ncomp=8, log10_A=-13.5):
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=log10_A, gamma=13 / 3))
+    return GWBConfig(psd=psd, orf="hd")
+
+
+def _sim(batch, mesh=None, **kw):
+    return EnsembleSimulator(batch, gwb=_gwb_cfg(batch),
+                             mesh=mesh or make_mesh(jax.devices()[:1]), **kw)
+
+
+# ------------------------------------------------- bit-identity across lanes
+
+def test_pipelined_equals_serial_plain_lane(batch):
+    sim = _sim(batch)
+    a = sim.run(32, seed=3, chunk=8)                     # depth 2 (default)
+    b = sim.run(32, seed=3, chunk=8, pipeline_depth=0)   # serial loop
+    np.testing.assert_array_equal(a["curves"], b["curves"])
+    np.testing.assert_array_equal(a["autos"], b["autos"])
+    assert a["report"].meta["pipeline_depth"] == 2
+    assert b["report"].meta["pipeline_depth"] == 0
+
+
+def test_pipelined_equals_serial_keep_corr(batch, tmp_path):
+    sim = _sim(batch)
+    a = sim.run(16, seed=2, chunk=8, keep_corr=True)
+    b = sim.run(16, seed=2, chunk=8, keep_corr=True, pipeline_depth=0)
+    np.testing.assert_array_equal(a["corr"], b["corr"])
+    np.testing.assert_array_equal(a["curves"], b["curves"])
+    # checkpointed keep_corr, both modes, equals the uncheckpointed run
+    c = sim.run(16, seed=2, chunk=8, keep_corr=True,
+                checkpoint=tmp_path / "kc.npz")
+    np.testing.assert_array_equal(c["corr"], a["corr"])
+
+
+def test_pipelined_equals_serial_os_lane(batch, tmp_path):
+    sim = _sim(batch)
+    a = sim.run(16, seed=4, chunk=8, os="hd")
+    b = sim.run(16, seed=4, chunk=8, os="hd", pipeline_depth=0)
+    np.testing.assert_array_equal(a["os"]["stats"]["hd"]["amp2"],
+                                  b["os"]["stats"]["hd"]["amp2"])
+    np.testing.assert_array_equal(a["curves"], b["curves"])
+    # checkpointed: the OS lanes ride the n_extra manifest unchanged
+    c = sim.run(16, seed=4, chunk=8, os="hd",
+                checkpoint=tmp_path / "os.npz")
+    np.testing.assert_array_equal(c["os"]["stats"]["hd"]["amp2"],
+                                  a["os"]["stats"]["hd"]["amp2"])
+
+
+def test_pipelined_equals_serial_lnlike_lane(batch):
+    from fakepta_tpu.infer import (ComponentSpec, FreeParam, InferSpec,
+                                   LikelihoodSpec)
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=8, free=(
+            FreeParam("log10_A", (-13.8, -12.6)),
+            FreeParam("gamma", (2.0, 6.0)))),
+    ))
+    spec = InferSpec(model=model,
+                     theta=np.array([[-13.2, 4.0], [-13.0, 3.0]]))
+    sim = _sim(batch)
+    a = sim.run(8, seed=5, chunk=4, lnlike=spec)
+    b = sim.run(8, seed=5, chunk=4, lnlike=spec, pipeline_depth=0)
+    np.testing.assert_array_equal(a["lnlike"]["lnl"], b["lnlike"]["lnl"])
+    np.testing.assert_array_equal(a["curves"], b["curves"])
+
+
+def test_pipelined_equals_serial_2x2x2_mesh(batch):
+    """Depth equivalence on the virtual 8-device mesh: 2-deep == 1-deep ==
+    serial, bit for bit, under (real=2, psr=2, toa=2) sharding."""
+    mesh = make_mesh(jax.devices(), psr_shards=2, toa_shards=2)
+    sim = _sim(batch, mesh=mesh)
+    runs = {d: sim.run(32, seed=7, chunk=8, pipeline_depth=d)
+            for d in (0, 1, 2)}
+    for d in (1, 2):
+        np.testing.assert_array_equal(runs[d]["curves"], runs[0]["curves"])
+        np.testing.assert_array_equal(runs[d]["autos"], runs[0]["autos"])
+    assert runs[1]["report"].meta["pipeline_depth"] == 1
+    # and the sharded stream equals the single-device one (f32 collective
+    # reduction-order tolerance, as everywhere else in the suite)
+    ref = _sim(batch).run(32, seed=7, chunk=8)
+    scale = np.abs(ref["curves"]).max()
+    np.testing.assert_allclose(runs[2]["curves"], ref["curves"], rtol=1e-5,
+                               atol=1e-4 * scale)
+
+
+def test_pipeline_with_sampled_cgw_bulk_prefetch(batch):
+    """The host-f64 psrterm bulk precompute prefetches chunk i+1 while chunk
+    i computes; streams must stay bit-identical to the serial loop."""
+    import fakepta_tpu.constants as const
+    toas_abs = np.tile(53000.0 * 86400.0
+                       + np.linspace(0.0, 10 * const.yr, 64), (8, 1))
+    pdist = np.column_stack([np.full(8, 1.0), np.full(8, 0.2)])
+    sim = EnsembleSimulator(
+        batch, gwb=_gwb_cfg(batch), mesh=make_mesh(jax.devices()[:1]),
+        cgw_sample=CGWSampling(psrterm=True, sample_pdist=True,
+                               tref=float(toas_abs.mean())),
+        toas_abs=toas_abs, pdist=pdist)
+    a = sim.run(12, seed=11, chunk=4)
+    b = sim.run(12, seed=11, chunk=4, pipeline_depth=0)
+    np.testing.assert_array_equal(a["curves"], b["curves"])
+    assert a["report"].counters.get("pipeline.h2d_prefetch", 0) >= 1
+
+
+# ------------------------------------------------------- checkpoint semantics
+
+def test_checkpoint_resume_after_mid_pipeline_kill(batch, tmp_path):
+    """A pipelined run killed mid-flight (progress raising on the writer
+    thread) leaves a resumable checkpoint; the resumed run equals the
+    uninterrupted one bit for bit, and no drain past the kill ran."""
+    sim = _sim(batch)
+    ck = tmp_path / "mc.npz"
+    full = sim.run(32, seed=5, chunk=8)
+
+    calls = []
+
+    class Kill(Exception):
+        pass
+
+    def boom(done, nreal):
+        calls.append(done)
+        if done >= 16:
+            raise Kill
+
+    with pytest.raises(Kill):
+        sim.run(32, seed=5, chunk=8, checkpoint=ck, progress=boom)
+    assert ck.exists(), "kill must leave the checkpoint family behind"
+    assert calls == [8, 16]          # FIFO drains; nothing ran past the kill
+    resumed = sim.run(32, seed=5, chunk=8, checkpoint=ck)
+    np.testing.assert_array_equal(resumed["curves"], full["curves"])
+    np.testing.assert_array_equal(resumed["autos"], full["autos"])
+    assert not ck.exists()
+
+
+def test_writer_exception_from_checkpoint_write_propagates(batch, tmp_path):
+    """An I/O failure inside the background checkpoint append surfaces to
+    the run() caller (not swallowed on the writer thread)."""
+    sim = _sim(batch)
+    real_save = io_utils.EnsembleCheckpoint.save
+
+    def failing(self, *a, **kw):
+        raise OSError("disk full")
+
+    io_utils.EnsembleCheckpoint.save = failing
+    try:
+        with pytest.raises(OSError, match="disk full"):
+            sim.run(24, seed=5, chunk=8, checkpoint=tmp_path / "mc.npz")
+    finally:
+        io_utils.EnsembleCheckpoint.save = real_save
+
+
+# ----------------------------------------------------------------- donation
+
+def test_donated_scratch_is_recycled_and_never_reread(batch):
+    """Donation safety: the packed-output scratch really is donated (the
+    engine's own recycled buffer is marked deleted after dispatch) and a
+    full pipelined run — which recycles drained buffers chunk after chunk —
+    still equals the serial loop bit for bit."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    sim = _sim(batch)
+    scratch = jax.device_put(
+        np.zeros((8, sim.nbins + 1), batch.t_own.dtype),
+        NamedSharding(sim.mesh, P("real")))
+    packed = sim._step(jax.random.key(0), 0, 8, (), scratch, False)
+    jax.block_until_ready(packed)
+    assert scratch.is_deleted(), "scratch was not donated"
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(scratch)          # reuse after donation must be an error
+    # the donated call's outputs are intact and recycling preserves streams
+    ref = sim._step(jax.random.key(0), 0, 8, (), None, False)
+    np.testing.assert_array_equal(np.array(packed), np.asarray(ref))
+    out2 = sim.run(40, seed=9, chunk=8)              # 5 chunks through ring
+    out0 = sim.run(40, seed=9, chunk=8, pipeline_depth=0)
+    np.testing.assert_array_equal(out2["curves"], out0["curves"])
+
+
+# ------------------------------------------------- overlap acceptance + obs
+
+def test_checkpointed_pipeline_overlaps_io(batch, tmp_path):
+    """The acceptance criterion: with a deliberately slowed checkpoint sink
+    the checkpointed pipelined run's steady per-chunk wall stays within 15%
+    of the uncheckpointed pipeline (the writer thread absorbs the I/O),
+    while the serial loop pays the sink in every chunk wall; the RunReport
+    records the overlap (ckpt appends timed on the writer, chunks not
+    synced, walls excluding them)."""
+    sim = _sim(batch)
+    # ~50 ms of device work per chunk on the CPU mesh: big enough that a
+    # half-chunk checkpoint sink is measurable, and the writer (sink + a
+    # sub-ms packed fetch per chunk) can never become the pipeline bottleneck
+    nreal, chunk = 24576, 4096
+
+    def steady_walls(rep):
+        return [c["wall_s"] for c in rep.chunks[1:]]    # drop compile chunk
+
+    base = sim.run(nreal, seed=13, chunk=chunk)          # warm + baseline
+    base = sim.run(nreal, seed=13, chunk=chunk)          # steady baseline
+    walls_a = steady_walls(base["report"])
+    # slow the sink by ~half a steady chunk so overlap is measurable but the
+    # writer never becomes the bottleneck (clamped for very fast machines)
+    sink = min(max(0.5 * float(np.median(walls_a)), 0.01), 0.2)
+    real_save = io_utils.EnsembleCheckpoint.save
+
+    def slow_save(self, *a, **kw):
+        time.sleep(sink)
+        return real_save(self, *a, **kw)
+
+    io_utils.EnsembleCheckpoint.save = slow_save
+    try:
+        piped = sim.run(nreal, seed=13, chunk=chunk,
+                        checkpoint=tmp_path / "p.npz")
+        serial = sim.run(nreal, seed=13, chunk=chunk,
+                         checkpoint=tmp_path / "s.npz", pipeline_depth=0)
+    finally:
+        io_utils.EnsembleCheckpoint.save = real_save
+    np.testing.assert_array_equal(piped["curves"], base["curves"])
+    np.testing.assert_array_equal(serial["curves"], base["curves"])
+
+    walls_b = steady_walls(piped["report"])
+    walls_c = steady_walls(serial["report"])
+    med_a, med_b, med_c = (float(np.median(w))
+                           for w in (walls_a, walls_b, walls_c))
+    # checkpointing under the pipeline costs < 15% per chunk (plus a small
+    # absolute epsilon so sub-ms walls cannot fail on scheduler noise)
+    assert med_b <= 1.15 * med_a + 0.010, (med_a, med_b)
+    # the serial loop pays the sink inline every chunk — the overlap is real
+    assert med_c >= med_b + 0.5 * sink, (med_b, med_c, sink)
+
+    rep = piped["report"]
+    assert rep.meta["pipeline_depth"] == 2
+    assert not any(c["synced"] for c in rep.chunks)
+    # every chunk's checkpoint append was timed on the writer (>= the sink)
+    # yet excluded from the dispatch walls: ckpt_wait_s < the serial chunk
+    # wall that pays the same fetch+append inline
+    for c in rep.chunks:
+        assert c["ckpt_wait_s"] >= sink
+    assert float(np.median([c["ckpt_wait_s"] for c in rep.chunks])) < med_c
+    summ = rep.summary()
+    assert summ["ckpt_wait_s"] >= sink * rep.nchunks
+    assert "pipeline_stall_s" in summ
+
+
+def test_obs_compare_direction_for_pipeline_metrics(batch, tmp_path):
+    """pipeline_stall_s / ckpt_wait_s are lower-is-better in obs compare:
+    growing them flags a regression, shrinking them does not."""
+    from fakepta_tpu.obs import RunReport
+    from fakepta_tpu.obs.report import format_delta
+
+    def rep(stall, ckpt):
+        return RunReport(
+            meta={"nreal": 8, "chunk": 8, "n_devices": 1,
+                  "pipeline_depth": 2},
+            chunks=[{"idx": 0, "wall_s": 1.0, "stall_s": stall,
+                     "ckpt_wait_s": ckpt, "synced": False}],
+            total_s=1.0)
+
+    _, regress = format_delta(rep(0.1, 0.1), rep(1.0, 1.0))
+    assert {"pipeline_stall_s", "ckpt_wait_s"} <= set(regress)
+    _, improve = format_delta(rep(1.0, 1.0), rep(0.1, 0.1))
+    assert not {"pipeline_stall_s", "ckpt_wait_s"} & set(improve)
+    # depth itself is a run-shape fact, never a regression
+    a, b = rep(0.1, 0.1), rep(0.1, 0.1)
+    b.meta["pipeline_depth"] = 0
+    _, regress = format_delta(a, b)
+    assert "pipeline_depth" not in regress
+
+
+# --------------------------------------------- compile cache + AOT warm start
+
+def test_compile_cache_and_warm_start(batch, tmp_path, monkeypatch):
+    """warm_start AOT-compiles the exact run executable into the persistent
+    compile cache (kwarg or FAKEPTA_TPU_COMPILE_CACHE env var), and the
+    warmed run still produces the canonical stream."""
+    cache = tmp_path / "xla-cache"
+    sim = _sim(batch, compile_cache_dir=cache)
+    spent = sim.warm_start(8)
+    assert spent > 0.0
+    assert cache.is_dir() and any(cache.iterdir()), \
+        "warm_start wrote nothing into the persistent compile cache"
+    out = sim.run(16, seed=3, chunk=8)
+    ref = _sim(batch).run(16, seed=3, chunk=8)
+    np.testing.assert_array_equal(out["curves"], ref["curves"])
+    # env-var opt-in reaches the same wiring
+    monkeypatch.setenv(pipeline_mod.COMPILE_CACHE_ENV, str(cache))
+    assert pipeline_mod.configure_compile_cache() == str(cache)
+    monkeypatch.delenv(pipeline_mod.COMPILE_CACHE_ENV)
+    assert pipeline_mod.configure_compile_cache(None) is None
+
+
+def test_warm_start_lane_variants_smoke(batch):
+    """warm_start selects the same step variant run() would for the os and
+    keep_corr configurations (compile-only smoke: no execution)."""
+    sim = _sim(batch)
+    assert sim.warm_start(8, os="hd") > 0.0
+    assert sim.warm_start(8, keep_corr=True) > 0.0

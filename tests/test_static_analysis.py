@@ -38,6 +38,11 @@ CASES = [
     ("hostsync_in_jit.py", LIB,
      {("host-sync-in-jit", 12), ("host-sync-in-jit", 17),
       ("host-sync-in-jit", 18), ("host-sync-in-jit", 22)}),
+    ("hostsync_loop.py", LIB,
+     {("host-sync-in-jit", 11), ("host-sync-in-jit", 12),
+      ("host-sync-in-jit", 16)}),
+    ("donated_reuse.py", LIB,
+     {("donated-buffer-reuse", 18), ("donated-buffer-reuse", 28)}),
     ("tracer_leak.py", LIB,
      {("tracer-leak", 10), ("tracer-leak", 12), ("tracer-leak", 14),
       ("tracer-leak", 15), ("tracer-leak", 24)}),
