@@ -43,7 +43,26 @@ RunReport each ``sim.run()`` attaches):
   loop actually waited on (first-chunk staging + depth-bound waits), and
   total checkpoint-append time (overlapped on the writer thread when
   pipelined). Both timings are lower-is-better under ``obs compare``;
+- ``intensity_flop_per_byte``: the measured chunk program's arithmetic
+  intensity (XLA cost-analysis FLOPs / bytes — the roofline x-coordinate;
+  higher-is-better under ``obs compare``), and ``model_bytes_per_chunk``:
+  the analytic HBM-traffic model of the same program
+  (``fakepta_tpu.ops.megakernel.chunk_bytes_model`` — the TPU-fused
+  accounting, recorded beside the measured bytes because XLA:CPU cost
+  analysis can neither fuse the draw chain nor see through the
+  interpret-mode kernel loop);
+- per-mode bytes/chunk rows for the whole-chunk megakernel
+  (docs/PERFORMANCE.md): ``cost_bytes_per_chunk_fused`` /
+  ``cost_bytes_per_chunk_fused_bf16`` (measured, AOT cost capture of the
+  ``use_pallas='mega'`` program at f32 and under the bf16-storage mode —
+  no measured run per mode) and ``model_bytes_per_chunk_fused`` /
+  ``model_bytes_per_chunk_fused_bf16`` (the analytic model), plus
+  ``fused_bytes_reduction_x`` = model_xla / model_fused — the recorded
+  roofline acceptance (>= 2x on the flagship config; higher-is-better);
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
+  ``benchmarks/suite.py`` rows carry the same ``platform``/``fallback``
+  pair, so CPU stand-in rounds are distinguishable across the whole
+  trajectory.
 
 Backend selection: the dead-tunnel probe verdict is cached to a temp file
 scoped to this process tree, and ``FAKEPTA_TPU_BENCH_BACKEND=cpu`` (or any
@@ -126,6 +145,9 @@ def main():
     if rep.cost.get("flops_per_chunk"):
         row["cost_flops_per_chunk"] = rep.cost["flops_per_chunk"]
     rep_sum = rep.summary()
+    for key in ("intensity_flop_per_byte", "model_bytes_per_chunk"):
+        if rep_sum.get(key):
+            row[key] = rep_sum[key]
     row["pipeline_depth"] = rep_sum.get("pipeline_depth", 0)
     row["pipeline_stall_s"] = rep_sum.get("pipeline_stall_s", 0.0)
     row["ckpt_wait_s"] = rep_sum.get("ckpt_wait_s", 0.0)
@@ -167,6 +189,26 @@ def main():
         "lnlike_evals_per_s_per_chip", 0.0)
     if lnl_sum.get("lnlike_bytes_per_chunk"):
         row["lnlike_bytes_per_chunk"] = lnl_sum["lnlike_bytes_per_chunk"]
+    # per-mode bytes/chunk (the megakernel tentpole, docs/PERFORMANCE.md):
+    # AOT cost capture of the fused whole-chunk program and its
+    # bf16-storage mode on the same flagship batch — a compile, not a
+    # measured run, so the roofline acceptance is recorded every round
+    sim_mega = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
+                                 mesh=make_mesh(jax.devices()),
+                                 use_pallas="mega")
+    for name, cost in (("fused", sim_mega.chunk_cost(chunk)),
+                       ("fused_bf16",
+                        sim_mega.chunk_cost(chunk, precision="bf16"))):
+        if cost.get("bytes_per_chunk"):
+            row[f"cost_bytes_per_chunk_{name}"] = cost["bytes_per_chunk"]
+        if cost.get("model_bytes_per_chunk"):
+            row[f"model_bytes_per_chunk_{name}"] = \
+                cost["model_bytes_per_chunk"]
+    if row.get("model_bytes_per_chunk") and \
+            row.get("model_bytes_per_chunk_fused"):
+        row["fused_bytes_reduction_x"] = round(
+            row["model_bytes_per_chunk"]
+            / row["model_bytes_per_chunk_fused"], 2)
     if fallback:
         row["fallback"] = "accelerator backend unavailable; CPU stand-in"
     print(json.dumps(row))

@@ -1,10 +1,15 @@
 """Compile-and-validate the fused Pallas kernel on the real TPU (VERDICT r2 #4).
 
-Runs the fused statistic path NON-interpreted (a real Mosaic kernel):
-1. parity vs the XLA path at a small size, both precisions;
+Runs the fused statistic paths NON-interpreted (real Mosaic kernels):
+1. parity vs the XLA path at a small size, both precisions — the binned-
+   correlation kernel AND the whole-chunk megakernel (use_pallas='mega',
+   f32 and the run(precision='bf16') storage mode);
 2. compile + run at the FLAGSHIP size (100 psr, 780 TOAs) where the VMEM-capped
    realization tile matters (pick_rt returns 4 there);
-3. throughput: XLA vs fused at the flagship size.
+3. throughput: XLA vs fused vs megakernel at the flagship size.
+
+(The interpret-mode lane in tests/test_megakernel.py covers kernel
+correctness without hardware; this script is the on-TPU Mosaic check.)
 
 Prints one JSON line per check. Exits non-zero on any parity failure.
 """
@@ -98,6 +103,20 @@ def main():
         print(json.dumps({"check": f"e2e_parity_{prec}_mosaic", "passed": passed,
                           "max_err": err, "scale": scale}))
 
+    # 1c. the whole-chunk megakernel (ops/megakernel.py), f32 and the
+    # bf16-storage run mode — in-kernel basis recompute + residual assembly
+    # as a real Mosaic program
+    sim_mega = EnsembleSimulator(small, gwb=gwb(small), mesh=mesh,
+                                 use_pallas="mega")
+    for prec, tol in ((None, 1e-3), ("bf16", 1e-2)):
+        out = sim_mega.run(8, seed=3, chunk=8, precision=prec)
+        scale = float(np.abs(ref["curves"]).max())
+        err = float(np.abs(out["curves"] - ref["curves"]).max())
+        passed = bool(err <= tol * scale)
+        ok &= passed
+        print(json.dumps({"check": f"e2e_parity_mega_{prec or 'f32'}_mosaic",
+                          "passed": passed, "max_err": err, "scale": scale}))
+
     # 2 + 3. flagship size: compile under the VMEM cap, throughput both paths.
     # Skipped when parity already failed: benchmarking a kernel that produces
     # wrong answers would publish meaningless speedup numbers.
@@ -109,17 +128,21 @@ def main():
     cfg = gwb(flag, ncomp=30, log10_A=np.log10(2e-15))
     nreal, chunk = 10_000, 10_000
     results = {}
-    for name, kw in (("xla", dict(use_pallas=False)),
-                     ("pallas_bf16_vpu", dict(use_pallas=True,
-                                              pallas_precision="bf16",
-                                              pallas_mxu_binning=False)),
-                     ("pallas_bf16_mxu", dict(use_pallas=True,
-                                              pallas_precision="bf16",
-                                              pallas_mxu_binning=True))):
+    for name, kw, rkw in (
+            ("xla", dict(use_pallas=False), {}),
+            ("pallas_bf16_vpu", dict(use_pallas=True,
+                                     pallas_precision="bf16",
+                                     pallas_mxu_binning=False), {}),
+            ("pallas_bf16_mxu", dict(use_pallas=True,
+                                     pallas_precision="bf16",
+                                     pallas_mxu_binning=True), {}),
+            ("mega_f32", dict(use_pallas="mega"), {}),
+            ("mega_bf16", dict(use_pallas="mega"),
+             dict(precision="bf16"))):
         sim = EnsembleSimulator(flag, gwb=cfg, mesh=mesh, **kw)
-        sim.run(chunk, seed=9, chunk=chunk)          # compile + warm
+        sim.run(chunk, seed=9, chunk=chunk, **rkw)   # compile + warm
         t0 = time.perf_counter()
-        out = sim.run(nreal, seed=1, chunk=chunk)
+        out = sim.run(nreal, seed=1, chunk=chunk, **rkw)
         t = time.perf_counter() - t0
         if not np.all(np.isfinite(out["curves"])):
             print(json.dumps({"check": f"flagship_{name}",
@@ -132,7 +155,11 @@ def main():
                       "vpu_binning": round(results["pallas_bf16_vpu"]
                                            / results["xla"], 3),
                       "mxu_binning": round(results["pallas_bf16_mxu"]
-                                           / results["xla"], 3)}))
+                                           / results["xla"], 3),
+                      "mega_f32": round(results["mega_f32"]
+                                        / results["xla"], 3),
+                      "mega_bf16": round(results["mega_bf16"]
+                                         / results["xla"], 3)}))
     if "--crossover" in sys.argv:
         crossover(mesh, gwb)
     sys.exit(0)

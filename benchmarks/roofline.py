@@ -50,6 +50,14 @@ def main():
                     help="cast residual blocks to bfloat16 at the statistic "
                          "boundary (halves the dominant (R,P,T) all_gather + "
                          "contraction traffic; ~4e-3 operand rounding)")
+    ap.add_argument("--mode", choices=("xla", "fused", "mega"),
+                    default="xla",
+                    help="statistic path to measure: the two-stage XLA "
+                         "einsums, the binned-correlation Pallas kernel, or "
+                         "the whole-chunk megakernel (use_pallas='mega')")
+    ap.add_argument("--precision", choices=("f32", "bf16"), default=None,
+                    help="per-run statistic precision (run(precision=...)); "
+                         "'bf16' + --mode mega is the bf16-storage mode")
     ap.add_argument("--platform", default=None)
     args = ap.parse_args()
 
@@ -70,21 +78,26 @@ def main():
     f = np.arange(1, 31) / float(batch.tspan_common)
     psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15),
                                            gamma=13 / 3))
+    use_pallas = {"xla": False, "fused": True, "mega": "mega"}[args.mode]
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()),
+                            use_pallas=use_pallas,
                             bases_dtype="bf16" if args.bases_bf16 else "f32",
                             stats_dtype="bf16" if args.stats_bf16 else "f32")
 
     # compile + warm, then measure steady state
-    warm = sim.run(args.chunk, seed=9, chunk=args.chunk)
+    warm = sim.run(args.chunk, seed=9, chunk=args.chunk,
+                   precision=args.precision)
     t0 = time.perf_counter()
-    out = sim.run(args.nreal, seed=1, chunk=args.chunk)
+    out = sim.run(args.nreal, seed=1, chunk=args.chunk,
+                  precision=args.precision)
     elapsed = time.perf_counter() - t0
     if not np.all(np.isfinite(out["curves"])):
         raise SystemExit("non-finite output")
     rate = args.nreal / elapsed / n_dev
     rep = out["report"]
-    print(json.dumps({"measure": "throughput",
+    print(json.dumps({"measure": "throughput", "mode": args.mode,
+                      "precision": rep.meta.get("precision", "f32"),
                       "real_per_s_per_chip": round(rate, 2),
                       "steady_real_per_s_per_chip": round(
                           rep.steady_real_per_s_per_chip(), 2),
@@ -105,9 +118,12 @@ def main():
         ridge = V5E_BF16_PEAK / V5E_HBM_BW      # FLOP/byte where roofline bends
         bound = "compute" if intensity > ridge else "memory"
         print(json.dumps({
-            "measure": "roofline",
+            "measure": "roofline", "mode": args.mode,
             "program_flops_per_chunk": flops,
             "program_bytes_per_chunk": bytes_acc,
+            "model_bytes_per_chunk": rep.cost.get("model_bytes_per_chunk"),
+            # bench.py-schema spelling, diffable by `obs compare`
+            "intensity_flop_per_byte": round(intensity, 2),
             "arithmetic_intensity_flop_per_byte": round(intensity, 2),
             "ridge_point_flop_per_byte": round(ridge, 2),
             "bound": bound,
@@ -122,6 +138,39 @@ def main():
         print(json.dumps({"measure": "memory",
                           "static_reservation_gb":
                               round(reserved / 2**30, 2)}))
+
+    # per-mode bytes/chunk (bench.py docstring schema): AOT cost capture of
+    # the megakernel program at f32 and under the bf16-storage mode beside
+    # this run's measured mode — the roofline acceptance as one JSON row
+    # (measured bytes + the analytic HBM model; the model is the source of
+    # truth on platforms whose cost analysis can't see TPU fusion —
+    # fakepta_tpu.ops.megakernel.chunk_bytes_model)
+    sim_mega = (sim if args.mode == "mega" else EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"),
+        mesh=make_mesh(jax.devices()), use_pallas="mega"))
+    sim_xla = sim if args.mode == "xla" else EnsembleSimulator(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"),
+        mesh=make_mesh(jax.devices()))
+    per_mode = {"measure": "bytes_per_mode"}
+    for name, cost in (("xla", sim_xla.chunk_cost(args.chunk)),
+                       ("fused", sim_mega.chunk_cost(args.chunk)),
+                       ("fused_bf16", sim_mega.chunk_cost(
+                           args.chunk, precision="bf16"))):
+        if cost.get("bytes_per_chunk"):
+            per_mode[f"cost_bytes_per_chunk_{name}"] = \
+                cost["bytes_per_chunk"]
+        if cost.get("model_bytes_per_chunk"):
+            per_mode[f"model_bytes_per_chunk_{name}"] = \
+                cost["model_bytes_per_chunk"]
+    if per_mode.get("model_bytes_per_chunk_xla") and \
+            per_mode.get("model_bytes_per_chunk_fused"):
+        per_mode["fused_bytes_reduction_x"] = round(
+            per_mode["model_bytes_per_chunk_xla"]
+            / per_mode["model_bytes_per_chunk_fused"], 2)
+        per_mode["fused_bf16_bytes_reduction_x"] = round(
+            per_mode["model_bytes_per_chunk_xla"]
+            / per_mode["model_bytes_per_chunk_fused_bf16"], 2)
+    print(json.dumps(per_mode))
 
     if args.trace_dir:
         with jax.profiler.trace(args.trace_dir):

@@ -76,6 +76,20 @@ DTYPE_POLICY = {
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"
 
+# bf16-storage policy (the mixed-precision-cast rule): library modules
+# sanctioned to down-cast f32 arrays to bfloat16 — the storage-halving /
+# f32-accumulate precision modes (pallas kernel operands, the megakernel's
+# bf16 base/coefficient storage, the engine's bases/stats casts). An
+# implicit f32->bf16 cast anywhere ELSE in the library is a silent
+# half-precision leak: it changes realization streams without a policy
+# entry or a tolerance certification, so the rule flags it (pragma with the
+# certified bound, or add the module here WITH the certification tests).
+BF16_STORAGE_MODULES = (
+    "fakepta_tpu/ops/pallas_kernels.py",
+    "fakepta_tpu/ops/megakernel.py",
+    "fakepta_tpu/parallel/montecarlo.py",
+)
+
 # Library code prefix: rules with a library-only clause (literal re-seeding,
 # dtype policy) fire only under it.
 LIBRARY_PREFIXES = ("fakepta_tpu/",)

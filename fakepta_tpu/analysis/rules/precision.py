@@ -1,0 +1,61 @@
+"""mixed-precision-cast: implicit f32->bf16 down-casts outside policy.
+
+The engine's bf16-storage / f32-accumulate precision modes (``run(
+precision='bf16')``, the Pallas kernels' bf16 operands, the megakernel's
+bf16 base storage) are *certified*: their modules are listed in
+``analysis.policy.BF16_STORAGE_MODULES`` and their streams are pinned
+against the mesh-invariance tolerances in tests. A bfloat16 cast anywhere
+else in the library is a silent half-precision leak — it rounds 24-bit
+mantissas to 8 without a policy entry, a documented bound, or a
+certification test — so it is a finding. Precision *mode strings*
+(``precision='bf16'``) are not casts and never flagged; only dtype markers
+(``jnp.bfloat16``, ``ml_dtypes.bfloat16``, the ``'bfloat16'`` dtype
+string) are.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from .. import policy
+from ..engine import Finding, ModuleContext
+from .common import NameResolver
+
+RULE_ID = "mixed-precision-cast"
+
+_BF16_ATTRS = {"jax.numpy.bfloat16", "numpy.bfloat16", "ml_dtypes.bfloat16",
+               "jax.dtypes.bfloat16"}
+_BF16_STRINGS = {"bfloat16"}
+
+
+def check(ctx: ModuleContext) -> List[Finding]:
+    if not ctx.is_library or ctx.path in policy.BF16_STORAGE_MODULES:
+        return []
+    resolver = NameResolver(ctx.tree)
+    findings: List[Finding] = []
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.Attribute, ast.Name)):
+            name = resolver.resolve(node)
+            if name in _BF16_ATTRS:
+                findings.append(ctx.finding(
+                    RULE_ID, node,
+                    f"{name} cast in a module outside the bf16-storage "
+                    f"policy (analysis.policy.BF16_STORAGE_MODULES): an "
+                    f"implicit f32->bf16 down-cast changes realization "
+                    f"streams silently; route it through the engine's "
+                    f"precision mode, or add the module to the policy "
+                    f"with certification tests"))
+        elif isinstance(node, ast.Call):
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, str) and \
+                        arg.value in _BF16_STRINGS:
+                    findings.append(ctx.finding(
+                        RULE_ID, arg,
+                        "dtype string 'bfloat16' in a module outside the "
+                        "bf16-storage policy; use the engine's precision "
+                        "mode (run(precision='bf16')) or add the module "
+                        "to BF16_STORAGE_MODULES with certification "
+                        "tests"))
+    return findings

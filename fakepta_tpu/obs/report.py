@@ -88,6 +88,21 @@ class RunReport:
             m["cost_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
         if self.cost.get("flops_per_chunk"):
             m["cost_flops_per_chunk"] = self.cost["flops_per_chunk"]
+        if self.cost.get("model_bytes_per_chunk"):
+            # the analytic HBM-traffic model beside the measured number
+            # (ops/megakernel.py chunk_bytes_model — the roofline source of
+            # truth on platforms whose cost analysis can't see TPU fusion);
+            # lower-is-better, like every *_bytes_per_chunk metric
+            m["model_bytes_per_chunk"] = self.cost["model_bytes_per_chunk"]
+        if self.cost.get("bytes_per_chunk") and \
+                self.cost.get("flops_per_chunk"):
+            # arithmetic intensity of the chunk program — the roofline
+            # x-coordinate; HIGHER is better (the whole point of the fused
+            # megakernel is pushing it toward the ridge), and `compare`
+            # treats it so
+            m["intensity_flop_per_byte"] = round(
+                self.cost["flops_per_chunk"] / self.cost["bytes_per_chunk"],
+                3)
         if self.memory.get("peak_bytes_in_use"):
             m["peak_bytes_in_use"] = self.memory["peak_bytes_in_use"]
         if self.meta.get("pipeline_depth") is not None:
@@ -221,18 +236,22 @@ def format_delta(a: RunReport, b: RunReport,
     """
     ma, mb = a.summary(), b.summary()
     keys = sorted(set(ma) | set(mb))
-    higher_is_better = {"real_per_s", "steady_real_per_s_per_chip"}
+    higher_is_better = {"real_per_s", "steady_real_per_s_per_chip",
+                        "intensity_flop_per_byte"}
 
     def _higher_is_better(k: str) -> bool:
         # suffix rules cover the detect lane's per-ORF metric names
         # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the
         # infer lane's recovery metrics (lnlike_map_hit_rate; its
-        # lnlike_map_l2_mean distance and *_bytes_per_chunk costs keep the
-        # lower-is-better default) and any *_per_s_per_chip / evals
-        # throughput metric
+        # lnlike_map_l2_mean distance and *_bytes_per_chunk /
+        # model_bytes_per_chunk costs keep the lower-is-better default,
+        # so a byte-per-chunk growth IS a regression), any *_per_s_per_chip
+        # / evals throughput metric, the roofline intensity, and the
+        # bench rows' *_reduction_x byte-savings factors
         return (k in higher_is_better
                 or k.endswith(("_per_s_per_chip", "_significance_sigma",
-                               "_detection_rate", "_hit_rate")))
+                               "_detection_rate", "_hit_rate",
+                               "_reduction_x")))
 
     # run-shape facts and distribution-scale diagnostics, not performance or
     # quality metrics — moving is information, not a regression (the infer

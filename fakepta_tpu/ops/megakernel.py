@@ -1,0 +1,410 @@
+"""Whole-chunk fused Pallas megakernel: white -> GP -> GWB -> pack in VMEM.
+
+The r5 roofline pins the flagship chunk program at ~7.1 FLOP/B against a
+v5e ridge of 240 (benchmarks/roofline.py): the engine is HBM-bound, so the
+next realizations/s comes from moving fewer bytes, not fewer FLOPs. The
+binned-correlation kernel (:mod:`fakepta_tpu.ops.pallas_kernels`) already
+keeps the (R, P, P) correlation tensor out of HBM; this module extends the
+fusion across the *whole chunk*:
+
+- XLA keeps only the cheap per-realization work: the RNG draws, the
+  hyperparameter sampling, and the GP **coefficient** assembly (draws times
+  spectrum weights, the (P x P) GWB Cholesky coupling) — an (R, P, K) tensor
+  with K ~ 2 * total Fourier bins, ~T/3 the residual's bytes at the
+  flagship — plus the white/ECORR/system/deterministic residual **base**
+  (R, P, T), the one irreducible per-realization read.
+- The kernel recomputes the sine-cosine Fourier bases **in VMEM** from the
+  staged ``(t_norm, chromatic-scale)`` tables instead of reloading the dense
+  (P, T, K) basis from HBM per stage, assembles each realization tile's
+  residuals ``res = base + coef @ B`` in scratch, forms the (PL, PF)
+  correlation block on the MXU and reduces it to the packed statistic lanes
+  in place. The GP-projected residuals and the correlation tensor never
+  round-trip HBM; HBM sees the base read, the coefficient read, and the
+  packed lane write.
+- Per-mode bytes: f32 reads ~2x(R,P,T); ``precision='bf16'`` additionally
+  stores the base in bfloat16 (f32 accumulation everywhere), halving the
+  dominant read. Trading the basis recompute's FLOPs for those bytes is the
+  roofline's point: intensity rises toward the ridge while the byte-bound
+  throughput ceiling drops by the byte ratio.
+
+Cross-pulsar structure: under 'psr' sharding each shard recomputes the
+*full* residual rows from the (tiny) gathered coefficients + gathered base,
+so the only collectives are the base/coefficient all_gathers before the
+kernel and the (R, nbins)-sized partial-bin psum after it — both
+XLA-async, overlapped with the next chunk's dispatch by the run pipeline.
+On the flagship mesh (psr_shards=1) the ``shared`` path skips the local
+operand entirely: one residual assembly feeds both sides of the
+correlation.
+
+Layout follows /opt/skills/guides/pallas_guide.md (f32 tiles (8, 128);
+zero padding is free for dot products). Everything here is exercised in
+``interpret=True`` mode on the CPU tier-1 lane (tests/test_megakernel.py);
+on TPU the same program is a real Mosaic kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_kernels import LANES, SUBLANES, _pad_to
+
+# time-table rows staged for the in-kernel basis recompute
+T_OWN, T_COMMON = 0, 1
+
+
+class MegaStage(NamedTuple):
+    """One GP stage's static basis descriptor.
+
+    ``nbin`` harmonics on time row ``tcol`` (T_OWN for per-pulsar noise,
+    T_COMMON for the GWB grid), chromatic-scale row ``scol`` of the staged
+    scale table. Scale rows already hold the TOA-validity mask (padding
+    TOAs are 0), so the recomputed basis is zero exactly where the dense
+    XLA basis is masked.
+    """
+
+    nbin: int
+    tcol: int
+    scol: int
+
+
+def stage_k(stages: Tuple[MegaStage, ...]) -> int:
+    """Total coefficient width: 2 (cos+sin) per harmonic per stage."""
+    return sum(2 * s.nbin for s in stages)
+
+
+def _basis_rows(stage: MegaStage, t_row, s_row, dtype):
+    """(2 * nbin, T) recomputed basis rows for one stage of one pulsar.
+
+    Bitwise the same elementwise ops as :func:`fakepta_tpu.batch
+    .fourier_basis_norm` (phase = 2 pi n t_norm; cos rows then sin rows,
+    matching the (2, N) -> 2N coefficient reshape), so the in-kernel basis
+    agrees element-for-element with the dense XLA one.
+    """
+    n = (jax.lax.broadcasted_iota(dtype, (stage.nbin, 1), 0)
+         + jnp.asarray(1.0, dtype))
+    phase = (jnp.asarray(2.0 * jnp.pi, dtype) * t_row) * n     # (nbin, T)
+    return jnp.concatenate([jnp.cos(phase) * s_row,
+                            jnp.sin(phase) * s_row], axis=0)
+
+
+def _project_rows(res_ref, base_ref, coef_ref, times_ref, scales_ref,
+                  stages, p_actual, k_pad, cdtype):
+    """res[:, p, :] = base[:, p, :] + coef[:, p, :] @ B(p) for every pulsar.
+
+    The basis block B(p) (K, T) is recomputed in VMEM per pulsar per grid
+    step and contracted against the realization tile's coefficient rows as
+    ONE (rt, K) x (K, T) MXU matmul — the dense (P, T, K) basis never
+    exists anywhere, in HBM or VMEM. Padded pulsar rows keep the plain
+    base copy (their coefficients are zero anyway).
+    """
+    res_ref[...] = base_ref[...].astype(cdtype)
+    if not stages:
+        return
+
+    def body(p, _):
+        rows = []
+        for st in stages:
+            t_row = pl.load(times_ref, (pl.ds(st.tcol, 1), pl.ds(p, 1),
+                                        slice(None)))[0]
+            s_row = pl.load(scales_ref, (pl.ds(st.scol, 1), pl.ds(p, 1),
+                                         slice(None)))[0]
+            rows.append(_basis_rows(st, t_row, s_row, cdtype))
+        basis = jnp.concatenate(rows, axis=0)                   # (K, T)
+        if k_pad != basis.shape[0]:
+            basis = jnp.pad(basis, ((0, k_pad - basis.shape[0]), (0, 0)))
+        coef = pl.load(coef_ref, (slice(None), pl.ds(p, 1),
+                                  slice(None)))[:, 0, :]        # (rt, K_pad)
+        contrib = jax.lax.dot_general(
+            coef.astype(cdtype), basis, (((1,), (0,)), ((), ())),
+            preferred_element_type=cdtype,
+            precision=jax.lax.Precision.HIGHEST)                # (rt, T)
+        prev = pl.load(res_ref, (slice(None), pl.ds(p, 1), slice(None)))
+        pl.store(res_ref, (slice(None), pl.ds(p, 1), slice(None)),
+                 prev + contrib[:, None, :])
+        return 0
+
+    jax.lax.fori_loop(0, p_actual, body, 0)
+
+
+def _mega_kernel(*refs, rt, nbins, stages, p_actual, p_actual_l, pl_pad,
+                 k_pad, shared, bf16, cdtype):
+    """One grid step: assemble ``rt`` realizations' residuals, correlate,
+    bin — all in VMEM.
+
+    Ref order (shared): base_f, coef_f, times_f, scales_f, w2, out,
+    res_f, flat. Non-shared adds the local operand set (base_l, coef_l,
+    times_l, scales_l before w2; res_l before flat). ``shared`` is the
+    psr_shards == 1 fast path: local rows are the leading ``pl_pad`` rows
+    of the full assembly, so residuals are built once.
+    """
+    if shared:
+        (base_f, coef_f, times_f, scales_f, w2, out_ref, res_f,
+         flat_ref) = refs
+    else:
+        (base_l, base_f, coef_l, coef_f, times_l, times_f, scales_l,
+         scales_f, w2, out_ref, res_l, res_f, flat_ref) = refs
+
+    _project_rows(res_f, base_f, coef_f, times_f, scales_f, stages,
+                  p_actual, k_pad, cdtype)
+    if not shared:
+        _project_rows(res_l, base_l, coef_l, times_l, scales_l, stages,
+                      p_actual_l, k_pad, cdtype)
+
+    for r in range(rt):
+        rows_f = res_f[r]
+        rows_l = res_f[r, :pl_pad] if shared else res_l[r]
+        if bf16:
+            # bf16 operands + f32 accumulation: the MXU's native rate, the
+            # same ~4e-3 operand rounding the XLA TPU default applies
+            rows_l = rows_l.astype(jnp.bfloat16)
+            rows_f = rows_f.astype(jnp.bfloat16)
+            prec = None
+        else:
+            prec = jax.lax.Precision.HIGHEST
+        corr = jax.lax.dot_general(rows_l, rows_f, (((1,), (1,)), ((), ())),
+                                   preferred_element_type=cdtype,
+                                   precision=prec)              # (PL, PF)
+        flat_ref[r] = corr.reshape(-1)
+    binned = jax.lax.dot_general(flat_ref[...], w2[...],
+                                 (((1,), (1,)), ((), ())),
+                                 preferred_element_type=cdtype,
+                                 precision=jax.lax.Precision.HIGHEST)
+    out_ref[0] = jnp.pad(binned, ((0, 0), (0, LANES - binned.shape[1])))
+
+
+def _padded_dims_mega(p_local: int, p_full: int, t: int, k: int):
+    """(PL, PF, T, K) after tile padding — the single source the VMEM model
+    and the real operand padding both read, so :func:`pick_rt_mega` cannot
+    drift from the shapes the kernel actually sees."""
+    return (p_local + (-p_local) % SUBLANES,
+            p_full + (-p_full) % LANES,
+            t + (-t) % LANES,
+            k + (-k) % LANES if k else 0)
+
+
+def pick_rt_mega(r_local: int, p_local: int, p_full: int, t: int, k: int,
+                 nbins: int, n_times: int = 2, n_scales: int = 1,
+                 shared: bool = True, base_bytes: int = 4,
+                 compute_bytes: int = 4,
+                 budget_bytes: int = 12 << 20) -> int:
+    """Largest realization tile whose VMEM working set fits the budget.
+
+    Per grid step the kernel holds the double-buffered base blocks
+    (grid-indexed, so Mosaic overlaps the next step's copy-in), the
+    double-buffered coefficient blocks, the grid-invariant time/scale/
+    weight tables (single-buffered: their index map is constant, Mosaic
+    keeps one resident copy), the residual + flattened-correlation
+    scratch, one (K, T) recomputed basis block, and the small output.
+    ``base_bytes`` is 2 under the bf16-storage mode — the mode exists to
+    halve exactly this, so it buys the tile size back.
+    """
+    pl_pad, pf_pad, t_pad, k_pad = _padded_dims_mega(p_local, p_full, t, k)
+    rows = pf_pad if shared else (pl_pad + pf_pad)
+    nb = (nbins + 1) + (-(nbins + 1)) % SUBLANES
+    fixed = (compute_bytes * nb * pl_pad * pf_pad          # w2
+             + (n_times + n_scales) * rows * t_pad * compute_bytes
+             + k_pad * t_pad * compute_bytes)              # basis block
+    for rt in (16, 8, 4, 2, 1):
+        if r_local % rt != 0:
+            continue
+        moving = (2 * rt * rows * t_pad * base_bytes       # base, dbl-buf
+                  + 2 * rt * rows * k_pad * base_bytes     # coef, dbl-buf
+                  + rt * rows * t_pad * compute_bytes      # res scratch
+                  + rt * pl_pad * pf_pad * compute_bytes   # flat scratch
+                  + 2 * rt * LANES * compute_bytes)        # out, dbl-buf
+        if fixed + moving <= budget_bytes:
+            return rt
+    return 1
+
+
+def chunk_bytes_model(nreal: int, npsr: int, ntoa: int, k_coef: int,
+                      mode: str = "xla", psr_shards: int = 1,
+                      dtype_bytes: int = 4) -> int:
+    """Analytic HBM bytes/chunk of the statistic dataflow, per mode.
+
+    The TPU-fused accounting: elementwise chains (the threefry draw chain,
+    masks, scalings) fuse into their consumers, so what actually crosses
+    HBM is the materialized tensors — residual/base writes, matmul operand
+    reads, collective payloads. XLA cost analysis reports exactly this on
+    TPU; on the CPU stand-in it cannot (XLA:CPU leaves the draw chain
+    unfused, and interpret-mode Pallas runs as a while loop whose full
+    operand state is tallied once more per buffer), so this model is the
+    recorded roofline source of truth off-TPU, beside the measured number.
+    Single-sourced here so bench.py / benchmarks/roofline.py / the
+    RunReport cost capture cannot drift.
+
+    Modes: ``'xla'`` (two-stage einsum path), ``'fused'`` (binned-
+    correlation kernel: the (R, P, P) tensor stays in VMEM), ``'mega'``
+    (whole-chunk megakernel: dense basis and projected residuals never
+    materialize), ``'mega_bf16'`` (megakernel + bf16 base/coefficient
+    storage).
+    """
+    if mode not in ("xla", "fused", "mega", "mega_bf16"):
+        raise ValueError(f"unknown mode {mode!r}")
+    b = dtype_bytes
+    p_local = npsr // psr_shards
+    rpt_l = nreal * p_local * ntoa          # this shard's residual block
+    rpt_f = nreal * npsr * ntoa             # the gathered full block
+    rpk_l = nreal * p_local * k_coef
+    rpk_f = nreal * npsr * k_coef
+    rpp = nreal * p_local * npsr            # correlation rows
+    gathered = psr_shards > 1
+    if mode in ("xla", "fused"):
+        n = (rpt_l * b                      # residual base write
+             + rpt_l * b + p_local * ntoa * k_coef * b + rpk_l * b
+             + rpt_l * b)                   # projection: reads + res write
+        if gathered:
+            n += 2 * rpt_f * b              # all_gather write + read-back
+        n += (rpt_l + (rpt_f if gathered else rpt_l)) * b  # corr reads
+        if mode == "xla":
+            n += 3 * rpp * b                # corr write + 2 binning reads
+        return int(n)
+    sb = 2 if mode == "mega_bf16" else b    # bf16-STORAGE halves these
+    n = rpt_l * sb + rpk_l * sb             # base + coefficient writes
+    if gathered:
+        n += 2 * (rpt_f + rpk_f) * sb       # all_gathers write + kernel read
+        n += (rpt_l + rpk_l) * sb           # kernel reads the local operands
+    else:
+        n += (rpt_l + rpk_l) * sb           # shared path: one read each
+    return int(n)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("stages", "nbins", "rt", "interpret",
+                              "precision"))
+def chunk_stats(base_local, base_full, coef_local, coef_full,
+                times_local, times_full, scales_local, scales_full,
+                weights, *, stages: Tuple[MegaStage, ...], nbins: int,
+                rt: int = 4, interpret: bool = False,
+                precision: str = "f32"):
+    """Fused residual-assembly + correlation + binning over one chunk shard.
+
+    base_local / base_full: (R, PL, T) / (R, PF, T) residual bases (white +
+        ECORR + system + deterministic stages, TOA-masked). Pass
+        ``base_local=None`` for the shared (psr_shards == 1) path — the
+        full operands then feed both sides of the correlation and the
+        local working set is skipped entirely.
+    coef_*: (R, PL, K) / (R, PF, K) concatenated GP coefficients in the
+        engine's stage order (red, dm, chrom, GWB basis groups; cos rows
+        then sin rows per stage — the ``(2, N) -> 2N`` reshape).
+    times_*: (2, P, T) staged time tables (row T_OWN, row T_COMMON).
+    scales_*: (S, P, T) chromatic scale tables; every row carries the TOA
+        mask (0 at padding), so recomputed bases vanish off the data.
+    weights: (nbins + 1, PL, PF) statistic weights — angular bins, any OS
+        slots, and the auto trace, exactly the binned-correlation kernel's
+        contract.
+    precision: ``'f32'`` (default — full-precision dots, stream-compatible
+        with the XLA path) or ``'bf16'`` (bf16 correlation operands with
+        f32 accumulation; pair with bf16 base storage for the byte win).
+        The basis recompute and the coefficient projection always run at
+        full precision: they set the realization stream, not just the
+        statistic.
+
+    Returns (curves (R, nbins), autos (R,)) — local partial sums; callers
+    inside shard_map psum over 'psr'.
+    """
+    if precision not in ("f32", "bf16"):
+        raise ValueError(
+            f"precision must be 'f32' or 'bf16', got {precision!r}")
+    shared = base_local is None
+    bf16 = precision == "bf16"
+    cdtype = jnp.float32 if base_full.dtype == jnp.bfloat16 \
+        else base_full.dtype
+    R = base_full.shape[0]
+    if R % rt != 0:
+        raise ValueError(f"nreal per shard ({R}) must be divisible by "
+                         f"rt={rt}")
+    if nbins + 1 > LANES:
+        raise ValueError(f"nbins={nbins} does not fit the {LANES}-lane "
+                         f"output")
+    k = stage_k(stages)
+    p_local = weights.shape[1] if shared else base_local.shape[1]
+    orig = (p_local, base_full.shape[1], base_full.shape[2], k)
+    pl_pad, pf_pad, t_pad, k_pad = _padded_dims_mega(*orig)
+    p_actual = base_full.shape[1]
+
+    def prep(base, coef, times, scales, p_mult):
+        base = _pad_to(_pad_to(base, 2, LANES), 1, p_mult)
+        times = _pad_to(_pad_to(times, 2, LANES), 1, p_mult)
+        scales = _pad_to(_pad_to(scales, 2, LANES), 1, p_mult)
+        if k:
+            coef = _pad_to(_pad_to(coef, 2, LANES), 1, p_mult)
+        else:
+            coef = jnp.zeros((R, base.shape[1], LANES), cdtype)
+        return base, coef, times, scales
+
+    base_full, coef_full, times_full, scales_full = prep(
+        base_full, coef_full, times_full, scales_full, LANES)
+    assert (base_full.shape[1], base_full.shape[2]) == (pf_pad, t_pad), \
+        "padding rules drifted from _padded_dims_mega — update both"
+    if not shared:
+        base_local, coef_local, times_local, scales_local = prep(
+            base_local, coef_local, times_local, scales_local, SUBLANES)
+        assert base_local.shape[1] == pl_pad
+    weights = _pad_to(_pad_to(weights, 2, LANES), 1, SUBLANES)
+    assert weights.shape[1:] == (pl_pad, pf_pad)
+    k_eff = max(k_pad, LANES)   # the zero-coef placeholder is LANES wide
+    nt, ns = times_full.shape[0], scales_full.shape[0]
+
+    # flatten the weights row-major to match corr.reshape(-1); pad the bin
+    # axis to a sublane multiple for the (NB8, PL*PF) NT binning operand
+    w2 = _pad_to(weights.reshape(nbins + 1, pl_pad * pf_pad), 0, SUBLANES)
+
+    kernel = functools.partial(
+        _mega_kernel, rt=rt, nbins=nbins, stages=stages, p_actual=p_actual,
+        p_actual_l=p_local, pl_pad=pl_pad, k_pad=k_eff, shared=shared,
+        bf16=bf16, cdtype=cdtype)
+
+    def fixed_spec(shape):
+        nil = tuple(0 for _ in shape)
+        return pl.BlockSpec(shape, lambda i, _z=nil: _z,
+                            memory_space=pltpu.VMEM)
+
+    full_specs = [
+        pl.BlockSpec((rt, pf_pad, t_pad), lambda i: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((rt, pf_pad, k_eff), lambda i: (i, 0, 0),
+                     memory_space=pltpu.VMEM),
+        fixed_spec((nt, pf_pad, t_pad)),
+        fixed_spec((ns, pf_pad, t_pad)),
+    ]
+    full_args = [base_full, coef_full, times_full, scales_full]
+    scratch = [pltpu.VMEM((rt, pf_pad, t_pad), cdtype),
+               pltpu.VMEM((rt, pl_pad * pf_pad), cdtype)]
+    if shared:
+        in_specs = full_specs + [fixed_spec(w2.shape)]
+        args = full_args + [w2]
+    else:
+        in_specs = [
+            pl.BlockSpec((rt, pl_pad, t_pad), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            full_specs[0],
+            pl.BlockSpec((rt, pl_pad, k_eff), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            full_specs[1],
+            fixed_spec((nt, pl_pad, t_pad)), full_specs[2],
+            fixed_spec((ns, pl_pad, t_pad)), full_specs[3],
+            fixed_spec(w2.shape),
+        ]
+        args = [base_local, base_full, coef_local, coef_full,
+                times_local, times_full, scales_local, scales_full, w2]
+        scratch = [pltpu.VMEM((rt, pl_pad, t_pad), cdtype)] + scratch
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(R // rt,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, rt, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((R // rt, rt, LANES), cdtype),
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    out = out.reshape(R, LANES)
+    return out[:, :nbins], out[:, nbins]

@@ -333,7 +333,7 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                     include_chrom, include_sys, include_gwb,
                     samp_static=(), samp_params=(), bases_bf16=False,
                     white_static=None, white_params=None, white_toaerr2=None,
-                    white_bid=None, white_nb=1, toa_shards=1):
+                    white_bid=None, white_nb=1, toa_shards=1, split_gp=False):
     """Simulate residual blocks for a chunk of realizations (shard_map body).
 
     keys: (R_local,) per-realization keys (identical across psr shards).
@@ -358,6 +358,16 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
     bit-identical to the unsharded program on any time sharding; every other
     draw (GP/GWB coefficients, hyperparameters, sources) is T-independent and
     identical on every time shard by key construction.
+
+    ``split_gp=True`` is the megakernel contract (:mod:`fakepta_tpu.ops
+    .megakernel`): the GP stages' coefficient DRAWS run unchanged (same
+    keys, same order — streams are byte-identical to the projected
+    program's), but the dense-basis projection is skipped and the function
+    returns ``(base, coeffs, gp_basis_all)`` — the masked white/ECORR/
+    system residual base (R, P, T), the concatenated per-realization GP
+    coefficients (R, P, K) in stage order, and the dense basis (P, T, K)
+    for callers that still need an XLA-side projection (the lnlike lane's
+    Woodbury moments; XLA dead-code-eliminates it when unused).
     """
     from .. import spectrum as spectrum_lib
     p_local = batch.t_own.shape[0]
@@ -666,6 +676,10 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                     g = gwb_group[j]
                     gwb_c[g] = c if gwb_c[g] is None else gwb_c[g] + c
                 coeffs.extend(gwb_c)
+        if split_gp:
+            c_all = (jnp.concatenate(coeffs, axis=-1) if coeffs
+                     else jnp.zeros((p_local, 0), dtype))
+            return jnp.where(batch.mask, res, 0.0), c_all
         if coeffs:
             with obs.span("gp_project"):
                 c_all = jnp.concatenate(coeffs, axis=-1)
@@ -675,6 +689,9 @@ def _simulate_block(keys, batch: PulsarBatch, chols, gwb_ws, gwb_idxs,
                                        preferred_element_type=dtype)
         return jnp.where(batch.mask, res, 0.0)
 
+    if split_gp:
+        base, c_all = jax.vmap(one)(keys)
+        return base, c_all, gp_basis_all
     return jax.vmap(one)(keys)
 
 
@@ -1075,8 +1092,15 @@ class EnsembleSimulator:
                  backend_id=None, waveform=None, compile_cache_dir=None):
         """``noise_sample`` takes :class:`NoiseSampling` config(s) — per-
         realization (log10_A, gamma) draws replacing the fixed PSD of the
-        red/dm/chrom/gwb stages. ``use_pallas`` enables the fused statistic kernel
-        (:mod:`fakepta_tpu.ops.pallas_kernels`); ``pallas_precision`` is
+        red/dm/chrom/gwb stages. ``use_pallas`` selects the statistic path:
+        ``True`` enables the fused binned-correlation kernel
+        (:mod:`fakepta_tpu.ops.pallas_kernels`); ``'mega'`` enables the
+        whole-chunk megakernel (:mod:`fakepta_tpu.ops.megakernel`) — GP
+        projection, correlation and binning fused in VMEM with the Fourier
+        bases recomputed in-kernel, the HBM-roofline path; its default
+        statistic precision is full f32 (stream-compatible with the XLA
+        path) and ``run(precision='bf16')`` opts into the bf16-storage /
+        f32-accumulate mode per run. ``pallas_precision`` is
         ``'bf16'`` (default: bf16 matmul operands with f32 accumulation —
         ~4e-3 relative rounding on individual pair correlations, 2x the MXU
         rate) or ``'f32'`` (full-precision matmul at half rate). The XLA path
@@ -1435,7 +1459,16 @@ class EnsembleSimulator:
         # fixed chunk size. On non-TPU platforms it runs in interpret mode
         # (tests); on TPU it is a real Mosaic kernel.
         platform = self.mesh.devices.flat[0].platform
-        self._use_pallas = bool(use_pallas)
+        if use_pallas not in (None, False, True, "mega"):
+            raise ValueError(f"use_pallas must be False, True or 'mega', "
+                             f"got {use_pallas!r}")
+        # statistic path: 'xla' (two-stage einsums), 'fused' (the binned-
+        # correlation Pallas kernel) or 'mega' (the whole-chunk megakernel,
+        # fakepta_tpu.ops.megakernel — GP projection + correlation + binning
+        # in VMEM, bases recomputed in-kernel)
+        self._stat_path = ("mega" if use_pallas == "mega"
+                           else "fused" if use_pallas else "xla")
+        self._use_pallas = self._stat_path != "xla"
         self._pallas_interpret = platform != "tpu"
         if pallas_precision not in ("bf16", "f32"):
             raise ValueError(f"pallas_precision must be 'bf16' or 'f32', "
@@ -1451,6 +1484,12 @@ class EnsembleSimulator:
         # XLA's TPU default (accumulation stays f32); realizations shift by
         # the ~4e-3 operand rounding
         self._bases_bf16 = bases_dtype == "bf16"
+        if self._bases_bf16 and self._stat_path == "mega":
+            raise ValueError(
+                "bases_dtype='bf16' is inert under use_pallas='mega' (the "
+                "megakernel recomputes bases in VMEM and never reads the "
+                "dense one); use run(precision='bf16') for the bf16-storage "
+                "mode instead")
         if stats_dtype not in ("f32", "bf16"):
             raise ValueError(f"stats_dtype must be 'f32' or 'bf16', got "
                              f"{stats_dtype!r}")
@@ -1490,8 +1529,17 @@ class EnsembleSimulator:
         # keyed by the (hashable) LikelihoodSpec + mode + path
         self._lnlike_compiled_cache: dict = {}
         self._step_lnlike_cache: dict = {}
-        self._step = self._build_step()
-        self._step_fused = self._build_step_fused() if self._use_pallas else None
+        self._step_xla_cache: dict = {}
+        self._step_mega_cache: dict = {}
+        self._mega_tables = None
+        self._step = self._build_step(self._stats_bf16)
+        self._step_xla_cache[self._stats_bf16] = self._step
+        self._step_fused = (self._build_step_fused()
+                            if self._stat_path == "fused" else None)
+        # build the default megakernel step eagerly so configuration errors
+        # surface at construction, like the fused path
+        self._step_mega = (self._get_step_mega(0, False, "f32")
+                           if self._stat_path == "mega" else None)
 
     def _obs_note_trace(self, signature) -> None:
         """Retrace guard: called from INSIDE the jitted steps, so it executes
@@ -1510,18 +1558,19 @@ class EnsembleSimulator:
             obs.event("retrace", value=list(map(str, signature)),
                       count=n)
 
-    def _obs_capture_cost(self, base_key, chunk: int, fused: bool,
-                          w_os=None, with_null: bool = False,
-                          lnl=None) -> dict:
+    def _obs_capture_cost(self, base_key, chunk: int, path: str,
+                          precision: str = "f32", w_os=None,
+                          with_null: bool = False, lnl=None) -> dict:
         """One-time XLA cost/memory analysis of the chunk program (cached per
-        simulator and step variant — plain/fused/OS/OS+null programs have
-        genuinely different FLOPs/bytes, and the OS lane's bytes-per-chunk is
-        a recorded benchmark metric). Uses the AOT path, which compiles a
-        second executable — that one extra compile is the documented price of
-        making the roofline's FLOPs/bytes a recorded artifact; events it
-        emits are sunk into a throwaway collector so they never pollute run
+        simulator and step variant — plain/fused/megakernel/OS/OS+null
+        programs and the f32/bf16 precision modes have genuinely different
+        FLOPs/bytes, and per-mode bytes-per-chunk is a recorded benchmark
+        metric). Uses the AOT path, which compiles a second executable —
+        that one extra compile is the documented price of making the
+        roofline's FLOPs/bytes a recorded artifact; events it emits are
+        sunk into a throwaway collector so they never pollute run
         metrics."""
-        cache_key = (int(chunk), bool(fused),
+        cache_key = (int(chunk), str(path), str(precision),
                      None if w_os is None else int(w_os.shape[0]),
                      bool(with_null),
                      None if lnl is None else lnl[2])
@@ -1536,28 +1585,38 @@ class EnsembleSimulator:
                               for _ in self._cgw_psrterm)
                 # scratch=None: the cost capture measures the program's
                 # FLOPs/bytes, which donation aliasing does not change
+                stats_bf16 = precision == "bf16"
                 if lnl is not None:
                     lnl_step, lnl_theta, _ = lnl
-                    if fused:
+                    if path != "xla":
                         lowered = lnl_step.lower(base_key, 0, chunk,
                                                  lnl_theta, bulks, None)
                     else:
                         lowered = lnl_step.lower(base_key, 0, chunk,
                                                  lnl_theta, bulks, None,
                                                  False)
-                elif w_os is not None and fused:
+                elif w_os is not None and path == "mega":
+                    lowered = self._get_step_mega(
+                        int(w_os.shape[0]), with_null, precision).lower(
+                            base_key, 0, chunk, w_os, bulks, None)
+                elif w_os is not None and path == "fused":
                     lowered = self._get_step_fused_os(
-                        int(w_os.shape[0]), with_null).lower(
+                        int(w_os.shape[0]), with_null, precision).lower(
                             base_key, 0, chunk, w_os, bulks, None)
                 elif w_os is not None:
-                    lowered = self._get_step_os(with_null).lower(
+                    lowered = self._get_step_os(with_null, stats_bf16).lower(
                         base_key, 0, chunk, w_os, bulks, None, False)
-                elif fused:
-                    lowered = self._step_fused.lower(
+                elif path == "mega":
+                    lowered = self._get_step_mega(0, False, precision).lower(
                         base_key, 0, chunk, self._w_os_empty, bulks, None)
+                elif path == "fused":
+                    lowered = self._get_step_fused_os(
+                        0, False, precision).lower(
+                            base_key, 0, chunk, self._w_os_empty, bulks,
+                            None)
                 else:
-                    lowered = self._step.lower(base_key, 0, chunk, bulks,
-                                               None, False)
+                    lowered = self._get_step_xla(stats_bf16).lower(
+                        base_key, 0, chunk, bulks, None, False)
                 compiled = lowered.compile()
                 ca = compiled.cost_analysis()
                 ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
@@ -1579,6 +1638,24 @@ class EnsembleSimulator:
             pass    # best-effort: absent on some backends/jax builds
         finally:
             self._obs_in_capture = False
+        try:
+            # the analytic HBM model beside the measured number: on TPU the
+            # two agree to fusion detail; on the CPU stand-in the measured
+            # one is polluted by XLA:CPU's unfused draw chain and the
+            # interpret-mode loop accounting, so the model is the recorded
+            # roofline source of truth there (ops/megakernel.py docstring)
+            from ..ops.megakernel import chunk_bytes_model, stage_k
+            if self._mega_tables is None:
+                self._mega_tables = self._build_mega_tables()
+            mode = {"xla": "xla", "fused": "fused"}.get(
+                path, "mega_bf16" if precision == "bf16" else "mega")
+            cost["model_bytes_per_chunk"] = chunk_bytes_model(
+                chunk, self.batch.npsr, self.batch.max_toa,
+                stage_k(self._mega_tables[0]), mode=mode,
+                psr_shards=self.mesh.shape[PSR_AXIS],
+                dtype_bytes=np.dtype(self.batch.t_own.dtype).itemsize)
+        except Exception:
+            pass
         self._obs_cost[cache_key] = cost
         return cost
 
@@ -1676,20 +1753,34 @@ class EnsembleSimulator:
 
     def _residuals(self, keys, batch, chols, gwb_ws, det, samp_params,
                    white_params, white_toaerr2, white_bid, cgw_trel,
-                   cgw_pdist, cgw_bulks, roe, *, toa_shards, null=False):
+                   cgw_pdist, cgw_bulks, roe, *, toa_shards, null=False,
+                   split_gp=False):
         """(R_local, P_local, T) residual blocks inside a shard_map body.
 
         The single signal-assembly path every step variant (XLA, fused
-        Pallas, OS, OS+null) shares, so adding a stage cannot fork the
-        program. Term order is frozen (noise block, deterministic block,
-        sampled Roemer, sampled CGW): f32 addition order is part of the
-        realization-stream contract. ``null=True`` is the OS lane's paired
-        noise-only stream — same noise stages and sampled noise nuisances
-        under the caller's (derived) keys, but no common correlated signal,
-        no deterministic block and no sampled CGW sources.
+        Pallas, megakernel, OS, OS+null) shares, so adding a stage cannot
+        fork the program. Term order is frozen (noise block, deterministic
+        block, sampled Roemer, sampled CGW): f32 addition order is part of
+        the realization-stream contract. ``null=True`` is the OS lane's
+        paired noise-only stream — same noise stages and sampled noise
+        nuisances under the caller's (derived) keys, but no common
+        correlated signal, no deterministic block and no sampled CGW
+        sources.
+
+        ``split_gp=True`` (the megakernel contract) returns ``(base,
+        coeffs, gp_basis_all)``: the residual WITHOUT the GP projection —
+        but with the deterministic/sampled delay terms added, so the base
+        is everything the kernel does not recompute — plus the coefficient
+        tensor and the dense basis (see :func:`_simulate_block`). The GP
+        projection then lands *last* in the addition order (inside the
+        kernel), vs. before the deterministic terms on the projected path:
+        with no det/roemer/cgw terms the two orders are identical ops, and
+        with them the difference is one f32 reassociation (bounded by the
+        engine's common mesh-invariance tolerance, pinned in
+        tests/test_megakernel.py).
         """
         inc = self._include if not null else self._include[:6] + (False,)
-        res = _simulate_block(keys, batch, chols, gwb_ws, self._gwb_idx,
+        out = _simulate_block(keys, batch, chols, gwb_ws, self._gwb_idx,
                               self._gwb_freqf, *inc,
                               samp_static=self._samp_static,
                               samp_params=samp_params,
@@ -1698,7 +1789,11 @@ class EnsembleSimulator:
                               white_params=white_params,
                               white_toaerr2=white_toaerr2,
                               white_bid=white_bid, white_nb=self._white_nb,
-                              toa_shards=toa_shards)
+                              toa_shards=toa_shards, split_gp=split_gp)
+        if split_gp:
+            res, coeffs, basis = out
+        else:
+            res = out
         if self._has_det and not null:
             res = res + det[None]
         for j in range(len(self._roe_states)):
@@ -1712,6 +1807,8 @@ class EnsembleSimulator:
                                     self._cgw_ranges[j], stat, tag=j,
                                     bulk=bulks.get(j))
                 res = res + jnp.where(batch.mask, term, 0.0)
+        if split_gp:
+            return res, coeffs, basis
         return res
 
     def _step_in_specs(self, has_toa):
@@ -1733,7 +1830,24 @@ class EnsembleSimulator:
                 *(tuple(_orbit_state_specs(has_toa)
                         for _ in self._roe_states)))
 
-    def _make_corr_sharded(self, with_null):
+    def _resolve_precision(self, path: str, precision) -> str:
+        """Effective statistic precision for a run: the run-level override
+        (``run(precision=...)``) or the path's constructor default — the
+        XLA path's ``stats_dtype``, the fused kernel's ``pallas_precision``,
+        and full f32 for the megakernel (which is stream-compatible with
+        the XLA path by default; bf16 storage is the explicit opt-in)."""
+        if precision is None:
+            if path == "xla":
+                return "bf16" if self._stats_bf16 else "f32"
+            if path == "fused":
+                return self._pallas_precision
+            return "f32"
+        if precision not in ("f32", "bf16"):
+            raise ValueError(f"precision must be 'f32' or 'bf16', got "
+                             f"{precision!r}")
+        return precision
+
+    def _make_corr_sharded(self, with_null, stats_bf16):
         """shard_map'd raw-pair-sum program behind the XLA step variants.
 
         Yields corr (R, P, P) sharded over (real, psr) — plus the paired
@@ -1752,7 +1866,7 @@ class EnsembleSimulator:
                                   white_params, white_toaerr2, white_bid,
                                   cgw_trel, cgw_pdist, cgw_bulks, roe,
                                   toa_shards=toa_shards)
-            corr = _correlation_rows(res, stats_bf16=self._stats_bf16,
+            corr = _correlation_rows(res, stats_bf16=stats_bf16,
                                      toa_psum=has_toa)
             if not with_null:
                 return corr
@@ -1764,7 +1878,7 @@ class EnsembleSimulator:
                                        white_toaerr2, white_bid, cgw_trel,
                                        cgw_pdist, cgw_bulks, roe,
                                        toa_shards=toa_shards, null=True)
-                corr0 = _correlation_rows(res0, stats_bf16=self._stats_bf16,
+                corr0 = _correlation_rows(res0, stats_bf16=stats_bf16,
                                           toa_psum=has_toa)
             return corr, corr0
 
@@ -1789,8 +1903,8 @@ class EnsembleSimulator:
         autos = jnp.einsum("rpq,pq->r", corr, self._w_auto, precision=hi)
         return curves, autos
 
-    def _build_step(self):
-        shmapped = self._make_corr_sharded(False)
+    def _build_step(self, stats_bf16=False):
+        shmapped = self._make_corr_sharded(False, stats_bf16)
 
         # ``scratch`` is the donated output-recycling buffer (the pipelined
         # run loop hands back a drained chunk's packed array): same shape,
@@ -1804,7 +1918,7 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal, cgw_bulks, scratch,
                  with_corr=False):
             # trace-time only: the retrace guard (see _obs_note_trace)
-            self._obs_note_trace(("step", nreal, with_corr,
+            self._obs_note_trace(("step", nreal, with_corr, stats_bf16,
                                   scratch is not None))
             # per-realization keys derived on device: one tiny transfer per chunk
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
@@ -1825,7 +1939,14 @@ class EnsembleSimulator:
 
         return step
 
-    def _build_step_os(self, with_null):
+    def _get_step_xla(self, stats_bf16):
+        step = self._step_xla_cache.get(bool(stats_bf16))
+        if step is None:
+            step = self._build_step(bool(stats_bf16))
+            self._step_xla_cache[bool(stats_bf16)] = step
+        return step
+
+    def _build_step_os(self, with_null, stats_bf16=False):
         """XLA step with the OS lane: per-ORF amp2 packed beside curves/autos.
 
         ``w_os`` is the (K, P, P) stack of ``fakepta_tpu.detect`` operator
@@ -1836,7 +1957,7 @@ class EnsembleSimulator:
         instead of forcing ``keep_corr=True``. ``with_null`` adds the paired
         noise-only stream's lanes for on-device null calibration.
         """
-        shmapped = self._make_corr_sharded(with_null)
+        shmapped = self._make_corr_sharded(with_null, stats_bf16)
 
         # scratch: donated packed-output recycling buffer (see _build_step)
         @partial(jax.jit, static_argnums=(2, 6), donate_argnums=(5,),
@@ -1846,7 +1967,7 @@ class EnsembleSimulator:
             # trace-time only: the retrace guard (see _obs_note_trace)
             # w_os.shape[0] is a static Python int at trace time
             self._obs_note_trace(("step_os", nreal, w_os.shape[0],
-                                  with_null, with_corr,
+                                  with_null, with_corr, stats_bf16,
                                   scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
@@ -1870,20 +1991,23 @@ class EnsembleSimulator:
 
         return step
 
-    def _get_step_os(self, with_null):
-        step = self._step_os_cache.get(bool(with_null))
+    def _get_step_os(self, with_null, stats_bf16=False):
+        key = (bool(with_null), bool(stats_bf16))
+        step = self._step_os_cache.get(key)
         if step is None:
-            step = self._build_step_os(bool(with_null))
-            self._step_os_cache[bool(with_null)] = step
+            step = self._build_step_os(*key)
+            self._step_os_cache[key] = step
         return step
 
     def _build_step_fused(self):
         """The plain fused statistic path — the n_os=0 case of
         :meth:`_build_step_fused_os` (one builder, so the OS lanes cannot
         fork the kernel program)."""
-        return self._build_step_fused_os(0, False)
+        return self._build_step_fused_os(0, False, self._pallas_precision)
 
-    def _build_step_fused_os(self, n_os, with_null):
+    def _build_step_fused_os(self, n_os, with_null, kernel_prec=None):
+        if kernel_prec is None:
+            kernel_prec = self._pallas_precision
         """Pallas statistic path: one kernel computes curves+autos (and any
         OS lanes) from residuals with the per-realization correlation block
         kept in VMEM (see :mod:`fakepta_tpu.ops.pallas_kernels`).
@@ -1928,7 +2052,7 @@ class EnsembleSimulator:
             with obs.span("correlate"):
                 curves_p, autos_p = binned_correlation(
                     res, res_full, weights, nbins=nb_eff, rt=rt,
-                    interpret=interpret, precision=self._pallas_precision,
+                    interpret=interpret, precision=kernel_prec,
                     mxu_binning=self._pallas_mxu_binning)
                 # the only other collective: reduce partial bin sums over
                 # psr shards
@@ -1951,7 +2075,7 @@ class EnsembleSimulator:
                     null_p, _ = binned_correlation(
                         res0, res0_full, w_null, nbins=n_os, rt=rt0,
                         interpret=interpret,
-                        precision=self._pallas_precision,
+                        precision=kernel_prec,
                         mxu_binning=self._pallas_mxu_binning)
                     outs.append(lax.psum(null_p, PSR_AXIS))
             return tuple(outs)
@@ -1974,7 +2098,7 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal, w_os, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_fused", nreal, n_os, with_null,
-                                  scratch is not None))
+                                  kernel_prec, scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             if n_os:
@@ -2001,13 +2125,242 @@ class EnsembleSimulator:
 
         return step
 
-    def _get_step_fused_os(self, n_os, with_null):
-        key = (int(n_os), bool(with_null))
+    def _get_step_fused_os(self, n_os, with_null, kernel_prec=None):
+        if kernel_prec is None:
+            kernel_prec = self._pallas_precision
+        key = (int(n_os), bool(with_null), str(kernel_prec))
         step = self._step_fused_os_cache.get(key)
         if step is None:
-            step = (self._step_fused if key == (0, False) else
-                    self._build_step_fused_os(*key))
+            step = (self._step_fused
+                    if key == (0, False, self._pallas_precision)
+                    and self._step_fused is not None
+                    else self._build_step_fused_os(*key))
             self._step_fused_os_cache[key] = step
+        return step
+
+    def _build_mega_tables(self):
+        """Static stage descriptors + staged time/scale tables for the
+        whole-chunk megakernel (:mod:`fakepta_tpu.ops.megakernel`).
+
+        Mirrors ``_simulate_block``'s GP stage order and basis-group
+        dedup EXACTLY (red, dm, chrom, then one stage per distinct
+        ``(idx, freqf, ncomp)`` GWB signature), so the kernel's
+        recomputed bases line up element-for-element with the dense ones
+        and the concatenated coefficient layout. Scale rows are the same
+        dtype expressions the XLA path evaluates, masked to the valid
+        TOAs (where the XLA path masks after projection, the kernel's
+        bases vanish at the source — identical values either way).
+        Returns ``(stages, stages_null, times (2, P, T), scales
+        (S, P, T))``; the null stream's stages drop the GWB entries (its
+        residuals carry no common signal, so its coefficient tensor is
+        correspondingly narrower).
+        """
+        from ..ops.megakernel import T_COMMON, T_OWN, MegaStage
+
+        batch = self.batch
+        dtype = batch.t_own.dtype
+        rows, row_idx = [], {}
+
+        def scale_row(key, build):
+            if key not in row_idx:
+                row_idx[key] = len(rows)
+                rows.append(jnp.where(batch.mask, build(), 0.0)
+                            .astype(dtype))
+            return row_idx[key]
+
+        plain = scale_row(("plain",), lambda: jnp.ones((), dtype))
+        stages = []
+        (_, _, inc_red, inc_dm, inc_chrom, _, inc_gwb) = self._include
+        if inc_red:
+            stages.append(MegaStage(batch.red_psd.shape[1], T_OWN, plain))
+        if inc_dm:
+            stages.append(MegaStage(
+                batch.dm_psd.shape[1], T_OWN,
+                scale_row(("chrom", 2.0),
+                          lambda: (1400.0 / batch.freqs) ** 2)))
+        if inc_chrom:
+            stages.append(MegaStage(
+                batch.chrom_psd.shape[1], T_OWN,
+                scale_row(("chrom", 4.0),
+                          lambda: (1400.0 / batch.freqs) ** 4)))
+        stages_null = tuple(stages)     # the 0xD7 stream has no GWB stage
+        if inc_gwb:
+            seen = set()
+            for idx_j, freqf_j, w_j in zip(self._gwb_idx, self._gwb_freqf,
+                                           self._gwb_w):
+                sig = (idx_j, freqf_j, int(w_j.shape[0]))
+                if sig in seen:
+                    continue
+                seen.add(sig)
+                scol = plain if not idx_j else scale_row(
+                    ("gwb", idx_j, freqf_j),
+                    lambda f=freqf_j, i=idx_j: (f / batch.freqs) ** i)
+                stages.append(MegaStage(sig[2], T_COMMON, scol))
+        times = jnp.stack([batch.t_own, batch.t_common])
+        return tuple(stages), stages_null, times, jnp.stack(rows)
+
+    def _mega_stats(self, base, coefs, times_l, scales_l, weights,
+                    stages_k, nb_k, store_bf16, shared):
+        """One megakernel invocation inside a shard_map body (shared by the
+        plain/OS/null and lnlike megakernel steps): optional bf16 base
+        storage, the base/coefficient/table all_gathers when pulsars are
+        sharded, the VMEM-model tile pick, and the kernel call itself.
+        Returns batch-dtype (curves_p, autos_p) partial sums."""
+        from ..ops.megakernel import chunk_stats, pick_rt_mega, stage_k
+
+        dtype = self.batch.t_own.dtype
+        base_bytes = 2 if store_bf16 else np.dtype(dtype).itemsize
+        comp_bytes = max(4, np.dtype(dtype).itemsize) if store_bf16 \
+            else np.dtype(dtype).itemsize
+        kprec = "bf16" if store_bf16 else "f32"
+        if store_bf16:
+            # the bf16-STORAGE mode: the (R, P, T) base and the (R, P, K)
+            # coefficients — the kernel's HBM reads — live in bfloat16;
+            # everything downstream accumulates in f32 (policy:
+            # analysis/policy.py BF16_STORAGE_MODULES)
+            base = base.astype(jnp.bfloat16)
+            coefs = coefs.astype(jnp.bfloat16)
+        if shared:
+            base_f, coef_f = base, coefs
+            times_f, scales_f = times_l, scales_l
+            base_l = coef_l = times_ll = scales_ll = None
+        else:
+            with obs.span("all_gather"):
+                base_f = lax.all_gather(base, PSR_AXIS, axis=1, tiled=True)
+                coef_f = lax.all_gather(coefs, PSR_AXIS, axis=1, tiled=True)
+                times_f = lax.all_gather(times_l, PSR_AXIS, axis=1,
+                                         tiled=True)
+                scales_f = lax.all_gather(scales_l, PSR_AXIS, axis=1,
+                                          tiled=True)
+            base_l, coef_l = base, coefs
+            times_ll, scales_ll = times_l, scales_l
+        rt = pick_rt_mega(base.shape[0], base.shape[1], base_f.shape[1],
+                          base.shape[2], stage_k(stages_k), nb_k, n_times=2,
+                          n_scales=int(scales_l.shape[0]), shared=shared,
+                          base_bytes=base_bytes, compute_bytes=comp_bytes)
+        with obs.span("megakernel"):
+            curves_p, autos_p = chunk_stats(
+                base_l, base_f, coef_l, coef_f, times_ll, times_f,
+                scales_ll, scales_f, weights, stages=stages_k, nbins=nb_k,
+                rt=rt, interpret=self._pallas_interpret, precision=kprec)
+        return curves_p.astype(dtype), autos_p.astype(dtype)
+
+    def _build_step_mega(self, n_os, with_null, precision="f32"):
+        """Whole-chunk megakernel step: residual assembly + correlation +
+        binning fused into one Pallas program per chunk.
+
+        XLA retains the draws, the hyperparameter sampling and the GP
+        coefficient assembly (``_residuals(split_gp=True)``) — streams are
+        byte-identical to every other path's — while the kernel recomputes
+        the Fourier bases in VMEM and keeps the projected residuals and
+        the correlation block on-chip (module docstring of
+        :mod:`fakepta_tpu.ops.megakernel` has the byte accounting). OS
+        lanes ride the same extra weight slots as the fused path; under
+        ``with_null`` the paired noise-only stream runs a second kernel
+        invocation with the GWB stage dropped from its descriptor.
+        ``precision='bf16'`` stores the residual base (the kernel's
+        dominant HBM read) in bfloat16 and runs bf16 correlation operands
+        with f32 accumulation — the run-level bf16-storage mode.
+        """
+        if not hasattr(self, "_stat_weights"):
+            self._stat_weights = jnp.concatenate(
+                [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]],
+                axis=0)
+        if self._mega_tables is None:
+            self._mega_tables = self._build_mega_tables()
+        stages, stages_null, times, scales = self._mega_tables
+        store_bf16 = precision == "bf16"
+        shared = self.mesh.shape[PSR_AXIS] == 1
+        has_toa = self._has_toa   # size-1 only: toa_shards > 1 raises at init
+        nbins = self.nbins
+        nb_eff = nbins + n_os
+
+        def kernel_call(base, coefs, times_l, scales_l, weights, stages_k,
+                        nb_k):
+            return self._mega_stats(base, coefs, times_l, scales_l, weights,
+                                    stages_k, nb_k, store_bf16, shared)
+
+        def sharded(keys, batch, chol, gwb_w, times_l, scales_l, weights,
+                    w_null, det, samp_params, white_params, white_toaerr2,
+                    white_bid, cgw_trel, cgw_pdist, cgw_bulks, *roe):
+            base, coefs, _ = self._residuals(
+                keys, batch, chol, gwb_w, det, samp_params, white_params,
+                white_toaerr2, white_bid, cgw_trel, cgw_pdist, cgw_bulks,
+                roe, toa_shards=1, split_gp=True)
+            curves_p, autos_p = kernel_call(base, coefs, times_l, scales_l,
+                                            weights, stages, nb_eff)
+            with obs.span("correlate"):
+                outs = [lax.psum(curves_p, PSR_AXIS),
+                        lax.psum(autos_p, PSR_AXIS)]
+            if with_null:
+                with obs.span("null"):
+                    nkeys = jax.vmap(
+                        lambda k: jax.random.fold_in(k, _NULL_TAG))(keys)
+                    base0, coefs0, _ = self._residuals(
+                        nkeys, batch, chol, gwb_w, det, samp_params,
+                        white_params, white_toaerr2, white_bid, cgw_trel,
+                        cgw_pdist, cgw_bulks, roe, toa_shards=1, null=True,
+                        split_gp=True)
+                    null_p, _ = kernel_call(base0, coefs0, times_l,
+                                            scales_l, w_null, stages_null,
+                                            n_os)
+                    outs.append(lax.psum(null_p, PSR_AXIS))
+            return tuple(outs)
+
+        specs = self._step_in_specs(has_toa)
+        table_spec = P(None, PSR_AXIS, None)
+        shmapped = shard_map(
+            sharded, mesh=self.mesh,
+            in_specs=(P(REAL_AXIS), specs[0], specs[1], specs[2],
+                      table_spec, table_spec, table_spec, table_spec,
+                      *specs[3:]),
+            out_specs=tuple(P(REAL_AXIS)
+                            for _ in range(2 + int(with_null))),
+            # pallas_call does not annotate vma on its outputs; the psum
+            # above makes them replicated over 'psr' by construction
+            check_vma=False,
+        )
+
+        # scratch: donated packed-output recycling buffer (see _build_step)
+        @partial(jax.jit, static_argnums=(2,), donate_argnums=(5,),
+                 keep_unused=True)
+        def step(base_key, offset, nreal, w_os, cgw_bulks, scratch):
+            # trace-time only: the retrace guard (see _obs_note_trace)
+            self._obs_note_trace(("step_mega", nreal, n_os, with_null,
+                                  precision, scratch is not None))
+            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                offset + jnp.arange(nreal))
+            if n_os:
+                weights = jnp.concatenate(
+                    [self._stat_weights[:nbins], w_os,
+                     self._stat_weights[nbins:]], axis=0)
+                w_null = jnp.concatenate(
+                    [w_os, jnp.zeros_like(w_os[:1])], axis=0)
+            else:
+                weights, w_null = self._stat_weights, w_os
+            out = shmapped(keys, self.batch, self._chol, self._gwb_w,
+                           times, scales, weights, w_null, self._det,
+                           self._samp_params, self._white_params,
+                           self._white_toaerr2, self._white_bid,
+                           self._cgw_trel, self._pdist, cgw_bulks,
+                           *self._roe_states)
+            curves_ext, autos = out[0], out[1]
+            extras = []
+            if n_os:
+                extras.append(curves_ext[:, nbins:])
+            if with_null:
+                extras.append(out[2])
+            # same packed single-transfer contract as the XLA step
+            return pack_stats(curves_ext[:, :nbins], autos, *extras)
+
+        return step
+
+    def _get_step_mega(self, n_os, with_null, precision="f32"):
+        key = (int(n_os), bool(with_null), str(precision))
+        step = self._step_mega_cache.get(key)
+        if step is None:
+            step = self._build_step_mega(*key)
+            self._step_mega_cache[key] = step
         return step
 
     def _lnlike_lanes(self, res, batch, theta, compiled, mode):
@@ -2084,20 +2437,29 @@ class EnsembleSimulator:
             lanes = lax.psum(lanes, PSR_AXIS)
         return lanes
 
-    def _build_step_lnlike(self, compiled, mode, fused):
+    def _build_step_lnlike(self, compiled, mode, path, precision=None):
         """Step with the lnlike lane packed beside curves/autos.
 
         The XLA variant mirrors :meth:`_build_step_os` (the lanes are extra
         ``pack_stats`` slots, so checkpointing/resume carry them via the
         ``n_extra`` manifest unchanged); the fused variant runs the Pallas
         statistic kernel for curves/autos while the likelihood lanes are
-        computed from the same residual blocks in the same program.
+        computed from the same residual blocks in the same program; the
+        megakernel variant feeds the whole-chunk kernel from the split
+        base/coefficient tensors while the Woodbury moments read an
+        XLA-projected residual from the very same draws (one trace, no
+        duplicate draw ops). ``precision`` is the per-run statistic
+        precision: it moves the curves/autos contraction only — the
+        likelihood moments always run at the batch dtype (the infer
+        module is not on the bf16 storage policy, analysis/policy.py).
         """
         has_toa = self._has_toa
         toa_shards = self._n_toa_shards
         specs = self._step_in_specs(has_toa)
+        precision = self._resolve_precision(path, precision)
 
-        if not fused:
+        if path == "xla":
+            stats_bf16 = precision == "bf16"
             def sharded(keys, batch, chol, gwb_w, theta, det, samp_params,
                         white_params, white_toaerr2, white_bid, cgw_trel,
                         cgw_pdist, cgw_bulks, *roe):
@@ -2106,7 +2468,7 @@ class EnsembleSimulator:
                                       white_toaerr2, white_bid, cgw_trel,
                                       cgw_pdist, cgw_bulks, roe,
                                       toa_shards=toa_shards)
-                corr = _correlation_rows(res, stats_bf16=self._stats_bf16,
+                corr = _correlation_rows(res, stats_bf16=stats_bf16,
                                          toa_psum=has_toa)
                 lanes = self._lnlike_lanes(res, batch, theta, compiled, mode)
                 return corr, lanes
@@ -2125,7 +2487,7 @@ class EnsembleSimulator:
                      with_corr=False):
                 # trace-time only: the retrace guard (see _obs_note_trace)
                 self._obs_note_trace(("step_lnlike", nreal, theta.shape,
-                                      mode, with_corr,
+                                      mode, with_corr, stats_bf16,
                                       scratch is not None))
                 keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                     offset + jnp.arange(nreal))
@@ -2142,13 +2504,84 @@ class EnsembleSimulator:
 
             return step
 
-        from ..ops.pallas_kernels import binned_correlation, pick_rt
-
         if not hasattr(self, "_stat_weights"):
             self._stat_weights = jnp.concatenate(
                 [jnp.moveaxis(self._w_bins, 2, 0), self._w_auto[None]],
                 axis=0)
         nbins = self.nbins
+        dtype = self.batch.t_own.dtype
+
+        if path == "mega":
+            if self._mega_tables is None:
+                self._mega_tables = self._build_mega_tables()
+            stages, _, times, scales = self._mega_tables
+            store_bf16 = precision == "bf16"
+            shared = self.mesh.shape[PSR_AXIS] == 1
+
+            def sharded(keys, batch, chol, gwb_w, theta, times_l, scales_l,
+                        weights, det, samp_params, white_params,
+                        white_toaerr2, white_bid, cgw_trel, cgw_pdist,
+                        cgw_bulks, *roe):
+                base, coefs, basis = self._residuals(
+                    keys, batch, chol, gwb_w, det, samp_params,
+                    white_params, white_toaerr2, white_bid, cgw_trel,
+                    cgw_pdist, cgw_bulks, roe, toa_shards=1, split_gp=True)
+                # the Woodbury moments read a full residual: project the
+                # SAME coefficients through the dense basis XLA-side (one
+                # trace — base/coefs are shared with the kernel operands,
+                # so no draw is ever duplicated); the statistic rides the
+                # megakernel from the split tensors
+                if basis is not None:
+                    with obs.span("gp_project"):
+                        proj = jnp.einsum("ptk,rpk->rpt", basis, coefs,
+                                          preferred_element_type=dtype)
+                    res = base + jnp.where(batch.mask, proj, 0.0)
+                else:
+                    res = base
+                curves_p, autos_p = self._mega_stats(
+                    base, coefs, times_l, scales_l, weights, stages, nbins,
+                    store_bf16, shared)
+                with obs.span("correlate"):
+                    curves = lax.psum(curves_p, PSR_AXIS)
+                    autos = lax.psum(autos_p, PSR_AXIS)
+                lanes = self._lnlike_lanes(res, batch, theta, compiled,
+                                           mode)
+                return curves, autos, lanes
+
+            table_spec = P(None, PSR_AXIS, None)
+            shmapped = shard_map(
+                sharded, mesh=self.mesh,
+                in_specs=(P(REAL_AXIS), specs[0], specs[1], specs[2], P(),
+                          table_spec, table_spec, table_spec, *specs[3:]),
+                out_specs=(P(REAL_AXIS), P(REAL_AXIS), P(REAL_AXIS)),
+                # pallas_call does not annotate vma on its outputs; the
+                # psums above make them replicated over 'psr'
+                check_vma=False,
+            )
+
+            # scratch: donated packed-output recycling (see _build_step)
+            @partial(jax.jit, static_argnums=(2,), donate_argnums=(5,),
+                     keep_unused=True)
+            def step(base_key, offset, nreal, theta, cgw_bulks, scratch):
+                # trace-time only: the retrace guard (see _obs_note_trace)
+                self._obs_note_trace(("step_mega_lnlike", nreal,
+                                      theta.shape, mode, precision,
+                                      scratch is not None))
+                keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+                    offset + jnp.arange(nreal))
+                curves, autos, lanes = shmapped(
+                    keys, self.batch, self._chol, self._gwb_w, theta,
+                    times, scales, self._stat_weights, self._det,
+                    self._samp_params, self._white_params,
+                    self._white_toaerr2, self._white_bid, self._cgw_trel,
+                    self._pdist, cgw_bulks, *self._roe_states)
+                return pack_stats(curves, autos, lanes)
+
+            return step
+
+        from ..ops.pallas_kernels import binned_correlation, pick_rt
+
+        kernel_prec = precision
         interpret = self._pallas_interpret
 
         def sharded(keys, batch, chol, gwb_w, theta, weights, det,
@@ -2166,7 +2599,7 @@ class EnsembleSimulator:
             with obs.span("correlate"):
                 curves_p, autos_p = binned_correlation(
                     res, res_full, weights, nbins=nbins, rt=rt,
-                    interpret=interpret, precision=self._pallas_precision,
+                    interpret=interpret, precision=kernel_prec,
                     mxu_binning=self._pallas_mxu_binning)
                 curves = lax.psum(curves_p, PSR_AXIS)
                 autos = lax.psum(autos_p, PSR_AXIS)
@@ -2189,7 +2622,8 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal, theta, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_fused_lnlike", nreal, theta.shape,
-                                  mode, scratch is not None))
+                                  mode, kernel_prec,
+                                  scratch is not None))
             keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
                 offset + jnp.arange(nreal))
             curves, autos, lanes = shmapped(
@@ -2201,11 +2635,12 @@ class EnsembleSimulator:
 
         return step
 
-    def _get_step_lnlike(self, model, mode, fused, compiled):
-        key = (model, str(mode), bool(fused))
+    def _get_step_lnlike(self, model, mode, path, compiled, precision=None):
+        resolved = self._resolve_precision(path, precision)
+        key = (model, str(mode), str(path), resolved)
         step = self._step_lnlike_cache.get(key)
         if step is None:
-            step = self._build_step_lnlike(compiled, mode, fused)
+            step = self._build_step_lnlike(compiled, mode, path, resolved)
             self._step_lnlike_cache[key] = step
         return step
 
@@ -2312,8 +2747,38 @@ class EnsembleSimulator:
             if ev is not None:
                 ev.set()
 
+    def chunk_cost(self, chunk: int, *, os=None, lnlike=None,
+                   keep_corr: bool = False, precision=None) -> dict:
+        """XLA cost analysis of ONE chunk program, without executing it.
+
+        Returns the ``{flops_per_chunk, bytes_per_chunk,
+        static_reservation_bytes}`` dict the RunReport's one-time capture
+        records (empty where the backend exposes no cost model). This is
+        the public handle the benchmarks use to record per-mode
+        (xla / fused / fused_bf16) bytes-per-chunk rows without paying a
+        measured run per mode — the roofline acceptance is a compile-time
+        artifact. Cached per (chunk, path, precision, lane) signature like
+        the in-run capture.
+        """
+        chunk = self._normalize_chunk(chunk, chunk)
+        lanes = self._prepare_lanes(os, lnlike)
+        path = "xla" if keep_corr else self._stat_path
+        prec = self._resolve_precision(path, precision)
+        base = rng_utils.as_key(0)
+        lnl = None
+        if lanes["lnl_compiled"] is not None:
+            step = self._get_step_lnlike(
+                lanes["lnl_spec"].model, lanes["lnl_spec"].mode, path,
+                lanes["lnl_compiled"], precision)
+            lnl = (step, lanes["lnl_theta"],
+                   (lanes["lnl_k"], lanes["lnl_l"], lanes["lnl_spec"].mode))
+        return dict(self._obs_capture_cost(
+            base, chunk, path, prec, w_os=lanes["w_os"],
+            with_null=bool(lanes["os_spec"].null) if lanes["os_spec"]
+            else False, lnl=lnl))
+
     def warm_start(self, chunk: int, *, keep_corr: bool = False, os=None,
-                   lnlike=None) -> float:
+                   lnlike=None, precision=None) -> float:
         """AOT-compile the chunk program ahead of the first :meth:`run`.
 
         Lowers and compiles the exact step executable ``run(chunk=chunk,
@@ -2329,7 +2794,9 @@ class EnsembleSimulator:
         t0 = time.perf_counter()
         chunk = self._normalize_chunk(chunk, chunk)
         lanes = self._prepare_lanes(os, lnlike)
-        fused = self._step_fused is not None and not keep_corr
+        path = "xla" if keep_corr else self._stat_path
+        prec = self._resolve_precision(path, precision)
+        stats_bf16 = prec == "bf16"
         base = rng_utils.as_key(0)
         dtype = self.batch.t_own.dtype
         n_lanes = self.nbins + 1 + lanes["n_extra"]
@@ -2343,9 +2810,9 @@ class EnsembleSimulator:
         try:
             if lanes["lnl_compiled"] is not None:
                 step = self._get_step_lnlike(
-                    lanes["lnl_spec"].model, lanes["lnl_spec"].mode, fused,
-                    lanes["lnl_compiled"])
-                if fused:
+                    lanes["lnl_spec"].model, lanes["lnl_spec"].mode, path,
+                    lanes["lnl_compiled"], precision)
+                if path != "xla":
                     lowered = step.lower(base, 0, chunk, lanes["lnl_theta"],
                                          bulks, scratch)
                 else:
@@ -2353,20 +2820,27 @@ class EnsembleSimulator:
                                          bulks, scratch, keep_corr)
             elif lanes["os_ops"] is not None:
                 null = lanes["os_spec"].null
-                if fused:
+                if path == "mega":
+                    lowered = self._get_step_mega(
+                        lanes["n_os"], null, prec).lower(
+                            base, 0, chunk, lanes["w_os"], bulks, scratch)
+                elif path == "fused":
                     lowered = self._get_step_fused_os(
-                        lanes["n_os"], null).lower(
+                        lanes["n_os"], null, prec).lower(
                             base, 0, chunk, lanes["w_os"], bulks, scratch)
                 else:
-                    lowered = self._get_step_os(null).lower(
+                    lowered = self._get_step_os(null, stats_bf16).lower(
                         base, 0, chunk, lanes["w_os"], bulks, scratch,
                         keep_corr)
-            elif fused:
-                lowered = self._step_fused.lower(
+            elif path == "mega":
+                lowered = self._get_step_mega(0, False, prec).lower(
+                    base, 0, chunk, self._w_os_empty, bulks, scratch)
+            elif path == "fused":
+                lowered = self._get_step_fused_os(0, False, prec).lower(
                     base, 0, chunk, self._w_os_empty, bulks, scratch)
             else:
-                lowered = self._step.lower(base, 0, chunk, bulks, scratch,
-                                           keep_corr)
+                lowered = self._get_step_xla(stats_bf16).lower(
+                    base, 0, chunk, bulks, scratch, keep_corr)
             lowered.compile()
         finally:
             self._obs_in_capture = prev
@@ -2374,7 +2848,7 @@ class EnsembleSimulator:
 
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
             checkpoint=None, progress=None, os=None, lnlike=None,
-            pipeline_depth: int = 2):
+            pipeline_depth: int = 2, precision=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
@@ -2439,6 +2913,19 @@ class EnsembleSimulator:
         reorder collective launches across processes. Realization streams
         are bit-identical at every depth. See docs/PERFORMANCE.md.
 
+        ``precision``: the per-run statistic precision mode — ``None``
+        (each path's constructor default), ``'f32'``, or ``'bf16'``. Under
+        ``'bf16'`` the statistic contraction runs bf16 *operands* with f32
+        accumulation on every path, and the megakernel path additionally
+        stores its (R, P, T) residual base in bfloat16 — the bf16-STORAGE
+        mode that halves the chunk program's dominant HBM read
+        (docs/PERFORMANCE.md has the per-mode byte table). Realization
+        draws and the likelihood lane's Woodbury moments always stay at
+        the batch dtype: which modules may down-cast is governed by the
+        ``analysis`` dtype policy (``BF16_STORAGE_MODULES``,
+        docs/INVARIANTS.md), and bf16 streams are certified against the
+        engine's mesh-invariance tolerances in tests/test_megakernel.py.
+
         Every run attaches a :class:`fakepta_tpu.obs.RunReport` under
         ``out["report"]`` (also ``self.last_report``): stage spans, per-chunk
         wall times (``synced`` marks chunks whose wall time included a device
@@ -2496,7 +2983,10 @@ class EnsembleSimulator:
                                          "keep_corr; cannot resume with it")
                     corr_out.append(state["corr"])
 
-        fused = self._step_fused is not None and not keep_corr
+        path = "xla" if keep_corr else self._stat_path
+        prec = self._resolve_precision(path, precision)
+        stats_bf16 = prec == "bf16"
+        fused = path != "xla"
         # The chunk executor (fakepta_tpu.parallel.pipeline): dispatches are
         # async either way; the *pipelined* loop additionally (a) precomputes
         # the NEXT chunk's CGW bulks while this one computes, (b) drains all
@@ -2522,8 +3012,9 @@ class EnsembleSimulator:
             """One async chunk dispatch -> (packed, corr-or-None)."""
             if lnl_compiled is not None:
                 lnl_step = self._get_step_lnlike(
-                    lnl_spec.model, lnl_spec.mode, fused, lnl_compiled)
-                if fused:
+                    lnl_spec.model, lnl_spec.mode, path, lnl_compiled,
+                    precision)
+                if path != "xla":
                     return lnl_step(base, offset, chunk, lnl_theta, bulks,
                                     scratch), None
                 if keep_corr:
@@ -2532,22 +3023,31 @@ class EnsembleSimulator:
                 return lnl_step(base, offset, chunk, lnl_theta, bulks,
                                 scratch, False), None
             if os_ops is not None:
-                if fused:
-                    return self._get_step_fused_os(n_os, os_spec.null)(
+                if path == "mega":
+                    return self._get_step_mega(n_os, os_spec.null, prec)(
+                        base, offset, chunk, w_os, bulks, scratch), None
+                if path == "fused":
+                    return self._get_step_fused_os(n_os, os_spec.null,
+                                                   prec)(
                         base, offset, chunk, w_os, bulks, scratch), None
                 if keep_corr:
-                    return self._get_step_os(os_spec.null)(
+                    return self._get_step_os(os_spec.null, stats_bf16)(
                         base, offset, chunk, w_os, bulks, scratch, True)
-                return self._get_step_os(os_spec.null)(
+                return self._get_step_os(os_spec.null, stats_bf16)(
                     base, offset, chunk, w_os, bulks, scratch, False), None
-            if fused:
-                return self._step_fused(base, offset, chunk,
-                                        self._w_os_empty, bulks,
-                                        scratch), None
+            if path == "mega":
+                return self._get_step_mega(0, False, prec)(
+                    base, offset, chunk, self._w_os_empty, bulks,
+                    scratch), None
+            if path == "fused":
+                return self._get_step_fused_os(0, False, prec)(
+                    base, offset, chunk, self._w_os_empty, bulks,
+                    scratch), None
+            step = self._get_step_xla(stats_bf16)
             if keep_corr:
-                return self._step(base, offset, chunk, bulks, scratch, True)
-            return self._step(base, offset, chunk, bulks, scratch,
-                              False), None
+                return step(base, offset, chunk, bulks, scratch, True)
+            return step(base, offset, chunk, bulks, scratch,
+                        False), None
 
         # chunk 0's staged host inputs are the one precompute the first
         # dispatch genuinely waits on (recorded as its stall_s); every later
@@ -2652,6 +3152,10 @@ class EnsembleSimulator:
         meta = {
             "nreal": int(nreal), "chunk": int(chunk),
             "keep_corr": bool(keep_corr), "fused": bool(fused),
+            # which statistic implementation the run executed ('xla' /
+            # 'fused' / 'mega') and its effective precision mode — run-shape
+            # facts the per-mode bench rows key on
+            "statistic_path": path, "precision": prec,
             "platform": self.mesh.devices.flat[0].platform,
             "n_devices": int(self.mesh.devices.size),
             "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
@@ -2674,13 +3178,13 @@ class EnsembleSimulator:
         collector.count("obs.chunks", len(chunk_records))
         lnl_cost = (None if lnl_compiled is None else
                     (self._get_step_lnlike(lnl_spec.model, lnl_spec.mode,
-                                           fused, lnl_compiled),
+                                           path, lnl_compiled, precision),
                      lnl_theta, (lnl_k, lnl_l, lnl_spec.mode)))
         report = RunReport.from_collector(
             collector, meta,
             retraces=self._obs_retraces - retraces_before,
             total_s=total_s,
-            cost=self._obs_capture_cost(base, chunk, fused, w_os=w_os,
+            cost=self._obs_capture_cost(base, chunk, path, prec, w_os=w_os,
                                         with_null=bool(os_spec.null)
                                         if os_spec else False,
                                         lnl=lnl_cost),

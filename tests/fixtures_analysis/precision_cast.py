@@ -1,0 +1,20 @@
+"""Seeded true positives for mixed-precision-cast: bf16 storage casts in a
+library module that is NOT in analysis.policy.BF16_STORAGE_MODULES."""
+import jax.numpy as jnp
+from jax.numpy import bfloat16 as bf
+
+
+def leaky(x):
+    y = x.astype(jnp.bfloat16)                   # cast marker -> finding
+    z = jnp.asarray(x, dtype="bfloat16")         # dtype string -> finding
+    w = x.astype(bf)                             # aliased import -> finding
+    return y + z + w
+
+
+def near_misses(x):
+    # an f32 cast is the policy default, a precision MODE string names a
+    # mode (not a dtype), and a plain string in data is not a call arg
+    a = x.astype(jnp.float32)
+    mode = "bf16"
+    label = "bfloat16"
+    return a, mode, label

@@ -52,6 +52,9 @@ CASES = [
     ("meshaxis_bad.py", LIB,
      {("mesh-axis-contract", 8), ("mesh-axis-contract", 9),
       ("mesh-axis-contract", 10)}),
+    ("precision_cast.py", LIB,
+     {("mixed-precision-cast", 8), ("mixed-precision-cast", 9),
+      ("mixed-precision-cast", 10)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -92,6 +95,9 @@ def test_dtype_policy_paths_exist():
     """Policy entries must point at real modules (refactors move files)."""
     for rel in policy.DTYPE_POLICY:
         assert (REPO / rel).is_file(), f"stale DTYPE_POLICY entry: {rel}"
+    for rel in policy.BF16_STORAGE_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale BF16_STORAGE_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
