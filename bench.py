@@ -59,10 +59,20 @@ RunReport each ``sim.run()`` attaches):
   ``model_bytes_per_chunk_fused_bf16`` (the analytic model), plus
   ``fused_bytes_reduction_x`` = model_xla / model_fused — the recorded
   roofline acceptance (>= 2x on the flagship config; higher-is-better);
+- ``peak_hbm_bytes``: the measured run's HBM watermark from the RunReport's
+  memwatch lane (allocator ``peak_bytes_in_use`` max-aggregated over local
+  devices and over the low-rate in-run sampler where the backend exposes
+  allocator stats; the static-reservation + live-packed-buffer model on the
+  CPU stand-in). Lower-is-better under ``obs compare`` (the default
+  direction) and banded by ``obs gate`` like every other row metric;
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
   ``benchmarks/suite.py`` rows carry the same ``platform``/``fallback``
   pair, so CPU stand-in rounds are distinguishable across the whole
   trajectory.
+
+A new row is gated against this history with ``python -m fakepta_tpu.obs
+gate row.json`` — MAD noise bands over same-``platform`` rows, so the CPU
+stand-in rounds never band an accelerator round (docs/OBSERVABILITY.md).
 
 Backend selection: the dead-tunnel probe verdict is cached to a temp file
 scoped to this process tree, and ``FAKEPTA_TPU_BENCH_BACKEND=cpu`` (or any
@@ -151,6 +161,8 @@ def main():
     row["pipeline_depth"] = rep_sum.get("pipeline_depth", 0)
     row["pipeline_stall_s"] = rep_sum.get("pipeline_stall_s", 0.0)
     row["ckpt_wait_s"] = rep_sum.get("ckpt_wait_s", 0.0)
+    if rep_sum.get("peak_hbm_bytes"):
+        row["peak_hbm_bytes"] = rep_sum["peak_hbm_bytes"]
 
     # the detection lane (fakepta_tpu.detect): same flagship program with the
     # on-device optimal statistic packed beside curves/autos — measured

@@ -8,9 +8,9 @@ Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
 ``--update-baseline`` to append a measured table to BASELINE.md. Ensemble
 rows carry the ``fakepta_tpu.obs`` telemetry fields (``compile_s``,
-``steady_real_per_s_per_chip``, ``retraces``, ``cost_bytes_per_chunk`` —
-see the bench.py docstring for the schema), sourced from the RunReport each
-``sim.run()`` attaches. The flagship row (config 5) additionally carries the
+``steady_real_per_s_per_chip``, ``retraces``, ``cost_bytes_per_chunk``,
+``peak_hbm_bytes`` — see the bench.py docstring for the schema), sourced
+from the RunReport each ``sim.run()`` attaches. The flagship row (config 5) additionally carries the
 detection-lane figures ``os_real_per_s_per_chip`` / ``os_bytes_per_chunk``
 from a second measured run with ``os='hd'`` (the device optimal statistic,
 ``fakepta_tpu.detect``) and the inference-lane figures
@@ -97,8 +97,9 @@ def _ensemble_rate(sim, nreal, chunk):
     }
     # chunk cost + roofline placement (bench.py docstring schema: measured
     # bytes, the analytic HBM model, and the intensity — higher-is-better)
+    # plus the memwatch HBM watermark (peak_hbm_bytes, lower-is-better)
     for key in ("cost_bytes_per_chunk", "model_bytes_per_chunk",
-                "intensity_flop_per_byte"):
+                "intensity_flop_per_byte", "peak_hbm_bytes"):
         if rep_sum.get(key):
             fields[key] = rep_sum[key]
     return rate, fields
@@ -468,10 +469,13 @@ def config5():
             / row["model_bytes_per_chunk_fused"], 2)
 
     # Peak device memory and an MFU estimate, both from the obs RunReport
-    # (allocator stats where the plugin provides them, else XLA's static
-    # reservation; FLOPs from the one-time cost-analysis capture).
+    # (the memwatch watermark: sampled allocator stats max-aggregated over
+    # local devices where the plugin provides them, else the
+    # static-reservation + packed-buffer model; FLOPs from the one-time
+    # cost-analysis capture).
     rep = sim.last_report
-    peak = rep.memory.get("peak_bytes_in_use") \
+    peak = rep.memory.get("peak_hbm_bytes") \
+        or rep.memory.get("peak_bytes_in_use") \
         or rep.cost.get("static_reservation_bytes")
     if peak:
         row["peak_hbm_gb"] = round(peak / 2**30, 2)

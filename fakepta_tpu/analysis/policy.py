@@ -55,6 +55,10 @@ DTYPE_POLICY = {
     "fakepta_tpu/obs/report.py": "host-f64",
     "fakepta_tpu/obs/cli.py": "host-f64",
     "fakepta_tpu/obs/__main__.py": "host-f64",
+    "fakepta_tpu/obs/trace.py": "host-f64",
+    "fakepta_tpu/obs/memwatch.py": "host-f64",
+    "fakepta_tpu/obs/flightrec.py": "host-f64",
+    "fakepta_tpu/obs/gate.py": "host-f64",
     # the detection-statistics subsystem's host layers: operator precompute
     # (ORF templates, pair counts, noise weighting) is one-off f64 staging
     # like the ORF Cholesky; the facade/CLI reduce packed lanes with host
@@ -88,6 +92,19 @@ BF16_STORAGE_MODULES = (
     "fakepta_tpu/ops/pallas_kernels.py",
     "fakepta_tpu/ops/megakernel.py",
     "fakepta_tpu/parallel/montecarlo.py",
+)
+
+# timing-discipline allowlist: library modules sanctioned to read raw
+# clocks (time.time / time.perf_counter / time.monotonic). obs/timing.py IS
+# the sanctioned clock (everything routes through its now()/Timer/span);
+# obs/flightrec.py reads perf_counter directly to stay import-cycle-free
+# below the metrics core (metrics mirrors events into the flight-recorder
+# ring, so flightrec cannot import timing, which imports metrics). A bare
+# clock read anywhere else in the library is a measurement the telemetry
+# artifacts never see — the rule flags it.
+TIMING_MODULES = (
+    "fakepta_tpu/obs/timing.py",
+    "fakepta_tpu/obs/flightrec.py",
 )
 
 # Library code prefix: rules with a library-only clause (literal re-seeding,

@@ -3,26 +3,40 @@
 Structured observability spanning the metrics core (counters / gauges /
 timing histograms + a schema-stable JSON-lines sink, ``metrics``), trace
 spans and device-synced timing (``timing``; absorbs and supersedes
-``fakepta_tpu.utils.profiling``), and the per-run :class:`RunReport`
-artifact every ``EnsembleSimulator.run()`` attaches, with a CLI to diff two
-runs (``python -m fakepta_tpu.obs summarize|compare``). See
-docs/OBSERVABILITY.md.
+``fakepta_tpu.utils.profiling``), the per-run :class:`RunReport` artifact
+every ``EnsembleSimulator.run()`` attaches, the run-timeline Chrome-trace
+exporter (``trace`` module — Perfetto-viewable pipeline overlap), HBM
+watermark telemetry (``memwatch``), the always-on crash flight recorder
+(``flightrec``), and the BENCH-trajectory regression gate (``gate``), with
+a CLI over all of it (``python -m fakepta_tpu.obs
+summarize|compare|trace|gate``). See docs/OBSERVABILITY.md.
 
 Everything here is host-side code. The one contract: obs hooks never
 introduce host syncs into jitted scopes — spans execute at trace time only,
 and telemetry reads happen at chunk boundaries where the engine already
 fetches (docs/INVARIANTS.md).
+
+Naming note: the package attribute ``obs.trace`` is the *profiler* context
+manager (``timing.trace``, long part of the public API); the Chrome
+trace-event exporter module is reached as ``obs.tracefmt`` or
+``fakepta_tpu.obs.trace`` via a module-path import (``from
+fakepta_tpu.obs.trace import build_trace``). The imports below are ordered
+so the function wins the attribute.
 """
 
+from . import flightrec, gate, memwatch
+from . import trace as tracefmt
 from .metrics import (SCHEMA, Collector, EventLog, active, collect, count,
                       event, gauge, observe, record_span,
                       subscribe_jax_monitoring)
-from .report import RunReport, format_delta, format_summary
-from .timing import Timer, annotation, span, trace
+from .report import (RunReport, format_delta, format_summary, metric_exempt,
+                     metric_higher_is_better)
+from .timing import Timer, annotation, now, span, trace
 
 __all__ = [
     "SCHEMA", "Collector", "EventLog", "RunReport", "Timer", "annotation",
-    "active", "collect", "count", "event", "format_delta", "format_summary",
-    "gauge", "observe", "record_span", "span", "subscribe_jax_monitoring",
-    "trace",
+    "active", "collect", "count", "event", "flightrec", "format_delta",
+    "format_summary", "gate", "gauge", "memwatch", "metric_exempt",
+    "metric_higher_is_better", "now", "observe", "record_span", "span",
+    "subscribe_jax_monitoring", "trace", "tracefmt",
 ]

@@ -1,4 +1,4 @@
-"""Entry point: ``python -m fakepta_tpu.obs summarize|compare ...``."""
+"""Entry point: ``python -m fakepta_tpu.obs summarize|compare|trace|gate``."""
 
 import sys
 

@@ -1,11 +1,17 @@
-"""CLI: ``python -m fakepta_tpu.obs summarize|compare <report.jsonl>...``.
+"""CLI: ``python -m fakepta_tpu.obs summarize|compare|trace|gate ...``.
 
-``summarize`` prints one report's metric table; ``compare`` prints a
+``summarize`` prints one report's metric table (flight-recorder dumps get a
+crash banner — spec hash, error, chunks completed); ``compare`` prints a
 per-metric delta table between two reports and flags regressions
 (throughput down, retraces/compile-time/cost-bytes up beyond the relative
-threshold). ``compare`` exits 0 by default even with regressions flagged —
-it is a diff tool; pass ``--fail-on-regression`` to gate CI on it. Exit 2 on
-usage/IO errors, mirroring ``fakepta_tpu.analysis``.
+threshold); ``trace`` exports one or more report/event-log shards as Chrome
+trace-event JSON for Perfetto (multi-host shards merge into one trace with
+a pid lane per host); ``gate`` bands a new bench row against the
+BENCH_r*.json history (MAD over same-platform rows) and flags metrics
+outside their noise band. ``compare``/``gate`` exit 0 by default even with
+regressions flagged — they are diff tools; pass ``--fail-on-regression``
+to gate CI on them. Exit 2 on usage/IO errors, mirroring
+``fakepta_tpu.analysis``.
 """
 
 from __future__ import annotations
@@ -20,12 +26,14 @@ from .report import RunReport, format_delta, format_summary
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog="python -m fakepta_tpu.obs",
-        description="inspect and diff ensemble-engine RunReport artifacts "
-                    "(JSON-lines files written by report.save())")
+        description="inspect, diff, trace and gate ensemble-engine "
+                    "RunReport artifacts (JSON-lines files written by "
+                    "report.save())")
     sub = parser.add_subparsers(dest="command", required=True)
 
     summ = sub.add_parser("summarize", help="print one report's metrics")
-    summ.add_argument("report", help="a RunReport .jsonl file")
+    summ.add_argument("report", help="a RunReport .jsonl file (or a "
+                                     "flightrec-*.json crash dump)")
     summ.add_argument("--format", choices=("text", "json"), default="text")
 
     comp = sub.add_parser("compare",
@@ -37,19 +45,102 @@ def build_parser() -> argparse.ArgumentParser:
                            "wrong way is flagged (default 0.10)")
     comp.add_argument("--fail-on-regression", action="store_true",
                       help="exit 1 when any metric is flagged")
+
+    tr = sub.add_parser(
+        "trace", help="export the run timeline as Chrome trace-event JSON "
+                      "(load the output at ui.perfetto.dev)")
+    tr.add_argument("reports", nargs="+",
+                    help="RunReport/event-log .jsonl file(s); pass every "
+                         "per-host shard of a multi-process run to merge "
+                         "them into one trace with a pid lane per host")
+    tr.add_argument("-o", "--output", default="trace.json",
+                    help="output path (default trace.json)")
+
+    ga = sub.add_parser(
+        "gate", help="band a new bench row against the BENCH_r*.json "
+                     "history (MAD noise bands over same-platform rows)")
+    ga.add_argument("row", help="the new row: a bench.py JSON line file, a "
+                                "driver-wrapped BENCH record, or a "
+                                "RunReport .jsonl (its summary is gated)")
+    ga.add_argument("--history", nargs="*", default=None,
+                    help="history files/globs (default: ./BENCH_r*.json)")
+    ga.add_argument("--k", type=float, default=3.0,
+                    help="band half-width in MADs (default 3.0)")
+    ga.add_argument("--rel-floor", type=float, default=0.05,
+                    help="minimum band as a fraction of the median, so a "
+                         "zero-MAD history cannot flag timer noise "
+                         "(default 0.05)")
+    ga.add_argument("--min-history", type=int, default=2,
+                    help="same-platform rows a metric needs before it "
+                         "gates (default 2)")
+    ga.add_argument("--fail-on-regression", action="store_true",
+                    help="exit 1 when any metric leaves its band the "
+                         "wrong way")
     return parser
+
+
+def _cmd_summarize(args) -> int:
+    rep = RunReport.load(args.report)
+    if args.format == "json":
+        print(json.dumps(rep.to_json(), indent=2))
+        return 0
+    if rep.meta.get("flightrec"):
+        # a crash dump: lead with the post-mortem identity so the operator
+        # sees at a glance WHICH configuration died and why
+        print(f"FLIGHT RECORDER dump (crashed run)\n"
+              f"  spec_hash : {rep.meta.get('spec_hash', '?')}\n"
+              f"  crashed   : {rep.meta.get('crash_time', '?')}\n"
+              f"  error     : {rep.meta.get('error') or '<none recorded>'}\n"
+              f"  mesh      : {rep.meta.get('mesh_shape', '?')}  "
+              f"chunks completed: {len(rep.chunks)}")
+    print(format_summary(rep))
+    return 0
+
+
+def _cmd_trace(args) -> int:
+    # note the submodule-direct form: the package attribute ``obs.trace`` is
+    # the profiler context manager (timing.trace, kept for back-compat), so
+    # the Chrome exporter must be imported as a module path
+    from .trace import export as trace_export
+
+    info = trace_export(args.reports, args.output)
+    print(f"wrote {info['path']}: {info['events']} events "
+          f"({info['spans']} spans, {info['processes']} process lane(s)); "
+          f"load it at https://ui.perfetto.dev")
+    return 0
+
+
+def _cmd_gate(args) -> int:
+    from . import gate as gate_mod
+
+    new_row = gate_mod.load_row(args.row)
+    hist_paths = gate_mod.resolve_history(args.history)
+    history = gate_mod.load_history(hist_paths)
+    results = gate_mod.gate_row(new_row, history, k=args.k,
+                                rel_floor=args.rel_floor,
+                                min_history=args.min_history)
+    platform = new_row.get("platform")
+    n_same = len([r for r in history if r.get("platform") == platform])
+    text, regressions = gate_mod.format_gate(results, platform, n_same)
+    print(text)
+    if regressions:
+        print(f"{len(regressions)} regression(s): {', '.join(regressions)}")
+        if args.fail_on_regression:
+            return 1
+    else:
+        print("no regressions flagged")
+    return 0
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     try:
         if args.command == "summarize":
-            rep = RunReport.load(args.report)
-            if args.format == "json":
-                print(json.dumps(rep.to_json(), indent=2))
-            else:
-                print(format_summary(rep))
-            return 0
+            return _cmd_summarize(args)
+        if args.command == "trace":
+            return _cmd_trace(args)
+        if args.command == "gate":
+            return _cmd_gate(args)
         rep_a = RunReport.load(args.report_a)
         rep_b = RunReport.load(args.report_b)
     except (OSError, ValueError, KeyError) as exc:

@@ -1,0 +1,140 @@
+"""Crash flight recorder: an always-on bounded ring of recent obs events.
+
+A killed or crashed run used to leave nothing but a stack trace — the
+RunReport is assembled only *after* a successful run, so the one case where
+telemetry matters most (a mid-pipeline drain failure, an OOM, an operator
+kill) produced no artifact at all. This module keeps a process-wide bounded
+ring buffer of recent observability events (:data:`RING_SIZE`, oldest
+dropped first) that is **always on**: :func:`note` costs one
+``deque.append`` of a small tuple whether or not a collector is installed,
+so the engine records into it unconditionally (``obs.event(...)`` mirrors
+here too — any event a collector would see is also in the ring).
+
+On an engine or pipeline exception, :meth:`EnsembleSimulator.run` dumps the
+ring plus the run's identity — spec hash, mesh/meta, the per-chunk records
+completed so far — to ``<ckpt_dir>/flightrec-<ts>-p<process>.json`` (next to
+the checkpoint when one was requested, else under
+``$FAKEPTA_TPU_FLIGHTREC_DIR`` when set). The dump is a schema-framed
+``fakepta_tpu.obs/1`` JSON-lines file, so it round-trips through
+``python -m fakepta_tpu.obs summarize`` like any RunReport artifact:
+the crash is diagnosable from the run's own directory.
+
+Clock reads here are ``time.perf_counter`` directly rather than
+``obs.timing.now`` to keep this module import-cycle-free (timing imports
+metrics, metrics mirrors events here); the module is allowlisted by the
+``timing-discipline`` rule (analysis.policy.TIMING_MODULES).
+"""
+
+from __future__ import annotations
+
+import collections
+import hashlib
+import json
+import os
+import threading
+import time
+from pathlib import Path
+from typing import List, Optional
+
+# ring capacity: large enough to hold the tail of a long run (every chunk
+# contributes a handful of events), small enough that the ring is noise in
+# host memory and a dump stays a quick glance
+RING_SIZE = 256
+
+# opt-in dump directory for runs without a checkpoint path
+DUMP_DIR_ENV = "FAKEPTA_TPU_FLIGHTREC_DIR"
+
+_ring: "collections.deque" = collections.deque(maxlen=RING_SIZE)
+# dumps can race (engine thread + a writer-thread failure unwinding two
+# stacks); serialize them so two dumps never interleave into one file
+_dump_lock = threading.Lock()
+
+
+def note(name: str, **attrs) -> None:
+    """Append one event to the ring (always on; never raises).
+
+    The stored tuple is ``(t_monotonic_s, name, attrs-or-None)``;
+    ``deque.append`` is atomic under the GIL, so the engine thread and the
+    pipeline's writer thread record concurrently without a lock.
+    """
+    _ring.append((time.perf_counter(), name, attrs or None))
+
+
+def snapshot() -> List[dict]:
+    """The ring's current contents, oldest first, as plain dicts."""
+    out = []
+    for t, name, attrs in list(_ring):
+        ev = {"t_mono_s": round(t, 6), "name": name}
+        if attrs:
+            ev["attrs"] = attrs
+        out.append(ev)
+    return out
+
+
+def clear() -> None:
+    """Empty the ring (tests; a new process starts empty anyway)."""
+    _ring.clear()
+
+
+def spec_hash(meta: dict) -> str:
+    """Stable short hash of a run's identity (meta minus volatile fields).
+
+    Two runs of the same spec — same ensemble shape, lanes, mesh, precision
+    — hash identically regardless of nreal/seed, so crash dumps group by
+    configuration across a campaign.
+    """
+    volatile = {"nreal", "seed", "extra_metrics"}
+    stable = {k: v for k, v in sorted(meta.items()) if k not in volatile}
+    blob = json.dumps(stable, sort_keys=True, default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def dump_dir(checkpoint=None) -> Optional[Path]:
+    """Where a dump should land: the checkpoint's directory when the run has
+    one, else ``$FAKEPTA_TPU_FLIGHTREC_DIR``, else None (no dump)."""
+    if checkpoint is not None:
+        return Path(checkpoint).resolve().parent
+    env = os.environ.get(DUMP_DIR_ENV)
+    return Path(env) if env else None
+
+
+def dump(directory, meta: dict, chunks=None, error: str = "",
+         process_index: int = 0) -> Optional[str]:
+    """Write the flight-recorder artifact; returns its path (None on any
+    failure — a dump must never mask the exception being handled).
+
+    The file is a ``fakepta_tpu.obs/1`` JSON-lines EventLog: header (meta +
+    spec hash + crash context), the per-chunk records completed so far, the
+    ring's events, and a summary line — loadable by ``RunReport.load`` and
+    printable by ``python -m fakepta_tpu.obs summarize``.
+    """
+    try:
+        from .metrics import EventLog
+
+        directory = Path(directory)
+        directory.mkdir(parents=True, exist_ok=True)
+        ts = time.strftime("%Y%m%d-%H%M%S")
+        path = directory / f"flightrec-{ts}-p{process_index:03d}.json"
+        chunks = list(chunks or [])
+        head_meta = dict(meta)
+        head_meta.update({
+            "flightrec": True,
+            "spec_hash": spec_hash(meta),
+            "crash_time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+            "error": error[:2000],
+        })
+        log = EventLog(meta=head_meta)
+        for c in chunks:
+            log.append("chunk", **c)
+        for ev in snapshot():
+            log.append("event", **ev)
+        summary = {
+            "chunks_completed": len(chunks),
+            "events_recorded": len(_ring),
+            "nreal": int(meta.get("nreal", 0)),
+        }
+        with _dump_lock:
+            log.save(path, summary=summary)
+        return str(path)
+    except Exception:                                    # pragma: no cover
+        return None

@@ -1,0 +1,179 @@
+"""Trajectory gate: noise-banded regression checks against BENCH history.
+
+``BENCH_r*.json`` is the repo's benchmark trajectory — one row per round.
+Until now "did this round regress?" was an eyeball judgement over raw
+numbers, which fails in exactly the ways the history shows: CPU stand-in
+rounds (r03–r05, dead accelerator tunnel) sit ~200x below the accelerator
+round (r02), so any naive diff against "the previous row" either
+cries wolf or is silenced entirely. The gate replaces that with a
+statistical check:
+
+- history rows are grouped by ``platform`` and only **same-platform** rows
+  band a new row — a CPU stand-in round can never gate an accelerator
+  round (or vice versa);
+- each metric's noise band is ``k * max(MAD, rel_floor * |median|)`` around
+  the per-platform median (MAD — median absolute deviation — is robust to
+  the occasional outlier round; the relative floor keeps a zero-MAD
+  history from flagging timer noise);
+- direction comes from the same tables ``obs compare`` uses
+  (:mod:`.report`): throughput down / bytes up / retraces up is a
+  regression, run-shape facts are exempt;
+- metrics need ``min_history`` same-platform observations before they gate
+  at all — a brand-new metric is informational until the history exists.
+
+CLI::
+
+    python -m fakepta_tpu.obs gate new_row.json                 # report only
+    python -m fakepta_tpu.obs gate new_row.json --fail-on-regression
+    python -m fakepta_tpu.obs gate run.jsonl --history BENCH_r0*.json
+
+The new row may be a bench line (``bench.py`` output), a driver-wrapped
+record (``{"parsed": {...}}`` — the committed ``BENCH_r*.json`` shape), or
+a RunReport ``.jsonl`` (its summary table is gated). Exit codes mirror
+``compare``: 0 clean (or report-only), 1 flagged under
+``--fail-on-regression``, 2 usage/IO.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import statistics
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .report import RunReport, metric_exempt, metric_higher_is_better
+
+DEFAULT_HISTORY_GLOB = "BENCH_r*.json"
+
+# bench-row bookkeeping fields that are not metrics at all
+_NON_METRIC_KEYS = {"metric", "unit", "platform", "fallback", "nreal_scale",
+                    "n", "cmd", "rc", "tail"}
+
+
+def parse_row(text: str) -> Optional[dict]:
+    """One bench row from file text: a raw bench line, or the driver-wrapped
+    ``{"parsed": row}`` record the committed BENCH_r*.json files use
+    (``parsed`` may be null for a crashed round — returns None)."""
+    data = json.loads(text)
+    if not isinstance(data, dict):
+        raise ValueError("bench row must be a JSON object")
+    if "parsed" in data and "rc" in data:
+        return data["parsed"] if isinstance(data["parsed"], dict) else None
+    return data
+
+
+def load_row(path) -> dict:
+    """The row under gate: bench JSON, wrapped record, or RunReport .jsonl
+    (whose summary + platform meta becomes the row)."""
+    text = Path(path).read_text()
+    first = text.lstrip()[:1]
+    if first == "{":
+        try:
+            row = parse_row(text.strip())
+        except (ValueError, json.JSONDecodeError):
+            row = None
+        if row is not None and "kind" not in row:
+            return row
+    rep = RunReport.load(path)
+    row = dict(rep.summary())
+    if rep.meta.get("platform") is not None:
+        row["platform"] = rep.meta["platform"]
+    return row
+
+
+def load_history(paths: Sequence) -> List[dict]:
+    """Parse history rows, silently dropping unparseable/crashed rounds
+    (a round that produced no row cannot band anything)."""
+    rows: List[dict] = []
+    for p in paths:
+        try:
+            row = parse_row(Path(p).read_text())
+        except (OSError, ValueError, json.JSONDecodeError):
+            continue
+        if row:
+            rows.append(row)
+    return rows
+
+
+@dataclass
+class GateResult:
+    metric: str
+    new: float
+    median: float
+    band: float
+    n_history: int
+    verdict: str        # "ok" | "regression" | "improved" | "info"
+
+
+def _numeric(v) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v)
+
+
+def gate_row(new_row: dict, history: Sequence[dict], k: float = 3.0,
+             rel_floor: float = 0.05,
+             min_history: int = 2) -> List[GateResult]:
+    """Band every gateable metric of ``new_row`` against same-platform
+    history; see the module docstring for the banding rule."""
+    platform = new_row.get("platform")
+    same = [r for r in history if r.get("platform") == platform]
+    results: List[GateResult] = []
+    for key in sorted(new_row):
+        if key in _NON_METRIC_KEYS:
+            continue
+        new_v = _numeric(new_row[key])
+        if new_v is None:
+            continue
+        obs_vals = [v for r in same
+                    if (v := _numeric(r.get(key))) is not None]
+        if len(obs_vals) < min_history:
+            results.append(GateResult(key, new_v, new_v, 0.0,
+                                      len(obs_vals), "info"))
+            continue
+        med = statistics.median(obs_vals)
+        mad = statistics.median([abs(v - med) for v in obs_vals])
+        band = k * max(mad, rel_floor * abs(med))
+        if metric_exempt(key):
+            verdict = "info"
+        elif metric_higher_is_better(key):
+            verdict = ("regression" if new_v < med - band else
+                       "improved" if new_v > med + band else "ok")
+        else:
+            verdict = ("regression" if new_v > med + band else
+                       "improved" if new_v < med - band else "ok")
+        results.append(GateResult(key, new_v, med, band,
+                                  len(obs_vals), verdict))
+    return results
+
+
+def format_gate(results: Sequence[GateResult], platform,
+                n_history: int) -> Tuple[str, List[str]]:
+    """Human table + the list of regressed metric names."""
+    lines = [f"gating against {n_history} same-platform "
+             f"(platform={platform!r}) history row(s)",
+             f"{'metric':<32} {'new':>14} {'median':>14} {'band':>12} "
+             f"{'n':>3}  verdict"]
+    regressions = []
+    for r in results:
+        mark = {"regression": "  << REGRESSION", "improved": "  (improved)",
+                "info": "  (no band: insufficient history)"
+                if r.n_history < 2 else "  (informational)"}.get(
+                    r.verdict, "")
+        lines.append(f"{r.metric:<32} {r.new:>14g} {r.median:>14g} "
+                     f"{r.band:>12g} {r.n_history:>3}  {r.verdict}{mark}")
+        if r.verdict == "regression":
+            regressions.append(r.metric)
+    return "\n".join(lines), regressions
+
+
+def resolve_history(args_history: Optional[Sequence[str]]) -> List[str]:
+    """History paths: explicit files/globs, else ./BENCH_r*.json."""
+    patterns = list(args_history) if args_history else [DEFAULT_HISTORY_GLOB]
+    paths: List[str] = []
+    for pat in patterns:
+        hits = sorted(glob.glob(pat))
+        paths.extend(hits if hits else ([pat] if Path(pat).exists() else []))
+    return paths

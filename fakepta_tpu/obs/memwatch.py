@@ -1,0 +1,187 @@
+"""HBM watermark telemetry: allocator sampling + packed-buffer accounting.
+
+Two complementary views of device memory, both feeding
+``RunReport.memory``:
+
+- :class:`HbmSampler` — a low-rate background sampler of the backend's
+  allocator stats (``device.memory_stats()``), aggregated **max over local
+  devices** and over samples. The one-shot capture it replaces sampled a
+  single device at run *end*, which both underreports multi-chip peaks and
+  misses any transient high-water mark between chunk boundaries. On
+  backends without allocator stats (XLA:CPU) the sampler detects that at
+  construction and never starts a thread — the stand-in rounds pay zero
+  cost.
+- :class:`PackedLedger` — per-chunk live-buffer accounting of the engine's
+  packed output buffers, the arrays the async pipeline's donated-scratch
+  ring is supposed to bound (docs/PERFORMANCE.md: "peak HBM holds ``depth``
+  packed buffers regardless of the chunk count"). The ledger counts fresh
+  device allocations vs recycles, verifies each recycled buffer really was
+  consumed by donation (``is_deleted`` — XLA invalidates a donated input at
+  dispatch), and :meth:`PackedLedger.check` raises if the runtime evidence
+  ever exceeds the ``depth``-buffers bound. PR 5's headline memory claim is
+  now asserted on every pipelined run instead of trusted.
+
+``RunReport.memory["peak_hbm_bytes"]`` is the allocator watermark where the
+backend exposes one, else the ledger's model (the chunk program's static
+reservation plus the extra live packed buffers beyond the one the
+reservation already counts).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional
+
+# allocator keys worth keeping, max-aggregated over local devices
+STAT_KEYS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+             "largest_alloc_size")
+
+# low-rate: ~20 Hz is dense enough to catch per-chunk transients (flagship
+# chunks are tens of ms at the slowest) while the sample itself is a cheap
+# local PJRT call — the thread is idle sleep otherwise
+SAMPLE_INTERVAL_S = 0.05
+
+
+def local_device_stats(devices) -> Dict[str, int]:
+    """Max-over-local-devices allocator stats (empty where unsupported).
+
+    ``devices`` is any iterable of jax devices (e.g. ``mesh.devices.flat``);
+    non-addressable devices (other hosts' chips in a multi-process mesh)
+    and backends without ``memory_stats`` are skipped. Aggregation is
+    ``max`` per key: the watermark that matters is the worst chip, and a
+    multi-chip mesh underreports peak HBM by up to ``n_devices``x if only
+    one device is sampled.
+    """
+    out: Dict[str, int] = {}
+    for d in devices:
+        try:
+            if not getattr(d, "addressable", True):
+                continue
+            stats = d.memory_stats()
+        except Exception:
+            continue
+        if not stats:
+            continue
+        for k in STAT_KEYS:
+            if k in stats:
+                out[k] = max(out.get(k, 0), int(stats[k]))
+    return out
+
+
+class HbmSampler:
+    """Background allocator-watermark sampler over the run's local devices.
+
+    ``start()`` probes once: if no local device exposes allocator stats the
+    sampler stays disabled (no thread). Otherwise a daemon thread samples at
+    :data:`SAMPLE_INTERVAL_S` and max-merges into the running watermark;
+    ``stop()`` joins the thread, takes one final sample, and returns the
+    aggregate stats dict (plus ``hbm_samples``, the sample count).
+    """
+
+    def __init__(self, devices, interval_s: float = SAMPLE_INTERVAL_S):
+        self.devices = list(devices)
+        self.interval_s = float(interval_s)
+        self.stats: Dict[str, int] = {}
+        self.samples = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def sample(self) -> None:
+        fresh = local_device_stats(self.devices)
+        if fresh:
+            self.samples += 1
+            for k, v in fresh.items():
+                self.stats[k] = max(self.stats.get(k, 0), v)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample()
+
+    def start(self) -> bool:
+        """Probe; spawn the sampling thread only where stats exist."""
+        self.sample()
+        if not self.stats:
+            return False
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name="fakepta-hbm-sampler")
+        self._thread.start()
+        return True
+
+    def stop(self) -> Dict[str, int]:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        self.sample()
+        out = dict(self.stats)
+        if self.samples:
+            out["hbm_samples"] = self.samples
+        return out
+
+
+class PackedLedger:
+    """Live packed-buffer accounting for one ``run()``'s chunk loop.
+
+    The engine reports every fresh device allocation of a packed output
+    buffer (:meth:`alloc`) and every donated-scratch recycle
+    (:meth:`recycle`, with the post-dispatch ``is_deleted()`` verdict of
+    the recycled buffer). On the pipelined path the donated ring bounds the
+    number of distinct live packed buffers at ``ring_size``; a fresh-alloc
+    count above that, or a recycled buffer that XLA did *not* consume
+    (donation silently broken — the buffer would stay live beside its
+    replacement), violates the bound and :meth:`check` raises.
+    """
+
+    def __init__(self, buffer_bytes: int, ring_size: int, pipelined: bool,
+                 n_real_shards: int = 1):
+        self.buffer_bytes = int(buffer_bytes)
+        self.ring_size = int(ring_size)
+        self.pipelined = bool(pipelined)
+        self.n_real_shards = max(int(n_real_shards), 1)
+        self.fresh_allocs = 0
+        self.recycles = 0
+        self.donation_misses = 0
+
+    def alloc(self) -> None:
+        self.fresh_allocs += 1
+
+    def recycle(self, donated_consumed: bool) -> None:
+        self.recycles += 1
+        if not donated_consumed:
+            self.donation_misses += 1
+
+    @property
+    def live_buffers(self) -> int:
+        """Distinct live packed device buffers (recycles reuse, never add)."""
+        return self.fresh_allocs
+
+    def check(self) -> None:
+        """Assert the depth-packed-buffers bound with runtime evidence."""
+        if not self.pipelined:
+            return   # the serial loop makes no bounded-peak claim
+        if self.fresh_allocs > self.ring_size or self.donation_misses:
+            raise RuntimeError(
+                f"pipeline depth bound violated: {self.fresh_allocs} packed "
+                f"buffers allocated (bound {self.ring_size}), "
+                f"{self.donation_misses} recycled scratch buffer(s) not "
+                f"consumed by donation — peak HBM no longer holds "
+                f"'depth' packed buffers (docs/PERFORMANCE.md); this is an "
+                f"engine bug, please report it with the run's flightrec "
+                f"dump")
+
+    def memory_fields(self) -> Dict[str, int]:
+        """The ledger's contribution to ``RunReport.memory``."""
+        out = {
+            "packed_buffer_bytes": self.buffer_bytes,
+            "packed_buffers_live_peak": self.live_buffers,
+        }
+        if self.pipelined:
+            out["packed_depth_bound_bytes"] = (
+                self.ring_size * self.buffer_bytes)
+        return out
+
+    def model_extra_bytes_per_device(self) -> int:
+        """Per-device bytes of live packed buffers beyond the one the chunk
+        program's static reservation already counts as its output."""
+        extra = max(self.live_buffers - 1, 0)
+        return extra * self.buffer_bytes // self.n_real_shards

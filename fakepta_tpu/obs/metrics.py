@@ -33,6 +33,8 @@ import threading
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterator, List, Optional
 
+from . import flightrec
+
 SCHEMA = "fakepta_tpu.obs/1"
 
 # jax.monitoring duration events forwarded into collectors, renamed to stable
@@ -133,6 +135,11 @@ def record_span(name: str) -> None:
 
 
 def event(name: str, value: Any = None, **attrs) -> None:
+    # events always land in the crash flight recorder's bounded ring
+    # (obs.flightrec) — one deque append, collector or not — so a killed
+    # run's dump contains the tail of whatever the engine reported
+    flightrec.note(name, **({"value": value, **attrs} if value is not None
+                            else attrs))
     c = active()
     if c is not None:
         c.event(name, value, **attrs)

@@ -18,6 +18,53 @@ from typing import Dict, List, Optional
 
 from .metrics import Collector, EventLog
 
+# ---------------------------------------------------------------------------
+# metric directions — single-sourced for `compare` (format_delta) and the
+# trajectory gate (`obs gate`, .gate): which way is "worse"?
+# ---------------------------------------------------------------------------
+
+# exact names where bigger is better
+HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
+                    "intensity_flop_per_byte",
+                    # bench-row headline fields (the BENCH_r*.json schema):
+                    # throughput value and its multiple of the v5e target
+                    "value", "vs_baseline"}
+
+# suffix rules cover the detect lane's per-ORF metric names
+# (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
+# recovery metrics (lnlike_map_hit_rate; its lnlike_map_l2_mean distance and
+# *_bytes_per_chunk / model_bytes_per_chunk costs keep the lower-is-better
+# default, so a byte-per-chunk growth IS a regression), any *_per_s_per_chip
+# / evals throughput metric, the roofline intensity, and the bench rows'
+# *_reduction_x byte-savings factors
+HIGHER_SUFFIXES = ("_per_s_per_chip", "_significance_sigma",
+                   "_detection_rate", "_hit_rate", "_reduction_x")
+
+# run-shape facts and distribution-scale diagnostics, not performance or
+# quality metrics — moving is information, not a regression (the infer
+# lane's lnL scale and grid size land here: a model change legitimately
+# moves absolute lnL without being better or worse). The pipeline's overlap
+# timings (pipeline_stall_s / ckpt_wait_s) stay REGRESSABLE and
+# lower-is-better — the default direction — but the depth itself is a
+# run-shape fact, as are the memwatch accounting facts (buffer size, the
+# depth bound itself) whose *violation* is a runtime error, not a delta.
+EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
+                  "hbm_samples", "packed_buffer_bytes",
+                  "packed_buffers_live_peak", "packed_depth_bound_bytes"}
+EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
+                   "_null_q95", "_p_value_median", "_lnl_max_mean",
+                   "_grid_k")
+
+
+def metric_higher_is_better(k: str) -> bool:
+    """True when a DROP in metric ``k`` is the regression direction."""
+    return k in HIGHER_IS_BETTER or k.endswith(HIGHER_SUFFIXES)
+
+
+def metric_exempt(k: str) -> bool:
+    """True when metric ``k`` is informational (never a regression)."""
+    return k in EXEMPT_METRICS or k.endswith(EXEMPT_SUFFIXES)
+
 
 @dataclass
 class RunReport:
@@ -34,6 +81,10 @@ class RunReport:
     total_s: float = 0.0
     cost: Dict[str, float] = field(default_factory=dict)
     memory: Dict[str, float] = field(default_factory=dict)
+    # run-relative span records from both the dispatch and writer threads
+    # ({name, t0, dur, tid, chunk, ...} — seconds; dur None = instant);
+    # the raw material `obs trace` turns into a Chrome/Perfetto timeline
+    timeline: List[dict] = field(default_factory=list)
 
     # -- derived -----------------------------------------------------------
     @property
@@ -105,6 +156,13 @@ class RunReport:
                 3)
         if self.memory.get("peak_bytes_in_use"):
             m["peak_bytes_in_use"] = self.memory["peak_bytes_in_use"]
+        if self.memory.get("peak_hbm_bytes"):
+            # the HBM watermark (obs.memwatch): allocator peak max-aggregated
+            # over local devices and over the low-rate sampler's samples
+            # where the backend exposes stats, else the packed-buffer model;
+            # lower-is-better in `compare` (the default direction), and the
+            # bench rows carry it (bench.py docstring schema)
+            m["peak_hbm_bytes"] = self.memory["peak_hbm_bytes"]
         if self.meta.get("pipeline_depth") is not None:
             # the async chunk pipeline's overlap figures (docs/PERFORMANCE
             # .md): stall_s is host work the dispatch actually waited on,
@@ -161,7 +219,8 @@ class RunReport:
         return {
             "meta": self.meta, "spans": self.spans, "chunks": self.chunks,
             "counters": self.counters, "gauges": self.gauges,
-            "timings": self.timings, "retraces": self.retraces,
+            "timings": self.timings, "timeline": self.timeline,
+            "retraces": self.retraces,
             "compile_s": self.compile_s, "total_s": self.total_s,
             "cost": self.cost, "memory": self.memory,
             "summary": self.summary(),
@@ -174,6 +233,8 @@ class RunReport:
             log.append("span", name=name)
         for c in self.chunks:
             log.append("chunk", **c)
+        for ev in sorted(self.timeline, key=lambda e: e.get("t0", 0.0)):
+            log.append("tl", **ev)
         for name, value in sorted(self.counters.items()):
             log.append("counter", name=name, value=value)
         for name, value in sorted(self.gauges.items()):
@@ -202,6 +263,9 @@ class RunReport:
                 rep.gauges[line["name"]] = line["value"]
             elif kind == "timing":
                 rep.timings[line["name"]] = list(line["values"])
+            elif kind == "tl":
+                rep.timeline.append(
+                    {k: v for k, v in line.items() if k != "kind"})
             elif kind == "report":
                 rep.retraces = int(line.get("retraces", 0))
                 rep.compile_s = float(line.get("compile_s", 0.0))
@@ -236,34 +300,9 @@ def format_delta(a: RunReport, b: RunReport,
     """
     ma, mb = a.summary(), b.summary()
     keys = sorted(set(ma) | set(mb))
-    higher_is_better = {"real_per_s", "steady_real_per_s_per_chip",
-                        "intensity_flop_per_byte"}
-
-    def _higher_is_better(k: str) -> bool:
-        # suffix rules cover the detect lane's per-ORF metric names
-        # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the
-        # infer lane's recovery metrics (lnlike_map_hit_rate; its
-        # lnlike_map_l2_mean distance and *_bytes_per_chunk /
-        # model_bytes_per_chunk costs keep the lower-is-better default,
-        # so a byte-per-chunk growth IS a regression), any *_per_s_per_chip
-        # / evals throughput metric, the roofline intensity, and the
-        # bench rows' *_reduction_x byte-savings factors
-        return (k in higher_is_better
-                or k.endswith(("_per_s_per_chip", "_significance_sigma",
-                               "_detection_rate", "_hit_rate",
-                               "_reduction_x")))
-
-    # run-shape facts and distribution-scale diagnostics, not performance or
-    # quality metrics — moving is information, not a regression (the infer
-    # lane's lnL scale and grid size land here: a model change legitimately
-    # moves absolute lnL without being better or worse). The pipeline's
-    # overlap timings (pipeline_stall_s / ckpt_wait_s) stay REGRESSABLE and
-    # lower-is-better — the default direction — but the depth itself is a
-    # run-shape fact.
-    exempt = {"nreal", "chunks", "pipeline_depth"}
-    exempt_suffixes = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
-                       "_null_q95", "_p_value_median", "_lnl_max_mean",
-                       "_grid_k")
+    # direction/exemption rules are the module-level tables above, shared
+    # with the trajectory gate (`obs gate`) so the two can never disagree
+    # about which way is "worse"
     lines = [f"{'metric':<28} {'a':>14} {'b':>14} {'delta':>12}"]
     regressions = []
     for k in keys:
@@ -275,9 +314,8 @@ def format_delta(a: RunReport, b: RunReport,
         delta = vb - va
         rel = delta / abs(va) if va else (1.0 if delta else 0.0)
         flag = ""
-        if (k not in exempt and not k.endswith(exempt_suffixes)
-                and abs(rel) > rel_threshold):
-            worse = rel < 0 if _higher_is_better(k) else rel > 0
+        if not metric_exempt(k) and abs(rel) > rel_threshold:
+            worse = rel < 0 if metric_higher_is_better(k) else rel > 0
             if worse:
                 flag = "  << REGRESSION"
                 regressions.append(k)

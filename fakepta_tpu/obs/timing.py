@@ -31,6 +31,20 @@ from . import metrics
 annotation = jax.profiler.TraceAnnotation    # named spans inside a trace
 
 
+def now() -> float:
+    """The library's sanctioned monotonic clock read (seconds).
+
+    Library code times things through this (or ``Timer``/``span``) rather
+    than calling ``time.time()``/``time.perf_counter()`` directly — the
+    ``timing-discipline`` analysis rule enforces it (docs/INVARIANTS.md).
+    Single-sourcing the clock keeps every recorded duration comparable
+    (one monotonic base, never wall-clock) and keeps the door open for a
+    test clock. The call is ``time.perf_counter`` today; callers must only
+    assume monotonicity and seconds.
+    """
+    return time.perf_counter()
+
+
 @contextlib.contextmanager
 def span(name: str):
     """Name a stage: ops for the device trace, an annotation for the host

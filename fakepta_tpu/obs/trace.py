@@ -1,0 +1,203 @@
+"""Run-timeline export as Chrome trace-event JSON (Perfetto-viewable).
+
+The async chunk pipeline (docs/PERFORMANCE.md) *claims* overlap — writer
+drains behind the next chunk's execute, host precompute hidden under device
+work — but until now the only evidence was aggregate ``stall_s`` /
+``ckpt_wait_s`` scalars. The engine now records a **timeline**: per-chunk
+span records (run-relative ``t0``/``dur`` seconds plus a logical lane
+``tid``) taken on both the dispatch thread and the pipeline's writer
+thread. This module converts those records — straight from a RunReport
+artifact — to the Chrome trace-event JSON format, so the run's concurrency
+is a picture instead of a claim:
+
+    python -m fakepta_tpu.obs trace run.jsonl -o trace.json
+    # open https://ui.perfetto.dev and load trace.json
+
+Lanes (one track per ``tid``): ``main`` (dispatch loop: per-chunk dispatch
+spans, staging/precompute of host-f64 CGW bulks, depth-bound stalls,
+donation-recycle instants), ``device`` (execute spans: dispatch to
+outputs-materialized — the device-side residency of each chunk), and
+``writer`` (drain spans with nested checkpoint appends). The compiled
+program's stage names (``obs.span``) are attached as instant markers on the
+device lane; per-op device timing still comes from ``obs.trace()`` (the
+jax profiler) — this timeline is the *host-side pipeline structure*, which
+the profiler does not show.
+
+Multi-process runs write one event-log shard per host
+(``run(eventlog=dir)`` → ``events-p<process>.jsonl``); passing all shards
+to this exporter merges them into a single trace with one **pid per host**
+(``trace shards/*.jsonl -o trace.json`` — run it on process 0 or offline).
+Timestamps are per-host run-relative clocks; lanes align at run start,
+which is what the per-host overlap question needs.
+
+The emitted JSON follows the Chrome trace-event format ("JSON Object
+Format": a top-level ``traceEvents`` list of ``ph: "X"/"i"/"M"`` events
+with microsecond ``ts``/``dur``); :func:`validate_trace` checks the
+invariants the format requires and the tests pin it.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional, Sequence
+
+from .report import RunReport
+
+# stable thread ids per logical lane (sort order = display order)
+TID = {"main": 0, "device": 1, "writer": 2}
+
+_VALID_PH = {"X", "i", "M"}
+
+
+def timeline_events(report: RunReport, pid: Optional[int] = None) -> List[dict]:
+    """Chrome trace events for one report's recorded timeline.
+
+    ``pid`` defaults to the report's ``meta.process_index`` (0 when the run
+    predates multi-host metadata) — one process lane per host shard.
+    """
+    meta = report.meta or {}
+    if pid is None:
+        pid = int(meta.get("process_index", 0))
+    events: List[dict] = []
+
+    label = (f"fakepta_tpu run p{pid}"
+             f" [{meta.get('statistic_path', '?')}"
+             f", depth {meta.get('pipeline_depth', '?')}"
+             f", {meta.get('platform', '?')}]")
+    events.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_name",
+                   "args": {"name": label}})
+    events.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_sort_index",
+                   "args": {"sort_index": pid}})
+    for lane, tid in TID.items():
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_name", "args": {"name": lane}})
+        events.append({"ph": "M", "pid": pid, "tid": tid,
+                       "name": "thread_sort_index",
+                       "args": {"sort_index": tid}})
+
+    first_exec_t0 = None
+    for ev in report.timeline:
+        tid = TID.get(str(ev.get("tid", "main")), 0)
+        name = str(ev.get("name", "?"))
+        t0 = float(ev.get("t0", 0.0))
+        args = {k: v for k, v in ev.items()
+                if k not in ("name", "t0", "dur", "tid")}
+        if ev.get("dur") is None:
+            events.append({"ph": "i", "pid": pid, "tid": tid, "name": name,
+                           "ts": t0 * 1e6, "s": "t", "args": args})
+            continue
+        if name == "execute" and first_exec_t0 is None:
+            first_exec_t0 = t0
+        events.append({"ph": "X", "pid": pid, "tid": tid, "name": name,
+                       "ts": t0 * 1e6, "dur": float(ev["dur"]) * 1e6,
+                       "args": args})
+
+    # the compiled program's stage names, as instant markers on the device
+    # lane at the first execute span (per-op timing is the jax profiler's
+    # job; these mark WHAT the program contains)
+    for span in report.spans:
+        events.append({"ph": "i", "pid": pid, "tid": TID["device"],
+                       "name": f"stage:{span}",
+                       "ts": (first_exec_t0 or 0.0) * 1e6, "s": "t",
+                       "args": {}})
+    return events
+
+
+def build_trace(reports: Sequence[RunReport]) -> dict:
+    """One Chrome trace object merging the given reports (pid per shard).
+
+    Shards sharing a ``process_index`` (or lacking one) are assigned
+    distinct pids in input order, so merging N single-host artifacts never
+    silently stacks their lanes.
+    """
+    events: List[dict] = []
+    used_pids: set = set()
+    for i, rep in enumerate(reports):
+        pid = int((rep.meta or {}).get("process_index", i))
+        while pid in used_pids:
+            pid += 1
+        used_pids.add(pid)
+        events.extend(timeline_events(rep, pid=pid))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {"tool": "fakepta_tpu.obs trace",
+                     "shards": len(reports)},
+    }
+
+
+def validate_trace(trace: dict) -> None:
+    """Raise ValueError unless ``trace`` is valid Chrome trace-event JSON.
+
+    Checks the format's structural invariants: a ``traceEvents`` list whose
+    entries carry a known ``ph``, integer ``pid``/``tid``, numeric
+    non-negative ``ts`` (and ``dur`` for complete events), string names,
+    and JSON-serializable ``args``. Duration events must not claim negative
+    time. This is what the tier-1 schema test pins.
+    """
+    if not isinstance(trace, dict) or not isinstance(
+            trace.get("traceEvents"), list):
+        raise ValueError("trace must be an object with a traceEvents list")
+    for i, ev in enumerate(trace["traceEvents"]):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            raise ValueError(f"{where}: not an object")
+        ph = ev.get("ph")
+        if ph not in _VALID_PH:
+            raise ValueError(f"{where}: unknown ph {ph!r}")
+        if not isinstance(ev.get("name"), str) or not ev["name"]:
+            raise ValueError(f"{where}: missing name")
+        for key in ("pid", "tid"):
+            if not isinstance(ev.get(key), int):
+                raise ValueError(f"{where}: {key} must be an int")
+        if ph == "M":
+            if not isinstance(ev.get("args"), dict):
+                raise ValueError(f"{where}: metadata event without args")
+            continue
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or ts < 0:
+            raise ValueError(f"{where}: ts must be a non-negative number")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or dur < 0:
+                raise ValueError(f"{where}: complete event needs dur >= 0")
+    json.dumps(trace)   # everything must serialize
+
+
+def load_reports(paths: Iterable) -> List[RunReport]:
+    """Load report/event-log shards (any file RunReport.save wrote)."""
+    return [RunReport.load(p) for p in paths]
+
+
+def export(paths: Sequence, out_path) -> dict:
+    """Load shards, build + validate the merged trace, write it; returns
+    summary counts for the CLI."""
+    reports = load_reports(paths)
+    trace = build_trace(reports)
+    validate_trace(trace)
+    with open(out_path, "w") as fh:
+        json.dump(trace, fh)
+    spans = sum(1 for ev in trace["traceEvents"] if ev["ph"] == "X")
+    pids = {ev["pid"] for ev in trace["traceEvents"]}
+    return {"events": len(trace["traceEvents"]), "spans": spans,
+            "processes": len(pids), "path": str(out_path)}
+
+
+def overlap_s(report: RunReport, a: str = "drain", b: str = "execute") -> float:
+    """Total seconds where any ``a`` span overlaps any ``b`` span of a
+    LATER chunk — the pipeline's measured concurrency (used by the tests'
+    acceptance and handy interactively)."""
+    spans_a = [ev for ev in report.timeline if ev.get("name") == a
+               and ev.get("dur") is not None]
+    spans_b = [ev for ev in report.timeline if ev.get("name") == b
+               and ev.get("dur") is not None]
+    total = 0.0
+    for ea in spans_a:
+        for eb in spans_b:
+            if eb.get("chunk", -1) <= ea.get("chunk", -1):
+                continue
+            lo = max(float(ea["t0"]), float(eb["t0"]))
+            hi = min(float(ea["t0"]) + float(ea["dur"]),
+                     float(eb["t0"]) + float(eb["dur"]))
+            total += max(hi - lo, 0.0)
+    return total

@@ -28,7 +28,6 @@ from __future__ import annotations
 import collections
 import dataclasses
 import threading
-import time
 from functools import partial
 from typing import Optional, Sequence, Tuple, Union
 
@@ -1660,16 +1659,14 @@ class EnsembleSimulator:
         return cost
 
     def _obs_memory_stats(self) -> dict:
-        """Allocator stats where the backend exposes them (None on CPU)."""
-        try:
-            stats = self.mesh.devices.flat[0].memory_stats()
-        except Exception:
-            return {}
-        if not stats:
-            return {}
-        keep = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
-                "largest_alloc_size")
-        return {k: int(stats[k]) for k in keep if k in stats}
+        """Allocator stats, MAX-aggregated over this host's mesh devices
+        (empty on backends without them, e.g. XLA:CPU). Sampling only one
+        device — what this did before obs.memwatch — underreports a
+        multi-chip mesh's peak HBM whenever sharding is uneven or one chip
+        carries the replicated extras."""
+        from ..obs import memwatch as obs_memwatch
+
+        return obs_memwatch.local_device_stats(self.mesh.devices.flat)
 
     def _host_cgw_bulks(self, base_key, offset: int, nreal: int):
         """Per-chunk host-f64 retarded-phase bulks for psrterm CGW sampling.
@@ -2699,31 +2696,56 @@ class EnsembleSimulator:
 
     def _drain_chunk(self, packed, corr, rec, packed_out, slot, corr_out,
                      ckpt, seed, nreal, chunk, done, progress, nb, n_extra,
-                     materialize, ev=None):
+                     materialize, ev=None, t_run0=None, timeline=None):
         """Host-side completion work for ONE dispatched chunk.
 
         Runs on the pipeline's writer thread (pipelined runs) or inline at
         submit (the serial fallback), in the serial loop's exact order:
         materialize outputs -> append the checkpoint chunk (process 0 only)
         -> invoke the progress callback. ``materialize`` forces the packed
-        lanes onto the host; the copy is forced (``np.array``) because the
-        pipelined loop recycles the device buffer as a donated scratch for
-        a later chunk, and ``np.asarray`` of a CPU-backend array can be a
-        zero-copy view into that very buffer. ``rec['ckpt_wait_s']`` records
+        lanes onto the host: ``"donatable"`` (the pipelined loop) copies
+        shard-by-shard via :func:`pipeline.materialize_copy` so the device
+        buffer stays consumable by donation when it is recycled as a later
+        dispatch's scratch (a plain ``np.asarray`` leaves jax's cached
+        zero-copy host view pinning the buffer on the CPU backend — the
+        donation then silently degrades to a copy); truthy-but-not-
+        ``"donatable"`` (the serial checkpoint path, which never donates)
+        keeps the ``np.array(to_host(...))`` copy that is also
+        multi-process-safe. ``rec['ckpt_wait_s']`` records
         the checkpoint append (inline in the chunk wall on the serial path;
         overlapped with device compute when pipelined). ``ev`` (pipelined
         only) signals the dispatch loop that this chunk's buffers are free
         to recycle — set even on failure so the loop cannot deadlock.
+
+        ``t_run0``/``timeline`` feed the run-timeline trace (obs.tracefmt):
+        the drain span (writer lane) with its nested checkpoint append, and
+        the chunk's *execute* span — dispatch start to outputs
+        materialized, the device-side residency the Perfetto view shows
+        overlapping the next chunk's dispatch. List appends and float
+        subtraction only: microseconds per chunk against multi-ms drains.
         """
+        idx = rec.get("idx", slot)
+        t_d0 = obs.now()
+        t_ready = None
         try:
-            if materialize:
+            if materialize == "donatable":
+                # pipelined path: the device buffer is recycled as a later
+                # dispatch's donated scratch, so the copy must not leave
+                # jax's cached host view pinning it (materialize_copy;
+                # found by the memwatch donation check)
+                arr = pipeline_mod.materialize_copy(packed)
+                packed_out[slot] = arr
+                t_ready = obs.now()
+            elif materialize:
                 arr = np.array(to_host(packed))
                 packed_out[slot] = arr
+                t_ready = obs.now()
             else:
                 arr = None
                 packed_out[slot] = packed
             if corr_out is not None:
                 corr_out[slot] = to_host(corr)
+                t_ready = obs.now()
             if ckpt is not None and jax.process_index() == 0:
                 # append-only: each save writes this chunk's arrays,
                 # O(chunk) I/O. Only process 0 writes — to_host replicates
@@ -2733,17 +2755,40 @@ class EnsembleSimulator:
                 if arr is None:
                     arr = to_host(packed)
                     packed_out[slot] = arr
-                t_ck = time.perf_counter()
+                    t_ready = obs.now()
+                t_ck = obs.now()
                 c_chunk, a_chunk = unpack_stats(arr, nb)
                 ckpt.save(seed, nreal, chunk, done, c_chunk, a_chunk,
                           corr_out[slot] if corr_out is not None else None,
                           extra=(arr[:, nb + 1:] if n_extra else None))
-                rec["ckpt_wait_s"] = time.perf_counter() - t_ck
+                t_now = obs.now()
+                rec["ckpt_wait_s"] = t_now - t_ck
+                if timeline is not None:
+                    timeline.append({"name": "ckpt_append", "tid": "writer",
+                                     "t0": t_ck - t_run0,
+                                     "dur": t_now - t_ck, "chunk": idx})
             if progress is not None:
                 if arr is None:
                     jax.block_until_ready(packed)  # completion, not dispatch
+                    t_ready = obs.now()
                 progress(min(done, nreal), nreal)
+            obs.flightrec.note("chunk_drained", idx=idx)
         finally:
+            if timeline is not None:
+                t_end = obs.now()
+                if t_ready is not None and "t0_s" in rec:
+                    # outputs-materialized is the completion evidence for
+                    # the chunk's device execution (the materialize blocks
+                    # on the async d2h copy, which blocks on compute)
+                    rec["t_ready_s"] = t_ready - t_run0
+                    timeline.append(
+                        {"name": "execute", "tid": "device",
+                         "t0": rec["t0_s"],
+                         "dur": max(t_ready - t_run0 - rec["t0_s"], 0.0),
+                         "chunk": idx})
+                timeline.append({"name": "drain", "tid": "writer",
+                                 "t0": t_d0 - t_run0,
+                                 "dur": t_end - t_d0, "chunk": idx})
             if ev is not None:
                 ev.set()
 
@@ -2791,7 +2836,7 @@ class EnsembleSimulator:
         recompiling, and the obs-measured ``compile_s`` amortizes instead of
         being paid per process. Returns the wall seconds spent.
         """
-        t0 = time.perf_counter()
+        t0 = obs.now()
         chunk = self._normalize_chunk(chunk, chunk)
         lanes = self._prepare_lanes(os, lnlike)
         path = "xla" if keep_corr else self._stat_path
@@ -2844,11 +2889,11 @@ class EnsembleSimulator:
             lowered.compile()
         finally:
             self._obs_in_capture = prev
-        return time.perf_counter() - t0
+        return obs.now() - t0
 
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
             checkpoint=None, progress=None, os=None, lnlike=None,
-            pipeline_depth: int = 2, precision=None):
+            pipeline_depth: int = 2, precision=None, eventlog=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
@@ -2940,8 +2985,29 @@ class EnsembleSimulator:
         and device-memory stats where the backend exposes them. All hooks are
         zero-overhead in steady state: nothing is read inside the jitted
         program, only at the chunk boundaries the engine already touches.
+
+        The report also carries the run **timeline** (per-chunk dispatch /
+        execute / drain spans across the dispatch and writer threads —
+        export with ``python -m fakepta_tpu.obs trace``, view in Perfetto)
+        and the HBM watermark (``memory["peak_hbm_bytes"]``: allocator peak
+        max-aggregated over local devices via a low-rate background
+        sampler where the backend exposes stats, else the packed-buffer
+        model). On pipelined runs the engine *asserts* the donated-ring
+        memory bound at runtime — at most ``depth`` live packed buffers,
+        every recycled scratch consumed by donation — and raises if the
+        evidence ever disagrees (obs.memwatch, docs/PERFORMANCE.md). A run
+        that dies records its tail in the always-on crash flight recorder
+        and dumps it beside the checkpoint (``flightrec-<ts>-p*.json``,
+        readable by ``obs summarize``; obs.flightrec).
+
+        ``eventlog``: a directory — after the run each process writes its
+        report there as ``events-p<process_index>.jsonl``. On a
+        multi-process run this yields one per-host shard per process;
+        merge them into a single Perfetto timeline with
+        ``python -m fakepta_tpu.obs trace <dir>/events-p*.jsonl -o
+        trace.json`` (one pid lane per host).
         """
-        t_run0 = time.perf_counter()
+        t_run0 = obs.now()
         obs.subscribe_jax_monitoring()
         collector = obs.Collector()
         retraces_before = self._obs_retraces
@@ -3008,6 +3074,55 @@ class EnsembleSimulator:
         dtype = self.batch.t_own.dtype
         scratch_sharding = NamedSharding(self.mesh, P(REAL_AXIS))
 
+        # run identity, built BEFORE the loop so the crash flight recorder
+        # can dump it for a run that never finishes (the RunReport reuses it)
+        meta = {
+            "nreal": int(nreal), "chunk": int(chunk),
+            "keep_corr": bool(keep_corr), "fused": bool(fused),
+            # which statistic implementation the run executed ('xla' /
+            # 'fused' / 'mega') and its effective precision mode — run-shape
+            # facts the per-mode bench rows key on
+            "statistic_path": path, "precision": prec,
+            "platform": self.mesh.devices.flat[0].platform,
+            "n_devices": int(self.mesh.devices.size),
+            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
+            "npsr": int(self.batch.npsr),
+            "max_toa": int(self.batch.max_toa),
+            # the depth the run actually executed at (0 = serial fallback,
+            # forced for multi-process runs regardless of the kwarg)
+            "pipeline_depth": int(depth if pipelined else 0),
+            # the obs layer's multi-host identity: which host this report /
+            # event-log shard came from (pid lanes in the merged trace)
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+        }
+        if isinstance(seed, (int, np.integer)):
+            meta["seed"] = int(seed)
+        if os_spec is not None:
+            meta["os"] = {"orfs": list(os_spec.orfs),
+                          "weighting": os_spec.weighting,
+                          "null": bool(os_spec.null)}
+        if lnl_spec is not None:
+            meta["lnlike"] = {"k": int(lnl_k), "d": int(lnl_compiled.D),
+                              "mode": lnl_spec.mode,
+                              "params": list(lnl_compiled.param_names)}
+
+        # observability (docs/OBSERVABILITY.md): the run timeline (dispatch /
+        # execute / drain spans, both threads — `obs trace` renders it), the
+        # HBM watermark sampler (no-op thread-free on stat-less backends),
+        # the packed-buffer ledger asserting the pipeline's depth-bounded
+        # peak-HBM claim at runtime, and the always-on crash flight recorder
+        timeline: list = []
+        ledger = obs.memwatch.PackedLedger(
+            int(chunk) * n_lanes * np.dtype(dtype).itemsize, ring_size,
+            pipelined, self._n_real_shards)
+        sampler = obs.memwatch.HbmSampler(self.mesh.devices.flat)
+        sampler.start()
+        obs.flightrec.note(
+            "run_start", spec_hash=obs.flightrec.spec_hash(meta),
+            nreal=int(nreal), chunk=int(chunk), path=path,
+            depth=int(depth if pipelined else 0), resume_done=int(done))
+
         def dispatch(offset, bulks, scratch):
             """One async chunk dispatch -> (packed, corr-or-None)."""
             if lnl_compiled is not None:
@@ -3052,15 +3167,19 @@ class EnsembleSimulator:
         # chunk 0's staged host inputs are the one precompute the first
         # dispatch genuinely waits on (recorded as its stall_s); every later
         # chunk's bulks precompute below, overlapped with device execution
-        t_pre0 = time.perf_counter()
+        t_pre0 = obs.now()
         bulks = self._host_cgw_bulks(base, done, chunk)
-        pre_stall = time.perf_counter() - t_pre0
+        pre_stall = obs.now() - t_pre0
+        if self._cgw_psrterm:
+            timeline.append({"name": "stage_inputs", "tid": "main",
+                             "t0": t_pre0 - t_run0, "dur": pre_stall,
+                             "chunk": 0})
         # created last before the loop so no earlier failure leaks the thread
         writer = pipeline_mod.make_writer(pipelined)
         try:
             with obs.collect(collector):
                 while done < nreal:
-                    t_chunk0 = time.perf_counter()
+                    t_chunk0 = obs.now()
                     # every step runs at the full chunk size (the final one
                     # overshoots and is truncated below): the steps are
                     # jitted with a static realization count, so a smaller
@@ -3072,22 +3191,45 @@ class EnsembleSimulator:
                                and ((keep_corr and not fused)
                                     or progress is not None)))}
                     pre_stall = 0.0
+                    rec["t0_s"] = t_chunk0 - t_run0
                     scratch = None
+                    recycled_from = None
                     if pipelined:
                         if len(ring) >= ring_size:
                             # depth bound + donation: wait for the oldest
                             # in-flight chunk's drain, then hand its packed
                             # buffer to this dispatch as donated scratch
                             prev_packed, ev = ring.popleft()
-                            t_wait = time.perf_counter()
+                            t_wait = obs.now()
                             ev.wait()
-                            rec["stall_s"] += time.perf_counter() - t_wait
+                            t_now = obs.now()
+                            rec["stall_s"] += t_now - t_wait
+                            timeline.append(
+                                {"name": "stall", "tid": "main",
+                                 "t0": t_wait - t_run0, "dur": t_now - t_wait,
+                                 "chunk": rec["idx"]})
                             scratch = prev_packed
+                            recycled_from = rec["idx"] - ring_size
                         else:
                             scratch = jax.device_put(
                                 np.zeros((chunk, n_lanes), dtype),
                                 scratch_sharding)
+                            ledger.alloc()
                     packed, corr = dispatch(done, bulks, scratch)
+                    obs.flightrec.note("chunk_dispatch", idx=rec["idx"],
+                                       offset=done)
+                    if recycled_from is not None:
+                        # runtime evidence for the depth-bounded peak-HBM
+                        # claim: donation must have consumed the recycled
+                        # buffer at dispatch (obs.memwatch; ledger.check()
+                        # raises after the loop if it ever did not)
+                        ledger.recycle(bool(scratch.is_deleted()))
+                        timeline.append(
+                            {"name": "recycle", "tid": "main",
+                             "t0": obs.now() - t_run0, "dur": None,
+                             "chunk": rec["idx"],
+                             "from_chunk": recycled_from})
+                    rec["live_packed"] = ledger.live_buffers
                     collector.count("pipeline.d2h_async",
                                     pipeline_mod.start_d2h(packed, corr))
                     done += chunk
@@ -3096,9 +3238,15 @@ class EnsembleSimulator:
                         # the NEXT chunk's host-f64 staging overlaps this
                         # chunk's device execution (the dispatch above
                         # returned immediately)
+                        t_b0 = obs.now()
                         bulks = self._host_cgw_bulks(base, done, chunk)
                         if self._cgw_psrterm:
                             collector.count("pipeline.h2d_prefetch")
+                            timeline.append(
+                                {"name": "precompute", "tid": "main",
+                                 "t0": t_b0 - t_run0,
+                                 "dur": obs.now() - t_b0,
+                                 "chunk": rec["idx"] + 1})
                     slot = len(packed_out)
                     packed_out.append(None)
                     if keep_corr:
@@ -3108,21 +3256,43 @@ class EnsembleSimulator:
                         self._drain_chunk, packed, corr, rec, packed_out,
                         slot, corr_out if keep_corr else None, ckpt, seed,
                         nreal, chunk, this_done, progress, nb, n_extra,
-                        pipelined or sync_each, ev)
+                        "donatable" if pipelined else sync_each, ev,
+                        t_run0, timeline)
                     if pipelined:
                         rec["stall_s"] += writer.submit(drain, ev.set)
                         ring.append((packed, ev))
                     else:
                         writer.submit(drain)
-                    rec["wall_s"] = time.perf_counter() - t_chunk0
+                    rec["wall_s"] = obs.now() - t_chunk0
+                    timeline.append({"name": "dispatch", "tid": "main",
+                                     "t0": rec["t0_s"], "dur": rec["wall_s"],
+                                     "chunk": rec["idx"]})
                     chunk_records.append(rec)
                 writer.close()
+                # the donated-ring memory bound, asserted with this run's
+                # own evidence (never fires unless the engine regressed)
+                ledger.check()
+                t_f0 = obs.now()
                 packed_h = np.concatenate(
                     [to_host(p) for p in packed_out])[:nreal]
-        except BaseException:
+                timeline.append({"name": "final_fetch", "tid": "main",
+                                 "t0": t_f0 - t_run0,
+                                 "dur": obs.now() - t_f0})
+        except BaseException as exc:
             writer.abort()
+            sampler.stop()
+            obs.flightrec.note("run_abort", error=repr(exc)[:500])
+            # post-mortem artifact: the ring + run identity, next to the
+            # checkpoint (or $FAKEPTA_TPU_FLIGHTREC_DIR); best-effort — a
+            # dump failure must never mask the original exception
+            rec_dir = obs.flightrec.dump_dir(checkpoint)
+            if rec_dir is not None:
+                obs.flightrec.dump(rec_dir, meta, chunks=chunk_records,
+                                   error=repr(exc)[:500],
+                                   process_index=int(jax.process_index()))
             raise
-        total_s = time.perf_counter() - t_run0   # final fetch = device-synced
+        total_s = obs.now() - t_run0   # final fetch = device-synced
+        obs.flightrec.note("run_end", total_s=round(total_s, 3))
         curves_h, autos_h = unpack_stats(packed_h, nb)
         out = {
             "curves": curves_h,
@@ -3149,48 +3319,48 @@ class EnsembleSimulator:
         # are already fetched — a failure here must never cost a result
         self._obs_spans |= set(collector.spans)
         from ..obs import RunReport
-        meta = {
-            "nreal": int(nreal), "chunk": int(chunk),
-            "keep_corr": bool(keep_corr), "fused": bool(fused),
-            # which statistic implementation the run executed ('xla' /
-            # 'fused' / 'mega') and its effective precision mode — run-shape
-            # facts the per-mode bench rows key on
-            "statistic_path": path, "precision": prec,
-            "platform": self.mesh.devices.flat[0].platform,
-            "n_devices": int(self.mesh.devices.size),
-            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
-            "npsr": int(self.batch.npsr),
-            "max_toa": int(self.batch.max_toa),
-            # the depth the run actually executed at (0 = serial fallback,
-            # forced for multi-process runs regardless of the kwarg)
-            "pipeline_depth": int(depth if pipelined else 0),
-        }
-        if isinstance(seed, (int, np.integer)):
-            meta["seed"] = int(seed)
-        if os_spec is not None:
-            meta["os"] = {"orfs": list(os_spec.orfs),
-                          "weighting": os_spec.weighting,
-                          "null": bool(os_spec.null)}
-        if lnl_spec is not None:
-            meta["lnlike"] = {"k": int(lnl_k), "d": int(lnl_compiled.D),
-                              "mode": lnl_spec.mode,
-                              "params": list(lnl_compiled.param_names)}
         collector.count("obs.chunks", len(chunk_records))
         lnl_cost = (None if lnl_compiled is None else
                     (self._get_step_lnlike(lnl_spec.model, lnl_spec.mode,
                                            path, lnl_compiled, precision),
                      lnl_theta, (lnl_k, lnl_l, lnl_spec.mode)))
+        cost = self._obs_capture_cost(base, chunk, path, prec, w_os=w_os,
+                                      with_null=bool(os_spec.null)
+                                      if os_spec else False,
+                                      lnl=lnl_cost)
+        # HBM watermark (obs.memwatch): allocator stats max-merged over the
+        # low-rate sampler's history, a final one-shot capture, and every
+        # local device; peak_hbm_bytes falls back to the packed-buffer model
+        # (static reservation + live buffers beyond the reservation's one)
+        # on stat-less backends so the bench rows always carry the metric
+        memory = sampler.stop()
+        for k, v in self._obs_memory_stats().items():
+            memory[k] = max(memory.get(k, 0), v)
+        memory.update(ledger.memory_fields())
+        if memory.get("peak_bytes_in_use"):
+            memory["peak_hbm_bytes"] = memory["peak_bytes_in_use"]
+            memory["peak_hbm_source"] = "allocator"
+        elif cost.get("static_reservation_bytes"):
+            memory["peak_hbm_bytes"] = (
+                int(cost["static_reservation_bytes"])
+                + ledger.model_extra_bytes_per_device())
+            memory["peak_hbm_source"] = "model"
         report = RunReport.from_collector(
             collector, meta,
             retraces=self._obs_retraces - retraces_before,
-            total_s=total_s,
-            cost=self._obs_capture_cost(base, chunk, path, prec, w_os=w_os,
-                                        with_null=bool(os_spec.null)
-                                        if os_spec else False,
-                                        lnl=lnl_cost),
-            memory=self._obs_memory_stats())
+            total_s=total_s, cost=cost, memory=memory)
         report.chunks = chunk_records
         report.spans = sorted(self._obs_spans)
+        report.timeline = sorted(timeline, key=lambda e: e.get("t0", 0.0))
         self.last_report = report
         out["report"] = report
+        if eventlog is not None:
+            # per-host event-log shard (every process writes its own file;
+            # `obs trace <dir>/events-p*.jsonl` merges them into one
+            # Perfetto timeline with a pid lane per host)
+            from pathlib import Path
+            shard_dir = Path(eventlog)
+            shard_dir.mkdir(parents=True, exist_ok=True)
+            report.save(shard_dir /
+                        f"events-p{int(jax.process_index()):03d}.jsonl")
         return out

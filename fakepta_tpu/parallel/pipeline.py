@@ -36,10 +36,12 @@ from __future__ import annotations
 import os
 import queue
 import threading
-import time
 from typing import Callable, Optional
 
 import jax
+import numpy as np
+
+from ..obs.timing import now as _now
 
 # opt-in env var for the persistent XLA compile cache; the kwarg
 # EnsembleSimulator(compile_cache_dir=...) takes precedence
@@ -150,9 +152,9 @@ class ThreadWriter:
         work, so the dispatch loop stops at most one chunk after a failure.
         """
         self._raise_pending()
-        t0 = time.perf_counter()
+        t0 = _now()
         self._q.put((drain, cancel))
-        return time.perf_counter() - t0
+        return _now() - t0
 
     def _raise_pending(self) -> None:
         if self._exc is not None:
@@ -175,6 +177,32 @@ class ThreadWriter:
 def make_writer(pipelined: bool):
     """The writer the run loop drains through: threaded iff pipelined."""
     return ThreadWriter() if pipelined else InlineWriter()
+
+
+def materialize_copy(x):
+    """Forced host copy of a device array that leaves the buffer DONATABLE.
+
+    ``np.array(np.asarray(x))`` — the obvious materialization — makes jax
+    cache a host view on the array (``_npy_value``); on backends where that
+    view is zero-copy (XLA:CPU) the cache holds a live external reference
+    to the device buffer, and XLA then *silently declines the donation*
+    when the pipelined loop recycles the buffer as a later dispatch's
+    scratch: the claimed in-place aliasing quietly became
+    dispatch-time copies (found by obs.memwatch's runtime donation check —
+    the recycled buffer was never marked deleted). Copying shard-by-shard
+    (``shard.data`` is a fresh per-shard view whose host view dies with
+    this scope) leaves no reference behind, so donation consumes the
+    buffer as designed. Single-process only (addressable shards ARE the
+    array) — exactly the pipelined loop's precondition; callers on the
+    multi-process path keep using ``to_host`` (process_allgather).
+    """
+    if not hasattr(x, "addressable_shards"):     # pragma: no cover
+        return np.array(np.asarray(x))           # old jax: plain copy
+    jax.block_until_ready(x)
+    out = np.empty(x.shape, x.dtype)
+    for s in x.addressable_shards:
+        out[s.index] = np.asarray(s.data)
+    return out
 
 
 def start_d2h(*arrays) -> int:

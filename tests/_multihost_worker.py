@@ -73,8 +73,13 @@ def main():
     def progress(done, total):
         seen.append(sorted(p.name for p in my_dir.iterdir()))
 
+    # per-host obs event-log shards (shared dir): every process writes
+    # events-p<pid>.jsonl; the test (standing in for process 0) merges them
+    # into one Chrome trace with a pid lane per host (obs trace)
+    shard_dir = outdir / "shards"
     out = sim.run(RUN["nreal"], seed=RUN["seed"], chunk=RUN["chunk"],
-                  checkpoint=str(my_dir / "ck"), progress=progress)
+                  checkpoint=str(my_dir / "ck"), progress=progress,
+                  eventlog=str(shard_dir))
 
     print(json.dumps({
         "process": pid,
@@ -84,6 +89,8 @@ def main():
         "curves_row0": np.asarray(out["curves"][0]).tolist(),
         "autos": np.asarray(out["autos"]).tolist(),
         "ckpt_files_mid_run": seen,
+        "eventlog_shard": str(shard_dir / f"events-p{pid:03d}.jsonl"),
+        "report_process_index": int(out["report"].meta["process_index"]),
     }), flush=True)
 
 

@@ -1,0 +1,24 @@
+"""Seeded true positives for timing-discipline (bare library clock reads),
+with near-misses: time.sleep is not a clock read, and routing through
+obs.timing (now/Timer) is the sanctioned path."""
+import time
+from time import perf_counter
+
+
+def measure(fn):
+    t0 = time.time()
+    fn()
+    return perf_counter() - t0
+
+
+def tick():
+    return time.monotonic()
+
+
+def sanctioned(fn):
+    from fakepta_tpu.obs.timing import now
+
+    time.sleep(0.001)          # a wait, not a measurement: never flagged
+    t0 = now()                 # the sanctioned clock
+    fn()
+    return now() - t0

@@ -101,6 +101,28 @@ def test_two_process_run_matches_single_host(tmp_path):
     assert any(files for files in by_pid[0]["ckpt_files_mid_run"])
     assert all(not files for files in by_pid[1]["ckpt_files_mid_run"])
 
+    # per-host obs event-log shards: every process wrote its own, metadata
+    # carries its process_index, and the shards merge into ONE Chrome trace
+    # with a pid lane per host (the multi-process trace story — the merge
+    # here plays the "process 0 merges" role after both workers exited)
+    from fakepta_tpu import obs
+    from fakepta_tpu.obs.trace import build_trace, validate_trace
+
+    shards = [pathlib.Path(by_pid[i]["eventlog_shard"]) for i in (0, 1)]
+    assert all(s.is_file() for s in shards), shards
+    reports = [obs.RunReport.load(s) for s in shards]
+    assert [r.meta["process_index"] for r in reports] == [0, 1]
+    assert all(r.meta["process_count"] == 2 for r in reports)
+    trace = build_trace(reports)
+    validate_trace(trace)
+    pids = {ev["pid"] for ev in trace["traceEvents"]}
+    assert pids == {0, 1}
+    # both hosts recorded per-chunk dispatch spans into their lanes
+    for pid in (0, 1):
+        names = {ev["name"] for ev in trace["traceEvents"]
+                 if ev["pid"] == pid and ev["ph"] == "X"}
+        assert "dispatch" in names, (pid, sorted(names))
+
     # the 2-process global mesh reproduces the single-host run exactly
     # (streams are mesh-placement independent; same global (2, 2, 2) shape
     # with the sequence-parallel psum crossing the process boundary; config

@@ -297,3 +297,40 @@ def test_cli_usage_errors_exit_2(tmp_path):
     proc = _cli("summarize", str(tmp_path / "missing.jsonl"))
     assert proc.returncode == 2
     assert "error:" in proc.stderr
+
+
+def test_cli_gate_smoke_on_real_bench_history(tmp_path):
+    """The CI smoke (ISSUE 7): `obs gate --fail-on-regression` exits 0 on
+    the real BENCH_r05 -> HEAD row and nonzero on a synthetic regressed
+    row, banding ONLY same-platform history (the CPU stand-in rounds
+    r03-r05 never gate an accelerator round)."""
+    wrapped = json.loads((REPO / "BENCH_r05.json").read_text())
+    row = wrapped["parsed"]
+    assert row and row["platform"] == "cpu"
+
+    head = tmp_path / "head.json"
+    head.write_text(json.dumps(row))
+    ok = _cli("gate", str(head), "--fail-on-regression")
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+    assert "no regressions flagged" in ok.stdout
+    assert "platform='cpu'" in ok.stdout
+
+    bad_row = dict(row, value=row["value"] / 2)   # throughput halved
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(bad_row))
+    report_only = _cli("gate", str(bad))           # diff tool by default
+    assert report_only.returncode == 0
+    assert "REGRESSION" in report_only.stdout
+    strict = _cli("gate", str(bad), "--fail-on-regression")
+    assert strict.returncode == 1
+    assert "value" in strict.stdout
+
+    # an accelerator-platform row finds no same-platform band in the
+    # committed history (r02 predates the platform field): informational,
+    # exit 0 — the cross-platform gating trap the MAD bands exist to avoid
+    tpu_row = dict(row, platform="tpu", value=48000.0)
+    tpu = tmp_path / "tpu.json"
+    tpu.write_text(json.dumps(tpu_row))
+    cross = _cli("gate", str(tpu), "--fail-on-regression")
+    assert cross.returncode == 0
+    assert "insufficient history" in cross.stdout

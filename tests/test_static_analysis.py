@@ -55,6 +55,9 @@ CASES = [
     ("precision_cast.py", LIB,
      {("mixed-precision-cast", 8), ("mixed-precision-cast", 9),
       ("mixed-precision-cast", 10)}),
+    ("timing_clock.py", LIB,
+     {("timing-discipline", 9), ("timing-discipline", 11),
+      ("timing-discipline", 15)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -98,6 +101,8 @@ def test_dtype_policy_paths_exist():
     for rel in policy.BF16_STORAGE_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale BF16_STORAGE_MODULES entry: {rel}"
+    for rel in policy.TIMING_MODULES:
+        assert (REPO / rel).is_file(), f"stale TIMING_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
