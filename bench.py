@@ -59,6 +59,21 @@ RunReport each ``sim.run()`` attaches):
   ``model_bytes_per_chunk_fused_bf16`` (the analytic model), plus
   ``fused_bytes_reduction_x`` = model_xla / model_fused — the recorded
   roofline acceptance (>= 2x on the flagship config; higher-is-better);
+- ``ess_per_s_per_chip`` / ``sample_steps_per_s_per_chip`` / ``rhat_max`` /
+  ``accept_rate``: the sampling-lane figures (``fakepta_tpu.sample``,
+  docs/SAMPLING.md) from a measured on-device batched-MCMC run — a CURN
+  free-spectrum posterior (per-bin ``log10_rho``, the model-independent
+  headline workload) sampled by HMC x parallel-tempering chains living
+  entirely on device, warm-started from the Laplace fit. ``ess_per_s_per_
+  chip`` is the minimum-over-dims effective sample count per second per
+  chip and ``sample_steps_per_s_per_chip`` the raw chain-transition
+  throughput (steps x chains x rungs); both are higher-better under ``obs
+  compare``/``gate``. ``rhat_max`` (split-free cross-chain R-hat, worst
+  dim) keeps the lower-is-better default — drifting up past the noise band
+  IS a regression — and ``accept_rate`` is an exempt health diagnostic
+  (non-monotonic optimum). The accelerator lane samples the flagship
+  100-psr array; the CPU stand-in samples a reduced array (the row's
+  ``platform`` field disambiguates, as everywhere);
 - ``peak_hbm_bytes``: the measured run's HBM watermark from the RunReport's
   memwatch lane (allocator ``peak_bytes_in_use`` max-aggregated over local
   devices and over the low-rate in-run sampler where the backend exposes
@@ -201,6 +216,35 @@ def main():
         "lnlike_evals_per_s_per_chip", 0.0)
     if lnl_sum.get("lnlike_bytes_per_chunk"):
         row["lnlike_bytes_per_chunk"] = lnl_sum["lnlike_bytes_per_chunk"]
+    # the sampling lane (fakepta_tpu.sample): on-device batched MCMC — a
+    # CURN free-spectrum posterior (per-bin log10_rho) characterized by HMC
+    # x tempering chains with zero host round-trips in the chain loop
+    # (docs/SAMPLING.md). The flagship array on an accelerator; a reduced
+    # array on the CPU stand-in (the Laplace staging + per-step batched
+    # Cholesky make the 100-psr config intractable host-side) — rows are
+    # disambiguated by `platform` like every stand-in figure.
+    from fakepta_tpu.sample import SampleSpec, SamplingRun
+    if platform != "cpu":
+        s_batch, s_chains, s_steps, s_warm = batch, 256, 512, 256
+    else:
+        s_batch = PulsarBatch.synthetic(npsr=8, ntoa=96, tspan_years=15.0,
+                                        toaerr=1e-7, n_red=8, n_dm=8, seed=0)
+        s_chains, s_steps, s_warm = 16, 256, 128
+    s_model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=6, spectrum="free_spectrum", free=(
+            FreeParam("log10_rho", (-9.0, -5.0), per_bin=True),)),
+    ))
+    s_spec = SampleSpec(model=s_model, n_chains=s_chains, n_temps=2,
+                        step_size=0.35, n_leapfrog=10, thin=2, warmup=s_warm)
+    sampler = SamplingRun(s_batch, s_spec, mesh=make_mesh(jax.devices()),
+                          data_seed=7)
+    s_out = sampler.run(s_steps, seed=7, segment=128, pipeline_depth=2)
+    for key in ("ess_per_s_per_chip", "sample_steps_per_s_per_chip",
+                "rhat_max", "accept_rate"):
+        row[key] = s_out["summary"][key]
+
     # per-mode bytes/chunk (the megakernel tentpole, docs/PERFORMANCE.md):
     # AOT cost capture of the fused whole-chunk program and its
     # bf16-storage mode on the same flagship batch — a compile, not a

@@ -16,7 +16,10 @@ from a second measured run with ``os='hd'`` (the device optimal statistic,
 ``fakepta_tpu.detect``) and the inference-lane figures
 ``lnlike_evals_per_s_per_chip`` / ``lnlike_bytes_per_chunk`` from a third
 measured run with a K=16 CURN hyperparameter grid (the GP-marginalized
-device likelihood, ``fakepta_tpu.infer`` — see the bench.py docstring for
+device likelihood, ``fakepta_tpu.infer``) and the sampling-lane figures
+``ess_per_s_per_chip`` / ``sample_steps_per_s_per_chip`` / ``rhat_max`` /
+``accept_rate`` from an on-device batched-MCMC free-spectrum posterior
+(``fakepta_tpu.sample``, docs/SAMPLING.md — see the bench.py docstring for
 the full schema).
 
     python benchmarks/suite.py                 # all configs, default sizes
@@ -446,6 +449,37 @@ def config5():
             lnl_sum["lnlike_evals_per_s_per_chip"]
     if lnl_sum.get("lnlike_bytes_per_chunk"):
         row["lnlike_bytes_per_chunk"] = lnl_sum["lnlike_bytes_per_chunk"]
+
+    # the sampling lane (fakepta_tpu.sample): on-device batched-MCMC CURN
+    # free-spectrum posterior — ESS/s, chain-step throughput, worst-dim
+    # R-hat and acceptance from the run summary (bench.py docstring
+    # schema; flagship array on accelerator, reduced array on the CPU
+    # stand-in where the host Laplace staging + per-step batched Cholesky
+    # are intractable at 100 psr)
+    from fakepta_tpu.sample import SampleSpec, SamplingRun
+    if jax.devices()[0].platform != "cpu":
+        s_batch, s_chains, s_steps, s_warm = batch, 256, 512, 256
+    else:
+        s_batch = PulsarBatch.synthetic(npsr=8, ntoa=96, tspan_years=15.0,
+                                        toaerr=1e-7, n_red=8, n_dm=8, seed=0)
+        s_chains, s_steps, s_warm = 16, 256, 128
+    s_model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=6, spectrum="free_spectrum",
+                      free=(FreeParam("log10_rho", (-9.0, -5.0),
+                                      per_bin=True),)),
+    ))
+    s_spec = SampleSpec(model=s_model, n_chains=s_chains, n_temps=2,
+                        step_size=0.35, n_leapfrog=10, thin=2,
+                        warmup=s_warm)
+    sampler = SamplingRun(s_batch, s_spec, mesh=make_mesh(jax.devices()),
+                          data_seed=7)
+    s_sum = sampler.run(s_steps, seed=7, segment=128,
+                        pipeline_depth=2)["summary"]
+    for key in ("ess_per_s_per_chip", "sample_steps_per_s_per_chip",
+                "rhat_max", "accept_rate"):
+        row[key] = s_sum[key]
 
     # per-mode bytes/chunk (the whole-chunk megakernel + bf16-storage
     # mode, bench.py docstring schema): AOT cost capture only — the

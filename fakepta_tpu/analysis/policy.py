@@ -76,6 +76,15 @@ DTYPE_POLICY = {
     # parallel/montecarlo.py.
     "fakepta_tpu/infer/run.py": "host-f64",
     "fakepta_tpu/infer/cli.py": "host-f64",
+    # the sampling subsystem's host layers: the facade's one-off f64
+    # staging (data -> Woodbury moments -> Newton/Laplace warm start runs
+    # under enable_x64 on CPU before any chain dispatches) and the host
+    # diagnostics finishers (R-hat/ESS from drained accumulators at f64).
+    # The device pieces live elsewhere under device-f32: ops/mcmc.py is
+    # dtype-polymorphic jnp and the chain program runs at the batch dtype.
+    "fakepta_tpu/sample/run.py": "host-f64",
+    "fakepta_tpu/sample/model.py": "host-f64",
+    "fakepta_tpu/sample/cli.py": "host-f64",
 }
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"

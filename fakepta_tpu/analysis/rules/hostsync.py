@@ -17,6 +17,19 @@ functions outside the loop (``montecarlo._drain_chunk``) and run on the
 pipeline's writer thread; a deliberate in-loop sync takes a pragma with its
 justification. Comprehensions are not flagged — a single post-loop gather
 (``[to_host(p) for p in out]``) is the intended final fetch.
+
+Third clause (the chain-loop clause): any host sync — ``to_host``/
+``block_until_ready``, ``float(...)``-family casts, ``.item()``/
+``.tolist()``, ``np.asarray`` — inside a function passed as a
+``lax.scan``/``fori_loop``/``while_loop``/``associative_scan`` body.
+Those bodies are ALWAYS traced (scan traces its body even without an
+enclosing ``jax.jit``), and they are exactly where the on-device sampler's
+zero-host-round-trips contract lives (docs/SAMPLING.md): one host
+materialization inside the chain loop's transition body re-serializes every
+MCMC step behind a device round-trip, the pattern ``fakepta_tpu.sample``
+exists to kill. Thinned draws leave through the writer-thread drain at
+segment boundaries; there is no sanctioned in-scan sync, so a violation
+here takes a pragma or a redesign.
 """
 
 from __future__ import annotations
@@ -38,6 +51,16 @@ _NUMPY_MATERIALIZERS = {"numpy.asarray", "numpy.array", "numpy.copy"}
 # the engine's to_host (parallel.mesh) and jax.block_until_ready (matched
 # as a bare call or a method on an array)
 _LOOP_SYNCS = {"to_host", "block_until_ready"}
+
+# lax loop-control primitives whose callable arguments are traced bodies
+# (the chain-loop clause): argument positions holding a traced function.
+# while_loop's cond AND body are both traced; fori_loop's body is arg 2.
+_TRACED_BODY_ARGS = {
+    "lax.scan": (0,),
+    "lax.fori_loop": (2,),
+    "lax.while_loop": (0, 1),
+    "lax.associative_scan": (0,),
+}
 
 
 def _loop_sync_findings(ctx: ModuleContext,
@@ -66,9 +89,91 @@ def _loop_sync_findings(ctx: ModuleContext,
     return findings
 
 
+def _traced_body_functions(tree: ast.AST, resolver: NameResolver):
+    """(fn node, primitive) for functions passed as lax loop-control bodies.
+
+    Matches a named def (module- or closure-level) or an inline lambda in a
+    traced-callable position of scan/fori_loop/while_loop/associative_scan.
+    """
+    defs_by_name = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+    bodies = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(resolver, node)
+        if not name:
+            continue
+        for prim, positions in _TRACED_BODY_ARGS.items():
+            if name != prim and not name.endswith("." + prim):
+                continue
+            for pos in positions:
+                if pos >= len(node.args):
+                    continue
+                arg = node.args[pos]
+                if isinstance(arg, ast.Lambda):
+                    bodies.append((arg, last_component(prim)))
+                elif isinstance(arg, ast.Name):
+                    for d in defs_by_name.get(arg.id, ()):
+                        bodies.append((d, last_component(prim)))
+    return bodies
+
+
+def _sync_call_message(resolver: NameResolver, node: ast.Call, where: str):
+    """The shared host-sync match: a message when ``node`` is one, else
+    None. ``where`` names the traced scope for the message."""
+    name = call_name(resolver, node)
+    if name and last_component(name) in _LOOP_SYNCS or (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "block_until_ready"):
+        kind = (last_component(name) if name
+                and last_component(name) in _LOOP_SYNCS
+                else "block_until_ready")
+        return (f"{kind}() inside {where} is a host round-trip in the "
+                f"chain loop — every step serializes behind a device "
+                f"sync; accumulate on device and drain thinned output at "
+                f"segment boundaries through the writer thread")
+    if name in _HOST_CASTS and len(node.args) == 1 and \
+            not isinstance(node.args[0], ast.Constant):
+        return (f"{name}() on a value inside {where} materializes it on "
+                f"host at trace time; use jnp ops or hoist the cast out "
+                f"of the traced scope")
+    if name in _NUMPY_MATERIALIZERS:
+        return (f"{name.replace('numpy', 'np')} inside {where} forces a "
+                f"device->host copy (or pins a trace-time constant); use "
+                f"jnp.asarray or move it to setup code")
+    if isinstance(node.func, ast.Attribute) and \
+            node.func.attr in _HOST_METHODS and not node.args:
+        return (f".{node.func.attr}() inside {where} is a blocking "
+                f"device->host sync; keep the value on device")
+    return None
+
+
+def _chain_loop_findings(ctx: ModuleContext, resolver: NameResolver,
+                         seen) -> List[Finding]:
+    findings: List[Finding] = []
+    for fn, prim in _traced_body_functions(ctx.tree, resolver):
+        fname = getattr(fn, "name", "<lambda>")
+        where = f"the {prim} body '{fname}'"
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            key = (node.lineno, node.col_offset)
+            if key in seen:
+                continue
+            msg = _sync_call_message(resolver, node, where)
+            if msg is not None:
+                findings.append(ctx.finding(RULE_ID, node, msg))
+                seen.add(key)
+    return findings
+
+
 def check(ctx: ModuleContext) -> List[Finding]:
     resolver = NameResolver(ctx.tree)
     findings: List[Finding] = []
+    seen: set = set()
     if ctx.is_library:
         findings.extend(_loop_sync_findings(ctx, resolver))
     for fn in jitted_functions(ctx.tree, resolver):
@@ -96,5 +201,9 @@ def check(ctx: ModuleContext) -> List[Finding]:
                     RULE_ID, node,
                     f".{node.func.attr}() inside jitted '{fn.name}' is a "
                     f"blocking device->host sync; keep the value on device"))
+            else:
+                continue
+            seen.add((node.lineno, node.col_offset))
+    findings.extend(_chain_loop_findings(ctx, resolver, seen))
     # dedupe: nested loops walk the same call once per enclosing loop
     return sorted(set(findings))

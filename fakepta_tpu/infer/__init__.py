@@ -29,14 +29,19 @@ Layers (docs/INFERENCE.md):
 
 from .model import (BATCH_SPECTRUM, INFER_SCHEMA, ComponentSpec,
                     CompiledLikelihood, FreeParam, InferSpec,
-                    LikelihoodSpec, as_spec, assemble, build,
-                    lanes_per_point, theta_grid)
+                    LikelihoodSpec, as_spec, assemble, box_from_unconstrained,
+                    box_log_prior, box_to_unconstrained,
+                    box_unconstrained_log_prior,
+                    box_unconstrained_log_prior_grad, build, lanes_per_point,
+                    theta_grid)
 from .reconstruct import wiener_coefficients, wiener_reconstruct
 from .run import InferenceRun
 
 __all__ = [
     "BATCH_SPECTRUM", "INFER_SCHEMA", "ComponentSpec", "CompiledLikelihood",
     "FreeParam", "InferSpec", "InferenceRun", "LikelihoodSpec", "as_spec",
-    "assemble", "build", "lanes_per_point", "theta_grid",
-    "wiener_coefficients", "wiener_reconstruct",
+    "assemble", "box_from_unconstrained", "box_log_prior",
+    "box_to_unconstrained", "box_unconstrained_log_prior",
+    "box_unconstrained_log_prior_grad", "build", "lanes_per_point",
+    "theta_grid", "wiener_coefficients", "wiener_reconstruct",
 ]

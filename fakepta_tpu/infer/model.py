@@ -20,8 +20,15 @@ through ``phi`` alone.
 
 Free parameters are scalars shared across pulsars by default;
 ``FreeParam(per_pulsar=True)`` gives every pulsar its own theta slot (the
-per-pulsar noise-surface case). Priors are box transforms: ``bounds``
-feed :func:`theta_grid` and :meth:`CompiledLikelihood.theta_from_unit`.
+per-pulsar noise-surface case) and ``FreeParam(per_bin=True)`` one slot per
+frequency bin (the model-independent free-spectrum case: per-bin
+``log10_rho`` on a common process). Priors are box transforms, and the box
+is SINGLE-SOURCED: the same ``FreeParam.bounds`` feed :func:`theta_grid`
+(the grid CLI), :meth:`CompiledLikelihood.theta_from_unit`, the uniform
+:func:`box_log_prior`, and the unconstrained ``<->`` box logit transform
+(:func:`box_to_unconstrained` / :func:`box_from_unconstrained`) the
+on-device sampler (:mod:`fakepta_tpu.sample`) runs its chains in — grid
+studies and MCMC posteriors see identical prior mass by construction.
 """
 
 from __future__ import annotations
@@ -56,14 +63,24 @@ MODES = ("lnlike", "grad", "fisher")
 
 @dataclasses.dataclass(frozen=True)
 class FreeParam:
-    """One free spectrum hyperparameter: name, box bounds, pulsar scope."""
+    """One free spectrum hyperparameter: name, box bounds, scope.
+
+    ``per_pulsar`` gives every pulsar its own theta slot; ``per_bin`` one
+    slot per frequency bin of the component (the free-spectrum case — the
+    named hyperparameter must accept a per-bin vector, e.g. ``log10_rho``).
+    The two scopes are mutually exclusive.
+    """
 
     name: str
     bounds: Tuple[float, float]
     per_pulsar: bool = False
+    per_bin: bool = False
 
     def __post_init__(self):
         object.__setattr__(self, "bounds", tuple(self.bounds))
+        if self.per_pulsar and self.per_bin:
+            raise ValueError(f"FreeParam {self.name!r} cannot be both "
+                             f"per_pulsar and per_bin")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -147,15 +164,17 @@ def theta_grid(model: LikelihoodSpec, shape: Union[int, Sequence[int]]):
     """(K, D) regular grid over every free parameter's box bounds.
 
     ``shape`` gives the points per free parameter in declaration order (one
-    int broadcasts). Per-pulsar parameters have no sensible dense grid —
-    build ``theta`` explicitly for those models.
+    int broadcasts). Per-pulsar and per-bin parameters have no sensible
+    dense grid — build ``theta`` explicitly (or sample the posterior with
+    :mod:`fakepta_tpu.sample`) for those models.
     """
     params = [fp for comp in model.components for fp in comp.free]
     if not params:
         raise ValueError("theta_grid needs at least one free parameter")
-    if any(fp.per_pulsar for fp in params):
-        raise ValueError("theta_grid cannot grid per-pulsar parameters; "
-                         "pass an explicit theta array instead")
+    if any(fp.per_pulsar or fp.per_bin for fp in params):
+        raise ValueError("theta_grid cannot grid per-pulsar/per-bin "
+                         "parameters; pass an explicit theta array (or run "
+                         "the sampler) instead")
     if isinstance(shape, (int, np.integer)):
         shape = (int(shape),) * len(params)
     shape = tuple(int(s) for s in shape)
@@ -166,6 +185,58 @@ def theta_grid(model: LikelihoodSpec, shape: Union[int, Sequence[int]]):
             for fp, s in zip(params, shape)]
     mesh = np.meshgrid(*axes, indexing="ij")
     return np.stack([m.ravel() for m in mesh], axis=-1)
+
+
+# ---------------------------------------------------------------------------
+# box priors & the unconstrained <-> box transform — the SINGLE SOURCE of
+# prior mass for the grid CLI (theta_grid / theta_from_unit) and the sampler
+# (fakepta_tpu.sample runs its chains in the unconstrained logit space).
+# Dtype-polymorphic jnp: f64 in host staging/oracles, batch dtype on device.
+# ---------------------------------------------------------------------------
+
+def box_log_prior(theta, bounds):
+    """ln p(theta) of the uniform box prior: ``-sum ln(hi - lo)`` inside the
+    box, ``-inf`` outside. ``theta`` (..., D), ``bounds`` (D, 2)."""
+    theta = jnp.asarray(theta)
+    bounds = jnp.asarray(bounds, theta.dtype)
+    lo, hi = bounds[:, 0], bounds[:, 1]
+    inside = jnp.all((theta >= lo) & (theta <= hi), axis=-1)
+    lnv = -jnp.sum(jnp.log(hi - lo))
+    return jnp.where(inside, lnv, -jnp.inf)
+
+
+def box_to_unconstrained(theta, bounds):
+    """Logit transform box -> R^D: ``v = logit((theta - lo)/(hi - lo))``."""
+    theta = jnp.asarray(theta)
+    bounds = jnp.asarray(bounds, theta.dtype)
+    lo, hi = bounds[:, 0], bounds[:, 1]
+    u = (theta - lo) / (hi - lo)
+    return jnp.log(u) - jnp.log1p(-u)
+
+
+def box_from_unconstrained(v, bounds):
+    """Inverse logit R^D -> box: ``theta = lo + (hi - lo) * sigmoid(v)``."""
+    v = jnp.asarray(v)
+    bounds = jnp.asarray(bounds, v.dtype)
+    lo, hi = bounds[:, 0], bounds[:, 1]
+    return lo + (hi - lo) * jax.nn.sigmoid(v)
+
+
+def box_unconstrained_log_prior(v):
+    """ln density of the box prior IN THE UNCONSTRAINED variable, up to the
+    bounds-independent constant: ``ln p(v) = ln p(theta(v)) + ln|dtheta/dv|
+    = sum [log sigmoid(v) + log sigmoid(-v)]`` — the ``ln(hi - lo)`` volume
+    and Jacobian factors cancel exactly, so the sampler's target never needs
+    the bounds at all (they enter only through the transform)."""
+    v = jnp.asarray(v)
+    return jnp.sum(jax.nn.log_sigmoid(v) + jax.nn.log_sigmoid(-v), axis=-1)
+
+
+def box_unconstrained_log_prior_grad(v):
+    """Gradient of :func:`box_unconstrained_log_prior`:
+    ``sigmoid(-v) - sigmoid(v)`` elementwise."""
+    v = jnp.asarray(v)
+    return jax.nn.sigmoid(-v) - jax.nn.sigmoid(v)
 
 
 def _batch_bins(batch, target: str) -> int:
@@ -242,11 +313,15 @@ class CompiledLikelihood:
                 if fp.per_pulsar and comp.target == "curn":
                     raise ValueError("'curn' is a common process; its "
                                      "hyperparameters cannot be per_pulsar")
-                length = self.npsr if fp.per_pulsar else 1
-                free_entries.append((fp.name, d, fp.per_pulsar))
+                length = (self.npsr if fp.per_pulsar
+                          else nbin if fp.per_bin else 1)
+                free_entries.append((fp.name, d, fp.per_pulsar, fp.per_bin))
                 if fp.per_pulsar:
                     names.extend(f"{comp.target}_{fp.name}[{p}]"
                                  for p in range(self.npsr))
+                elif fp.per_bin:
+                    names.extend(f"{comp.target}_{fp.name}[{b}]"
+                                 for b in range(nbin))
                 else:
                     names.append(f"{comp.target}_{fp.name}")
                 bounds.extend([list(fp.bounds)] * length)
@@ -282,6 +357,22 @@ class CompiledLikelihood:
         u = np.asarray(u, dtype=float)
         lo, hi = self.bounds[:, 0], self.bounds[:, 1]
         return lo + u * (hi - lo)
+
+    # -- prior / transform (usable on host f64 and inside device programs;
+    #    the SAME self.bounds that theta_grid meshes, so grid studies and
+    #    the sampler see identical prior mass) ----------------------------
+    def log_prior(self, theta):
+        """Uniform-box ln p(theta) over this model's bounds (see
+        :func:`box_log_prior`)."""
+        return box_log_prior(theta, self.bounds)
+
+    def to_unconstrained(self, theta):
+        """Box -> R^D logit transform (see :func:`box_to_unconstrained`)."""
+        return box_to_unconstrained(theta, self.bounds)
+
+    def from_unconstrained(self, v):
+        """R^D -> box inverse logit (see :func:`box_from_unconstrained`)."""
+        return box_from_unconstrained(v, self.bounds)
 
     # -- device functions (legal inside jit/shard_map on batch shards) -----
     def basis(self, batch):
@@ -345,11 +436,16 @@ class CompiledLikelihood:
                 cols.append(jnp.concatenate([pd, pd], axis=-1))
                 continue
             kwargs = dict(c["fixed"])
-            for pname, start, per_psr in c["free"]:
+            for pname, start, per_psr, per_bin in c["free"]:
                 if per_psr:
                     v = lax.dynamic_slice(theta, (start + psr_offset,),
                                           (p_local,))
                     kwargs[pname] = v[:, None]
+                elif per_bin:
+                    # one slot per frequency bin (free spectrum): the (n,)
+                    # vector broadcasts against f ((n,) for curn, (P, n)
+                    # per pulsar) inside the registered spectrum
+                    kwargs[pname] = lax.dynamic_slice(theta, (start,), (n,))
                 else:
                     kwargs[pname] = theta[start]
             psd = spectrum_lib.evaluate(c["spectrum"], f, **kwargs)

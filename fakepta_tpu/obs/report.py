@@ -28,7 +28,13 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     "intensity_flop_per_byte",
                     # bench-row headline fields (the BENCH_r*.json schema):
                     # throughput value and its multiple of the v5e target
-                    "value", "vs_baseline"}
+                    "value", "vs_baseline",
+                    # the sampling lane's effective-sample count (its
+                    # ess_per_s_per_chip / sample_steps_per_s_per_chip
+                    # throughputs ride the _per_s_per_chip suffix, and
+                    # rhat_max keeps the lower-is-better default: R-hat
+                    # drifting up past the noise band IS a regression)
+                    "ess_min"}
 
 # suffix rules cover the detect lane's per-ORF metric names
 # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
@@ -50,7 +56,15 @@ HIGHER_SUFFIXES = ("_per_s_per_chip", "_significance_sigma",
 # depth bound itself) whose *violation* is a runtime error, not a delta.
 EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   "hbm_samples", "packed_buffer_bytes",
-                  "packed_buffers_live_peak", "packed_depth_bound_bytes"}
+                  "packed_buffers_live_peak", "packed_depth_bound_bytes",
+                  # sampler kernel-health diagnostics: acceptance/swap rates
+                  # are tuning targets with a non-monotonic optimum (~0.65-
+                  # 0.9 for HMC), so neither direction is "worse"; the
+                  # regression-bearing sampler metrics are ess_min /
+                  # ess_per_s_per_chip / sample_steps_per_s_per_chip
+                  # (higher-better) and rhat_max / divergences /
+                  # nonfinite_lnl (lower-better defaults)
+                  "accept_rate", "swap_rate", "n_kept"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")

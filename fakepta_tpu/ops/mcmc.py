@@ -1,0 +1,166 @@
+"""Batched gradient-informed MCMC transition kernels (HMC + tempering).
+
+The device-side half of :mod:`fakepta_tpu.sample`: a Hamiltonian Monte
+Carlo transition over a ``(chains, temps, D)`` state tensor plus adjacent
+replica-exchange (parallel tempering) swaps expressed as on-device
+permutations — no host decision anywhere, so a ``lax.scan`` over these
+transitions is one jitted program with zero host syncs inside.
+
+Design contracts:
+
+- **Pure and dtype-polymorphic**: plain jnp on whatever dtype the state
+  carries — f64 in the oracle tests (leapfrog reversibility / detailed
+  balance to ~1e-12), the batch dtype inside the engine's chain program.
+- **Target-agnostic**: the (tempered) posterior enters only through a
+  ``vg(z) -> (lnl, glnl, lnpri, glnpri)`` callable evaluated on the full
+  ``(C, T, D)`` tensor at once, so the caller controls batching, sharding
+  and collectives (the sampler gathers per-pulsar likelihood rows over the
+  'psr' mesh axis and reduces them in a fixed order — bitwise
+  mesh-invariant, see :func:`fakepta_tpu.ops.woodbury.lnlike_and_grad_phi`).
+- **Stream discipline**: every draw comes from a per-(chain, temp) key the
+  caller derives by folding the GLOBAL chain index (the engine's
+  realization-key convention), so chain trajectories are bit-identical on
+  any mesh shape.
+- **Tempering**: only the likelihood is tempered (``beta_t * lnl +
+  lnpri``), so prior mass is shared across the ladder and the swap accept
+  ratio reduces to ``(beta_i - beta_j)(lnl_j - lnl_i)``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+#: divergence threshold: a leapfrog trajectory whose energy error exceeds
+#: this (or goes non-finite) is counted divergent and always rejected
+MAX_ENERGY_ERROR = 50.0
+
+
+def tempered(parts, betas):
+    """(lnp, grad) of the tempered target from vg parts. ``betas`` (T,)."""
+    lnl, glnl, lnpri, glnpri = parts
+    return (betas * lnl + lnpri, betas[..., None] * glnl + glnpri)
+
+
+def leapfrog(vg, z, parts, p, eps, n_steps, betas):
+    """``n_steps`` of the leapfrog integrator on the full (C, T, D) tensor.
+
+    ``eps`` broadcasts against (C, T, 1) — per-temperature step sizes are
+    ``eps[None, :, None]``. Uses the merged-kick form (initial half kick,
+    full kicks, undo half): ``n_steps`` gradient evaluations total, exactly
+    reversible up to floating-point roundoff (the detailed-balance oracle
+    in tests/test_sample.py pins this at f64).
+    Returns ``(z, p, parts)`` at the trajectory end.
+    """
+    _, g = tempered(parts, betas)
+    p = p + 0.5 * eps * g
+
+    def body(carry, _):
+        z, p, parts = carry
+        z = z + eps * p
+        parts = vg(z)
+        _, g = tempered(parts, betas)
+        p = p + eps * g
+        return (z, p, parts), None
+
+    (z, p, parts), _ = lax.scan(body, (z, p, parts), None, length=n_steps)
+    _, g = tempered(parts, betas)
+    p = p - 0.5 * eps * g
+    return z, p, parts
+
+
+def hmc_transition(keys, z, parts, vg, betas, eps, n_leapfrog,
+                   max_energy_error=MAX_ENERGY_ERROR):
+    """One batched HMC transition for every (chain, temp).
+
+    ``keys`` (C, T) per-(chain, temp) PRNG keys (momentum draw folds subtag
+    0, the accept uniform subtag 1 — the caller already folded step index,
+    global chain index and temperature). ``z`` (C, T, D), ``parts`` the
+    ``vg(z)`` 4-tuple, ``betas`` (T,), ``eps`` (T,) per-temperature step
+    sizes, ``n_leapfrog`` static.
+
+    Returns ``(z, parts, accept, divergent)`` with accept/divergent (C, T)
+    bools. Non-finite or > ``max_energy_error`` trajectories count as
+    divergent and are always rejected (the flight recorder surfaces their
+    count per run).
+    """
+    dtype = z.dtype
+    d = z.shape[-1]
+    kmom = jax.vmap(jax.vmap(
+        lambda k: jax.random.normal(jax.random.fold_in(k, 0), (d,), dtype)))(
+            keys)
+    lnu = jax.vmap(jax.vmap(
+        lambda k: jnp.log(jax.random.uniform(
+            jax.random.fold_in(k, 1), (), dtype))))(keys)
+    lnp0, _ = tempered(parts, betas)
+    h0 = lnp0 - 0.5 * jnp.sum(kmom * kmom, axis=-1)
+    eps_b = eps[None, :, None]
+    z1, p1, parts1 = leapfrog(vg, z, parts, kmom, eps_b, n_leapfrog, betas)
+    lnp1, _ = tempered(parts1, betas)
+    h1 = lnp1 - 0.5 * jnp.sum(p1 * p1, axis=-1)
+    dh = h1 - h0
+    ok = jnp.isfinite(dh)
+    divergent = (~ok) | (dh < -max_energy_error)
+    accept = ok & (lnu < dh)
+    sel = accept[..., None]
+    z = jnp.where(sel, z1, z)
+    lnl, glnl, lnpri, glnpri = parts
+    lnl1, glnl1, lnpri1, glnpri1 = parts1
+    parts = (jnp.where(accept, lnl1, lnl),
+             jnp.where(sel, glnl1, glnl),
+             jnp.where(accept, lnpri1, lnpri),
+             jnp.where(sel, glnpri1, glnpri))
+    return z, parts, accept, divergent
+
+
+def swap_permutation(keys, lnl, betas, parity):
+    """Adjacent-pair replica-exchange permutation along the temperature axis.
+
+    ``keys`` (C,) per-chain keys, ``lnl`` (C, T) UNtempered log-likelihoods,
+    ``parity`` 0/1 selects which adjacent pairs ``(t, t+1)`` propose this
+    round (even/odd alternation covers the whole ladder). Both members of a
+    pair share one uniform, and the log accept ratio
+    ``(beta_t - beta_p)(lnl_p - lnl_t)`` is symmetric under the pair swap,
+    so the result is a well-formed on-device permutation — apply it with
+    :func:`apply_permutation`, no host round-trip.
+
+    Returns (C, T) int32 gather indices (``t`` itself where no swap).
+    """
+    t_count = lnl.shape[-1]
+    t = jnp.arange(t_count)
+    up = (t % 2) == (parity % 2)
+    partner = jnp.clip(jnp.where(up, t + 1, t - 1), 0, t_count - 1)
+    lo = jnp.minimum(t, partner)
+    ln_r = (betas[t] - betas[partner]) * (lnl[..., partner] - lnl[..., t])
+
+    def one(key, ln_r_c):
+        us = jax.random.uniform(key, (t_count,), ln_r_c.dtype)
+        acc = (jnp.log(us[lo]) < ln_r_c) & (partner != t)
+        return jnp.where(acc, partner, t)
+
+    return jax.vmap(one)(keys, ln_r)
+
+
+def apply_permutation(perm, *arrays):
+    """Gather each array's temperature axis (axis 1) through ``perm``.
+
+    Arrays are (C, T) or (C, T, D); every per-(chain, temp) state tensor
+    (position, cached likelihood/prior values and gradients) must ride the
+    same permutation so the swapped chains stay self-consistent.
+    """
+    out = []
+    for a in arrays:
+        idx = perm if a.ndim == 2 else perm[..., None]
+        out.append(jnp.take_along_axis(a, jnp.broadcast_to(idx, a.shape),
+                                       axis=1))
+    return tuple(out)
+
+
+def geometric_betas(n_temps, max_temp, dtype=jnp.float32):
+    """The standard geometric inverse-temperature ladder: ``beta_t =
+    max_temp^(-t/(T-1))`` with ``beta_0 = 1`` (the cold, target chain)."""
+    if n_temps == 1:
+        return jnp.ones((1,), dtype)
+    expo = jnp.arange(n_temps, dtype=dtype) / (n_temps - 1)
+    return jnp.asarray(float(max_temp), dtype) ** (-expo)

@@ -172,6 +172,45 @@ def lnlike_from_moments(d0, dT, M, lndetN, n_valid, phi):
     return -0.5 * (quad + lndetN + lnnorm + n_valid * LN_2PI)
 
 
+def lnlike_and_grad_phi(M, phi, d0, dT, lndetN, n_valid):
+    """Woodbury lnL for ONE pulsar plus its CLOSED-FORM gradient wrt phi.
+
+    The analytic van Haasteren–Vallisneri derivative
+
+        d lnL / d phi_j = -1/2 [ 1/phi_j - (Sigma^{-1})_jj / phi_j^2
+                                 - (Sigma^{-1} dT)_j^2 / phi_j^2 ]
+
+    — one Cholesky, one triangular inverse and two triangular solves per
+    (pulsar, theta) point, all pulsar-local elementwise-batched ops. The
+    on-device sampler (:mod:`fakepta_tpu.sample`) uses this instead of
+    reverse-mode autodiff so each pulsar's (lnL, grad) row is computed
+    bit-identically on every mesh shape; the cross-pulsar reduction then
+    happens in a FIXED order after one gather, which is what makes chain
+    trajectories bitwise mesh-invariant (chaotic accept/reject loops
+    amplify any ulp, so tolerance-level invariance is not enough there).
+    Returns ``(lnl, dlnl_dphi)`` with shapes ``()`` and ``(2M,)``.
+    """
+    phi = jnp.maximum(phi, _phi_floor(phi.dtype))
+    sigma = M + jnp.diag(1.0 / phi)
+    chol = jnp.linalg.cholesky(sigma)
+    lnnorm = jnp.sum(jnp.log(phi)) + 2.0 * jnp.sum(
+        jnp.log(jnp.diagonal(chol)))
+    y = solve_triangular(chol, dT, lower=True)
+    quad = d0 - jnp.sum(y * y)
+    lnl = -0.5 * (quad + lndetN + lnnorm + n_valid * LN_2PI)
+    # b = Sigma^{-1} dT (back-substitution of the forward solve), and
+    # diag(Sigma^{-1}) from the triangular inverse: Sigma^{-1} = L^-T L^-1
+    # => (Sigma^{-1})_jj = sum_k (L^-1)_kj^2. Triangular solves only — the
+    # library-wide no-dense-inverse contract holds.
+    b = solve_triangular(chol, y, lower=True, trans=1)
+    linv = solve_triangular(chol, jnp.eye(chol.shape[0], dtype=chol.dtype),
+                            lower=True)
+    sdiag = jnp.sum(linv * linv, axis=0)
+    inv_phi2 = 1.0 / (phi * phi)
+    glnl = -0.5 * (1.0 / phi - sdiag * inv_phi2 - (b * b) * inv_phi2)
+    return lnl, glnl
+
+
 def conditional_mean(M, phi, dT):
     """Posterior-mean GP coefficients ``b = Sigma^{-1} T^T N^{-1} r``.
 

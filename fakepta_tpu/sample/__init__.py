@@ -1,0 +1,38 @@
+"""fakepta_tpu.sample — on-device batched MCMC as an engine lane.
+
+The subsystem that closes the inference loop (ROADMAP item 1): posterior
+characterization used to mean a host-driven sampler round-tripping
+device<->host every step — exactly the pattern the chunked engine was built
+to kill. Here thousands of gradient-informed HMC chains times parallel-
+tempering rungs live entirely on device: the chain loop is one jitted
+``lax.scan`` program per segment with ZERO host syncs inside, warm-started
+and whitened by a Laplace fit of the same Woodbury likelihood the grid lane
+evaluates (``ops/woodbury.py`` — now with the closed-form gradient kernel
+:func:`~fakepta_tpu.ops.woodbury.lnlike_and_grad_phi`), chains sharded over
+the ``'real'`` mesh axis and the per-pulsar likelihood over ``'psr'``,
+tempering swaps as on-device permutations, and on-device R-hat/ESS/
+acceptance accumulators that drain through the async pipeline's writer
+thread exactly like chunk outputs.
+
+Layers (docs/SAMPLING.md):
+
+- :mod:`fakepta_tpu.ops.mcmc` — the batched transition kernels: leapfrog/
+  HMC over a (chains, temps, D) tensor, replica-exchange permutations, the
+  geometric beta ladder; pure, dtype-polymorphic, target-agnostic.
+- :mod:`model` — :class:`SampleSpec` (chains/temps/kernel configuration
+  over a :class:`~fakepta_tpu.infer.LikelihoodSpec`; priors single-sourced
+  through the model's box bounds and the shared unconstrained<->box
+  transform in :mod:`fakepta_tpu.infer.model`) plus the host diagnostics
+  finishers over the drained accumulators.
+- :class:`SamplingRun` — the host facade: data -> Woodbury moments ->
+  Laplace warm start -> the segment loop (pipeline drains, donated
+  buffers, checkpoints, timeline, flight recorder, ``warm_start()`` AOT),
+  emitting a ``fakepta_tpu.sample/1`` artifact ``python -m fakepta_tpu.obs
+  compare``/``gate`` consume; CLI: ``python -m fakepta_tpu.sample run``.
+"""
+
+from .model import SAMPLE_SCHEMA, SampleSpec, as_spec, diagnostics
+from .run import SampleCheckpoint, SamplingRun
+
+__all__ = ["SAMPLE_SCHEMA", "SampleCheckpoint", "SampleSpec", "SamplingRun",
+           "as_spec", "diagnostics"]

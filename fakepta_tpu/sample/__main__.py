@@ -1,0 +1,8 @@
+"""``python -m fakepta_tpu.sample`` entry point."""
+
+import sys
+
+from .cli import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,979 @@
+"""SamplingRun: on-device batched MCMC over the Woodbury likelihood.
+
+The posterior-characterization lane (ROADMAP item 1): thousands of
+gradient-informed HMC chains x tempering rungs living entirely on device,
+with ZERO host round-trips inside the chain loop. The data side is staged
+once (host float64, the sanctioned one-off pattern): residuals reduce to
+per-pulsar Woodbury moments (``ops/woodbury.py`` — the same rank-2N algebra
+the grid lane amortizes), a Newton/Laplace fit of the posterior supplies
+both the chain warm start and the whitening preconditioner, and from then
+on every segment is ONE jitted ``lax.scan`` program — transitions, swap
+permutations, thinning and the R-hat/ESS/acceptance accumulators all on
+device. Thinned draws and accumulator snapshots drain through the async
+pipeline's writer thread exactly like chunk outputs (``parallel/pipeline``),
+with donated/recycled thinned-scratch buffers under the ``PackedLedger``
+depth bound (the state carry is deliberately NOT donated — see the ``seg``
+wrapper in :meth:`SamplingRun._get_programs`),
+timeline spans per SEGMENT (never per step), checkpoint/resume at segment
+boundaries, and ``warm_start()`` AOT support against the persistent compile
+cache.
+
+Bitwise reproducibility contract (tests/test_sample.py): per-step draws
+fold the GLOBAL chain index (the engine's realization-key convention),
+per-pulsar (lnL, grad) rows are computed with pulsar-local closed-form
+kernels (:func:`fakepta_tpu.ops.woodbury.lnlike_and_grad_phi`) and reduced
+in a FIXED order after one gather over 'psr' — the chain program's only
+collective — so thinned streams are bit-identical across mesh shapes,
+pipeline depths, and checkpoint resumes.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import json
+import threading
+from functools import partial
+from pathlib import Path
+from types import SimpleNamespace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from .. import obs
+from ..infer import model as infer_model
+from ..infer.model import (box_from_unconstrained, box_unconstrained_log_prior,
+                           box_unconstrained_log_prior_grad)
+from ..ops import mcmc, woodbury
+from ..parallel import pipeline as pipeline_mod
+from ..parallel.mesh import PSR_AXIS, REAL_AXIS, TOA_AXIS, to_host
+from ..parallel.montecarlo import _batch_specs
+from ..utils import rng as rng_utils
+from ..utils.compat import enable_x64, shard_map
+from .model import SAMPLE_SCHEMA, SAMPLE_TAG, SWAP_TAG, as_spec, diagnostics
+
+#: carry fields the checkpoint snapshot preserves (everything else —
+#: cached likelihood/prior values and gradients — is recomputed from ``z``
+#: by the refresh program, bit-identically, on resume)
+_SNAP_KEYS = ("z", "n", "npair", "prev_valid", "s1", "s2", "s11", "prev",
+              "accept", "swap", "swap_att", "divergent", "nonfinite")
+
+
+def _host_ctx():
+    """f64-on-CPU staging context for the one-off host precomputes."""
+    try:
+        cpu = jax.local_devices(backend="cpu")[0]
+    except RuntimeError:
+        cpu = None
+    stack = contextlib.ExitStack()
+    stack.enter_context(enable_x64())
+    if cpu is not None:
+        stack.enter_context(jax.default_device(cpu))
+    return stack
+
+
+class SampleCheckpoint:
+    """Append-only segment checkpoint for a sampling run.
+
+    ``<path>`` is the manifest (written last, atomically); thinned
+    post-warmup draws append as ``<path>.s<k>.npz`` and the carry snapshot
+    overwrites ``<path>.state.npz`` via rename. Because per-step keys fold
+    the ABSOLUTE step index, a resumed run reproduces the uninterrupted
+    chain bit-for-bit. All files are removed on successful completion.
+    """
+
+    def __init__(self, path):
+        self.path = Path(path)
+
+    def _seg_path(self, idx: int) -> Path:
+        return self.path.with_name(self.path.name + f".s{idx:05d}.npz")
+
+    def _state_path(self) -> Path:
+        return self.path.with_name(self.path.name + ".state.npz")
+
+    def save(self, ident: dict, done: int, snapshot: dict, thinned):
+        if thinned is not None:
+            np.savez(self._seg_path(done - 1), thinned=thinned)
+        tmp = self._state_path().with_suffix(".tmp.npz")
+        np.savez(tmp, **snapshot)
+        tmp.replace(self._state_path())
+        manifest = dict(ident, schema=SAMPLE_SCHEMA, done=int(done),
+                        kept=sorted(int(p.name.rsplit(".s", 1)[1][:5])
+                                    for p in self._glob_segs()))
+        tmp_m = self.path.with_suffix(self.path.suffix + ".tmp")
+        tmp_m.write_text(json.dumps(manifest))
+        tmp_m.replace(self.path)
+
+    def _glob_segs(self):
+        return self.path.parent.glob(
+            self.path.name + ".s" + "[0-9]" * 5 + ".npz")
+
+    def load(self, ident: dict):
+        if not self.path.exists():
+            return None
+        manifest = json.loads(self.path.read_text())
+        for k, v in ident.items():
+            if manifest.get(k) != v:
+                return None
+        snap = dict(np.load(self._state_path()))
+        thinned = [np.load(self._seg_path(i))["thinned"]
+                   for i in manifest["kept"]]
+        return {"done": int(manifest["done"]), "snapshot": snap,
+                "thinned": thinned}
+
+    def delete(self):
+        for p in list(self._glob_segs()) + [self._state_path(), self.path]:
+            try:
+                p.unlink()
+            except FileNotFoundError:
+                pass
+
+
+class SamplingRun:
+    """Batched-MCMC posterior study over a PulsarBatch.
+
+    ``spec`` is a :class:`~fakepta_tpu.sample.SampleSpec` (or a bare
+    :class:`~fakepta_tpu.infer.LikelihoodSpec` for the kernel defaults).
+    ``residuals`` is the (P, T) data vector; omit it and the facade
+    synthesizes self-consistent data from the model at ``truth`` (box
+    midpoints by default) — the posterior-validation configuration the
+    tests and the free-spectrum example run. ``mesh`` is the engine's
+    (real, psr[, toa]) mesh: chains shard over 'real', the per-pulsar
+    likelihood work over 'psr'.
+    """
+
+    def __init__(self, batch, spec, residuals=None, truth=None, mesh=None,
+                 data_seed=0, compile_cache_dir=None):
+        from ..parallel.mesh import make_mesh
+
+        pipeline_mod.configure_compile_cache(compile_cache_dir)
+        self.spec = as_spec(spec)
+        self.batch = batch
+        self.compiled = infer_model.build(self.spec.model, batch)
+        self.mesh = mesh if mesh is not None else make_mesh(
+            jax.devices()[:1])
+        self._n_real_shards = self.mesh.shape[REAL_AXIS]
+        n_psr_shards = self.mesh.shape[PSR_AXIS]
+        self._has_toa = TOA_AXIS in self.mesh.shape
+        if self.spec.n_chains % self._n_real_shards != 0:
+            raise ValueError(
+                f"n_chains={self.spec.n_chains} must be divisible by the "
+                f"real mesh axis ({self._n_real_shards})")
+        if batch.npsr % n_psr_shards != 0:
+            raise ValueError(
+                f"npsr={batch.npsr} must be divisible by the psr mesh axis "
+                f"({n_psr_shards}); pad the batch")
+        self._dtype = batch.t_own.dtype
+        self._ecorr_on = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+
+        if truth is None:
+            truth = self.compiled.theta_from_unit(
+                np.full(self.compiled.D, 0.5))
+        self.truth = np.asarray(truth, dtype=np.float64)
+        if self.truth.shape != (self.compiled.D,):
+            raise ValueError(f"truth must be a ({self.compiled.D},) vector "
+                             f"for {list(self.compiled.param_names)}")
+
+        # --- one-off host-f64 staging: data -> Woodbury moments -> Laplace
+        with _host_ctx():
+            self._nsb64 = self._f64_batch_views()
+        if residuals is None:
+            residuals = self._synthesize_data(data_seed)
+        residuals = np.asarray(residuals, dtype=np.float64)
+        if residuals.shape != np.asarray(batch.t_own).shape:
+            raise ValueError(f"residuals shape {residuals.shape} != batch "
+                             f"{np.asarray(batch.t_own).shape}")
+        self.residuals = residuals
+        self._mom64 = self._host_moments(residuals)
+        self._fit_laplace()
+
+        psr_sh = NamedSharding(self.mesh, P(PSR_AXIS))
+        self._mom_dev = tuple(
+            jax.device_put(np.asarray(m, dtype=self._dtype), psr_sh)
+            for m in self._mom64)
+        self._prog_cache: dict = {}
+        self._trace_counts: dict = {}
+        self.retraces = 0
+        self.last_report = None
+        self.last_result = None
+
+    # ------------------------------------------------------------------
+    # host-f64 staging (one-off; the sanctioned host-float64 layer)
+    # ------------------------------------------------------------------
+    def _f64_batch_views(self) -> SimpleNamespace:
+        """f64 views of the batch fields ``basis``/``phi`` read, so the
+        staging math runs at full precision whatever the batch dtype."""
+        b = self.batch
+        f64 = lambda x: jnp.asarray(np.asarray(x, dtype=np.float64))  # noqa: E731
+        return SimpleNamespace(
+            t_own=f64(b.t_own), t_common=f64(b.t_common),
+            freqs=f64(b.freqs), df_own=f64(b.df_own),
+            tspan_common=f64(b.tspan_common), red_psd=f64(b.red_psd),
+            dm_psd=f64(b.dm_psd), chrom_psd=f64(b.chrom_psd),
+            sys_psd=f64(b.sys_psd),
+            sys_mask=jnp.asarray(np.asarray(b.sys_mask)),
+            mask=jnp.asarray(np.asarray(b.mask)),
+            sigma2=f64(b.sigma2),
+            epoch_idx=jnp.asarray(np.asarray(b.epoch_idx)),
+            ecorr_amp=f64(b.ecorr_amp))
+
+    def _synthesize_data(self, data_seed) -> np.ndarray:
+        """Self-consistent synthetic residuals drawn FROM the model at the
+        truth point: white (+ ECORR epoch offsets) plus the model's GP
+        components with prior variance ``phi(truth)`` — the generative
+        process the likelihood marginalizes, so the posterior is exactly
+        calibrated (the R-hat/recovery acceptance configuration)."""
+        rng = rng_utils.KeyStream(data_seed, "sample_data").host_rng()
+        with _host_ctx():
+            basis = np.asarray(self.compiled.basis(self._nsb64))
+            phi = np.asarray(self.compiled.phi(
+                jnp.asarray(self.truth), self._nsb64))
+        coef = rng.standard_normal(phi.shape) * np.sqrt(phi)
+        res = np.einsum("ptm,pm->pt", basis, coef)
+        sigma2 = np.asarray(self.batch.sigma2, dtype=np.float64)
+        res += rng.standard_normal(sigma2.shape) * np.sqrt(sigma2)
+        if self._ecorr_on:
+            amp = np.asarray(self.batch.ecorr_amp, dtype=np.float64)
+            idx = np.asarray(self.batch.epoch_idx)
+            eps = rng.standard_normal(amp.shape)
+            res += amp * np.take_along_axis(eps, idx, axis=1)
+        return res * np.asarray(self.batch.mask)
+
+    def _host_moments(self, residuals):
+        """Per-pulsar Woodbury moments of the ONE data vector, host f64.
+
+        Computed unsharded in one fixed order so the staged moments are
+        identical on every mesh — the chain loop then only ever consumes
+        bit-identical inputs (mesh invariance starts here)."""
+        num_ep = self.batch.max_toa if self._ecorr_on else 0
+        with _host_ctx():
+            nsb = self._nsb64
+            tmat = self.compiled.basis(nsb)
+
+            def fparts(t, s2, m, e, a):
+                return woodbury.fixed_parts(t, s2, m, e, a,
+                                            num_epochs=num_ep)
+
+            def rparts(r, t, s2, m, e, a):
+                return woodbury.res_parts(r, t, s2, m, e, a,
+                                          num_epochs=num_ep)
+
+            fixed = jax.vmap(fparts)(tmat, nsb.sigma2, nsb.mask,
+                                     nsb.epoch_idx, nsb.ecorr_amp)
+            resp = jax.vmap(rparts)(jnp.asarray(residuals), tmat,
+                                    nsb.sigma2, nsb.mask, nsb.epoch_idx,
+                                    nsb.ecorr_amp)
+            m, lndet, nv, corr = jax.vmap(woodbury.finish_fixed)(fixed)
+            if corr is None:
+                d0, dt = jax.vmap(lambda rp: woodbury.finish_res(rp))(resp)
+            else:
+                d0, dt = jax.vmap(woodbury.finish_res)(resp, corr)
+            return tuple(np.asarray(x) for x in (m, lndet, nv, d0, dt))
+
+    def _lnpost64(self, v):
+        """f64 unconstrained log posterior (the warm-start objective)."""
+        with _host_ctx():
+            bounds = jnp.asarray(self.compiled.bounds)
+            mom = tuple(jnp.asarray(x) for x in self._mom64)
+            m, lndet, nv, d0, dt = mom
+            theta = box_from_unconstrained(jnp.asarray(v, jnp.float64),
+                                           bounds)
+            phi = self.compiled.phi(theta, self._nsb64)
+            lnl = jnp.sum(jax.vmap(woodbury.lnlike_from_moments)(
+                d0, dt, m, lndet, nv, phi))
+            return lnl + box_unconstrained_log_prior(
+                jnp.asarray(v, jnp.float64))
+
+    def lnpost_unconstrained(self, v) -> float:
+        """Public f64 handle on the warm-start objective (tests pin its
+        gradient against finite differences)."""
+        return float(self._lnpost64(v))
+
+    def lnpost_grad(self, v) -> np.ndarray:
+        with _host_ctx():
+            return np.asarray(jax.grad(self._lnpost64)(
+                jnp.asarray(v, jnp.float64)))
+
+    def _fit_laplace(self, max_iter: int = 60):
+        """Damped-Newton mode fit + Laplace factor — the Hessian-lane warm
+        start: chains initialize at ``mode + C z, z ~ N(0, I)`` and the HMC
+        kernel runs in the C-whitened space (C C^T = (-H)^{-1}), so a
+        near-Gaussian posterior is near-isotropic for the integrator."""
+        d = self.compiled.D
+        with _host_ctx():
+            grad_fn = jax.grad(self._lnpost64)
+            hess_fn = jax.hessian(self._lnpost64)
+            v = np.zeros(d)
+            f = float(self._lnpost64(v))
+            for _ in range(max_iter):
+                g = np.asarray(grad_fn(v))
+                h = np.asarray(hess_fn(v))
+                a = -h
+                ridge = 1e-10 * max(float(np.trace(a)) / d, 1.0)
+                while True:
+                    try:
+                        np.linalg.cholesky(a + ridge * np.eye(d))
+                        break
+                    except np.linalg.LinAlgError:
+                        ridge *= 10.0
+                delta = np.linalg.solve(a + ridge * np.eye(d), g)
+                step = 1.0
+                for _ in range(30):
+                    f_new = float(self._lnpost64(v + step * delta))
+                    if np.isfinite(f_new) and f_new >= f:
+                        break
+                    step *= 0.5
+                v = v + step * delta
+                moved = float(np.linalg.norm(step * delta))
+                converged = abs(f_new - f) <= 1e-9 * (1.0 + abs(f))
+                f = f_new
+                if converged and moved < 1e-6:
+                    break
+            h = np.asarray(hess_fn(v))
+            a = -h
+            ridge = 0.0
+            while True:
+                try:
+                    chol_a = np.linalg.cholesky(
+                        a + (ridge * np.eye(d) if ridge else 0.0))
+                    break
+                except np.linalg.LinAlgError:
+                    ridge = max(ridge * 10.0, 1e-8 * abs(np.trace(a)) / d)
+            from jax.scipy.linalg import solve_triangular
+            linv = np.asarray(solve_triangular(
+                jnp.asarray(chol_a), jnp.eye(d, dtype=jnp.float64),
+                lower=True))
+        self.mode_v = v                        # (D,) unconstrained mode
+        self.chol_cov = linv.T                 # C with C C^T = (-H)^{-1}
+        self.mode_theta = np.asarray(
+            self.compiled.theta_from_unit(1 / (1 + np.exp(-v))))
+
+    # ------------------------------------------------------------------
+    # the chain program (one jitted segment; zero host syncs inside)
+    # ------------------------------------------------------------------
+    def _note_trace(self, signature) -> None:
+        """Retrace guard (trace-time only, montecarlo._obs_note_trace)."""
+        n = self._trace_counts.get(signature, 0) + 1
+        self._trace_counts[signature] = n
+        obs.count("obs.traces")
+        if n > 1:
+            self.retraces += 1
+            obs.count("obs.retraces")
+
+    def _state_specs(self):
+        r, rep = P(REAL_AXIS), P()
+        return dict(z=r, lnl=r, glnl=r, lnpri=r, glnpri=r,
+                    n=rep, npair=rep, prev_valid=rep,
+                    s1=r, s2=r, s11=r, prev=r,
+                    accept=rep, swap=rep, swap_att=rep,
+                    divergent=rep, nonfinite=rep)
+
+    def _get_programs(self, seg_steps: int, warmup: int):
+        key = (int(seg_steps), int(warmup))
+        hit = self._prog_cache.get(key)
+        if hit is not None:
+            return hit
+        spec, compiled, mesh = self.spec, self.compiled, self.mesh
+        dtype = self._dtype
+        d, t_count = compiled.D, spec.n_temps
+        thin, n_leap = spec.thin, spec.n_leapfrog
+        swap_every, max_dh = spec.swap_every, spec.max_energy_error
+        n_out = seg_steps // thin
+        n_psr_shards = mesh.shape[PSR_AXIS]
+        betas = mcmc.geometric_betas(t_count, spec.max_temp, dtype)
+        eps = jnp.asarray(spec.step_size, dtype) / jnp.sqrt(betas)
+        bounds = jnp.asarray(compiled.bounds, dtype)
+        mode_v = jnp.asarray(self.mode_v, dtype)
+        chol_cov_t = jnp.asarray(self.chol_cov.T, dtype)    # z @ C^T
+        chol_cov = jnp.asarray(self.chol_cov, dtype)        # g_v @ C
+        t_idx = jnp.arange(t_count)
+        state_specs = self._state_specs()
+        mom_specs = tuple(P(PSR_AXIS) for _ in range(5))
+        batch_specs = _batch_specs(self._has_toa)
+
+        def vg_factory(moments, batch):
+            m_l, lndet_l, nv_l, d0_l, dt_l = moments
+            p_local = m_l.shape[0]
+            off = lax.axis_index(PSR_AXIS) * p_local
+
+            def vg(zz):
+                """(C, T, D) z -> (lnl, glnl, lnpri, glnpri).
+
+                Per-pulsar (lnL, grad) rows are closed-form and
+                pulsar-local; the ONLY collective is the gather over
+                'psr', after which the reduction runs in a fixed order —
+                bitwise identical on every mesh shape (the chain loop's
+                whole reproducibility story; see module docstring)."""
+                v = mode_v + zz @ chol_cov_t
+                lnpri = box_unconstrained_log_prior(v)
+                glnpri = box_unconstrained_log_prior_grad(v) @ chol_cov
+                flat_v = v.reshape(-1, d)
+
+                def phi_of(vv):
+                    th = box_from_unconstrained(vv, bounds)
+                    return compiled.phi(th, batch, off)
+
+                with obs.span("sample_phi"):
+                    phi = jax.vmap(phi_of)(flat_v)
+                    dphi = jax.vmap(jax.jacfwd(phi_of))(flat_v)
+                with obs.span("sample_lnl"):
+                    lnl_p, gphi = jax.vmap(lambda ph: jax.vmap(
+                        woodbury.lnlike_and_grad_phi)(
+                            m_l, ph, d0_l, dt_l, lndet_l, nv_l))(phi)
+                    grow = jnp.einsum("xpm,xpmd->xpd", gphi, dphi)
+                if n_psr_shards > 1:
+                    lnl_rows = lax.all_gather(lnl_p, PSR_AXIS, axis=1,
+                                              tiled=True)
+                    grad_rows = lax.all_gather(grow, PSR_AXIS, axis=1,
+                                               tiled=True)
+                else:
+                    lnl_rows, grad_rows = lnl_p, grow
+                lnl = jnp.sum(lnl_rows, axis=1).reshape(zz.shape[:-1])
+                glnl = (jnp.sum(grad_rows, axis=1) @ chol_cov).reshape(
+                    zz.shape)
+                return (lnl, glnl, lnpri, glnpri)
+
+            return vg
+
+        def sharded(state, moments, batch, base_key, seg_start):
+            vg = vg_factory(moments, batch)
+            kl = state["z"].shape[0]
+            cg = lax.axis_index(REAL_AXIS) * kl + jnp.arange(kl)
+
+            def mcmc_step(carry, abs_step):
+                z, parts, inc = carry
+                sk = jax.random.fold_in(
+                    jax.random.fold_in(base_key, SAMPLE_TAG), abs_step)
+                keys = jax.vmap(lambda g: jax.vmap(
+                    lambda tt: jax.random.fold_in(
+                        jax.random.fold_in(sk, g), tt))(t_idx))(cg)
+                z, parts, acc, div = mcmc.hmc_transition(
+                    keys, z, parts, vg, betas, eps, n_leap, max_dh)
+                inc = dict(
+                    inc,
+                    accept=inc["accept"] + jnp.sum(
+                        acc, axis=0, dtype=jnp.int32),
+                    divergent=inc["divergent"] + jnp.sum(
+                        div, dtype=jnp.int32),
+                    nonfinite=inc["nonfinite"] + jnp.sum(
+                        ~jnp.isfinite(parts[0]), dtype=jnp.int32))
+                if t_count > 1:
+                    with obs.span("sample_swap"):
+                        do_swap = (abs_step % swap_every) == (swap_every - 1)
+                        parity = (abs_step // swap_every) % 2
+                        skeys = jax.vmap(lambda g: jax.random.fold_in(
+                            jax.random.fold_in(sk, SWAP_TAG), g))(cg)
+                        perm = mcmc.swap_permutation(skeys, parts[0], betas,
+                                                     parity)
+                        ident = jnp.broadcast_to(t_idx[None], perm.shape)
+                        perm = jnp.where(do_swap, perm, ident)
+                        z, *parts = mcmc.apply_permutation(perm, z, *parts)
+                        parts = tuple(parts)
+                        inc = dict(
+                            inc,
+                            swap=inc["swap"] + jnp.sum(
+                                perm == (t_idx[None] + 1), axis=0,
+                                dtype=jnp.int32),
+                            swap_att=inc["swap_att"] + jnp.where(
+                                do_swap & ((t_idx % 2) == parity)
+                                & (t_idx < t_count - 1),
+                                jnp.int32(kl), jnp.int32(0)))
+                return (z, parts, inc), None
+
+            def emit(carry, j):
+                z, parts, inc, acc = carry
+                steps = seg_start + j * thin + jnp.arange(thin)
+                (z, parts, inc), _ = lax.scan(mcmc_step, (z, parts, inc),
+                                              steps)
+                v = mode_v + z[:, 0, :] @ chol_cov_t
+                theta = box_from_unconstrained(v, bounds)      # (kl, D)
+                post = steps[-1] >= warmup
+                wi = post.astype(jnp.int32)
+                wf = post.astype(dtype)
+                pair_w = wf * acc["prev_valid"]
+                acc = dict(
+                    n=acc["n"] + wi,
+                    npair=acc["npair"]
+                    + (pair_w > 0).astype(jnp.int32),
+                    s1=acc["s1"] + wf * theta,
+                    s2=acc["s2"] + wf * theta * theta,
+                    s11=acc["s11"] + pair_w * theta * acc["prev"],
+                    prev=jnp.where(post, theta, acc["prev"]),
+                    prev_valid=jnp.maximum(acc["prev_valid"], wf))
+                return (z, parts, inc, acc), theta
+
+            parts = (state["lnl"], state["glnl"], state["lnpri"],
+                     state["glnpri"])
+            inc0 = dict(accept=jnp.zeros((t_count,), jnp.int32),
+                        swap=jnp.zeros((t_count,), jnp.int32),
+                        swap_att=jnp.zeros((t_count,), jnp.int32),
+                        divergent=jnp.zeros((), jnp.int32),
+                        nonfinite=jnp.zeros((), jnp.int32))
+            acc0 = {k: state[k] for k in ("n", "npair", "prev_valid", "s1",
+                                          "s2", "s11", "prev")}
+            (z, parts, inc, acc), thinned = lax.scan(
+                emit, (state["z"], parts, inc0, acc0), jnp.arange(n_out))
+            # cross-chain reduction of the counter increments: one psum
+            # over 'real' per SEGMENT (not per step)
+            inc = jax.tree_util.tree_map(
+                lambda x: lax.psum(x, REAL_AXIS), inc)
+            new_state = dict(
+                z=z, lnl=parts[0], glnl=parts[1], lnpri=parts[2],
+                glnpri=parts[3], **acc,
+                accept=state["accept"] + inc["accept"],
+                swap=state["swap"] + inc["swap"],
+                swap_att=state["swap_att"] + inc["swap_att"],
+                divergent=state["divergent"] + inc["divergent"],
+                nonfinite=state["nonfinite"] + inc["nonfinite"])
+            snapshot = {k: new_state[k] for k in _SNAP_KEYS}
+            return new_state, thinned, snapshot
+
+        snap_specs = {k: state_specs[k] for k in _SNAP_KEYS}
+        shmapped = shard_map(
+            sharded, mesh=mesh,
+            in_specs=(state_specs, mom_specs, batch_specs, P(), P()),
+            out_specs=(state_specs, P(None, REAL_AXIS), snap_specs),
+            # the gathered likelihood rows are summed to values that are
+            # replicated over 'psr'/'toa' by construction (fixed-order
+            # reduction of identical rows); vma cannot see that, so the
+            # check is disabled like the engine's pallas paths
+            check_vma=False,
+        )
+
+        # the thinned-output scratch is donated: each drained thinned
+        # buffer is recycled as a later dispatch's scratch, so peak HBM
+        # holds `depth` thinned buffers (PackedLedger asserts this at
+        # runtime). The STATE CARRY is deliberately NOT donated: the
+        # snapshot outputs are value-identical to carry entries, so XLA
+        # CSEs them into the SAME output buffers — donating the carry on
+        # the next dispatch would let XLA overwrite buffers the writer
+        # thread is still checkpointing (observed as silent accumulator
+        # corruption and crashes on multi-device meshes). The carry is
+        # KB-scale, so keeping both generations live costs nothing.
+        @partial(jax.jit, donate_argnums=(3,), keep_unused=True)
+        def seg(base_key, seg_start, state, scratch):
+            # trace-time only: the retrace guard
+            self._note_trace(("sample_seg", seg_steps, warmup,
+                              scratch is not None))
+            return shmapped(state, self._mom_dev, self.batch, base_key,
+                            seg_start)
+
+        def refresh_sharded(z, moments, batch):
+            vg = vg_factory(moments, batch)
+            lnl, glnl, lnpri, glnpri = vg(z)
+            return dict(lnl=lnl, glnl=glnl, lnpri=lnpri, glnpri=glnpri)
+
+        refresh_sh = shard_map(
+            refresh_sharded, mesh=mesh,
+            in_specs=(P(REAL_AXIS), mom_specs, batch_specs),
+            out_specs={k: P(REAL_AXIS) for k in ("lnl", "glnl", "lnpri",
+                                                 "glnpri")},
+            check_vma=False,
+        )
+
+        @jax.jit
+        def refresh(z):
+            self._note_trace(("sample_refresh",))
+            return refresh_sh(z, self._mom_dev, self.batch)
+
+        self._prog_cache[key] = (seg, refresh)
+        return seg, refresh
+
+    # ------------------------------------------------------------------
+    # state construction / resume
+    # ------------------------------------------------------------------
+    def _state_shardings(self):
+        return {k: NamedSharding(self.mesh, s)
+                for k, s in self._state_specs().items()}
+
+    def _zero_accum_host(self):
+        spec, d = self.spec, self.compiled.D
+        k, t = spec.n_chains, spec.n_temps
+        dt = np.dtype(self._dtype)
+        return dict(n=np.zeros((), np.int32), npair=np.zeros((), np.int32),
+                    prev_valid=np.zeros((), dt),
+                    s1=np.zeros((k, d), dt), s2=np.zeros((k, d), dt),
+                    s11=np.zeros((k, d), dt), prev=np.zeros((k, d), dt),
+                    accept=np.zeros((t,), np.int32),
+                    swap=np.zeros((t,), np.int32),
+                    swap_att=np.zeros((t,), np.int32),
+                    divergent=np.zeros((), np.int32),
+                    nonfinite=np.zeros((), np.int32))
+
+    def _init_state(self, seed, refresh, snapshot=None):
+        """Device state from the Laplace warm start (or a checkpoint
+        snapshot): z is host-staged — identical on every mesh — and the
+        cached likelihood parts are recomputed on device by the refresh
+        program, so a resume reproduces the carry bit-for-bit."""
+        spec, d = self.spec, self.compiled.D
+        k, t = spec.n_chains, spec.n_temps
+        if snapshot is None:
+            rng = rng_utils.KeyStream(seed, "sample_init").host_rng()
+            host = dict(self._zero_accum_host(),
+                        z=rng.standard_normal((k, t, d)).astype(self._dtype))
+        else:
+            host = {k2: np.asarray(v) for k2, v in snapshot.items()}
+        shardings = self._state_shardings()
+        state = {k2: jax.device_put(v, shardings[k2])
+                 for k2, v in host.items()}
+        state.update(refresh(state["z"]))
+        return state
+
+    # ------------------------------------------------------------------
+    # the run loop (mirrors EnsembleSimulator.run's pipeline structure)
+    # ------------------------------------------------------------------
+    def _normalize(self, n_steps: int, segment):
+        thin = self.spec.thin
+        if segment is None:
+            segment = min(max(n_steps, thin), 256)
+        segment = max(int(segment), thin)
+        segment += (-segment) % thin
+        warmup = self.spec.warmup
+        warmup_n = ((warmup + segment - 1) // segment) * segment \
+            if warmup else 0
+        post_n = ((int(n_steps) + segment - 1) // segment) * segment
+        return segment, warmup_n, post_n
+
+    def warm_start(self, n_steps: int = 256, segment=None) -> float:
+        """AOT-compile the segment executable (shapes, donation aliasing
+        and all) into the persistent compile cache ahead of ``run()``."""
+        t0 = obs.now()
+        segment, warmup_n, _ = self._normalize(n_steps, segment)
+        seg_fn, _refresh = self._get_programs(segment, warmup_n)
+        shardings = self._state_shardings()
+        spec, d = self.spec, self.compiled.D
+        k, t = spec.n_chains, spec.n_temps
+        dt = np.dtype(self._dtype)
+
+        def sds(arr_shape, dtype, name):
+            return jax.ShapeDtypeStruct(arr_shape, dtype,
+                                        sharding=shardings[name])
+
+        state = dict(
+            z=sds((k, t, d), dt, "z"), lnl=sds((k, t), dt, "lnl"),
+            glnl=sds((k, t, d), dt, "glnl"), lnpri=sds((k, t), dt, "lnpri"),
+            glnpri=sds((k, t, d), dt, "glnpri"),
+            n=sds((), np.int32, "n"), npair=sds((), np.int32, "npair"),
+            prev_valid=sds((), dt, "prev_valid"),
+            s1=sds((k, d), dt, "s1"), s2=sds((k, d), dt, "s2"),
+            s11=sds((k, d), dt, "s11"), prev=sds((k, d), dt, "prev"),
+            accept=sds((t,), np.int32, "accept"),
+            swap=sds((t,), np.int32, "swap"),
+            swap_att=sds((t,), np.int32, "swap_att"),
+            divergent=sds((), np.int32, "divergent"),
+            nonfinite=sds((), np.int32, "nonfinite"))
+        scratch = jax.ShapeDtypeStruct(
+            (segment // spec.thin, k, d), dt,
+            sharding=NamedSharding(self.mesh, P(None, REAL_AXIS)))
+        seg_fn.lower(rng_utils.as_key(0), jnp.int32(0), state,
+                     scratch).compile()
+        return obs.now() - t0
+
+    def _drain_segment(self, thinned, snapshot, rec, out, slot, ckpt,
+                       ident, done_segments, is_post, materialize, ev,
+                       t_run0, timeline, progress, done_steps, total_steps):
+        """Writer-thread completion work for ONE segment (the analog of
+        montecarlo._drain_chunk): materialize the thinned buffer so its
+        device storage stays donatable, guard against NaN chains (a
+        nan-lnL abort surfaces through the flight recorder), append the
+        checkpoint, tick progress. Never called from inside the dispatch
+        loop's device path."""
+        idx = rec["idx"]
+        t_d0 = obs.now()
+        t_ready = None
+        try:
+            if materialize == "donatable":
+                arr = pipeline_mod.materialize_copy(thinned)
+            else:
+                arr = np.array(to_host(thinned))
+            t_ready = obs.now()
+            if not np.all(np.isfinite(arr)):
+                obs.flightrec.note("nan_lnl_abort", segment=idx)
+                raise FloatingPointError(
+                    f"sampling segment {idx} produced non-finite chain "
+                    f"draws (nan-lnL); see the flight-recorder dump")
+            out[slot] = arr if is_post else None
+            if ckpt is not None and jax.process_index() == 0:
+                t_ck = obs.now()
+                snap_h = {k: np.asarray(to_host(v))
+                          for k, v in snapshot.items()}
+                ckpt.save(ident, done_segments, snap_h,
+                          arr if is_post else None)
+                rec["ckpt_wait_s"] = obs.now() - t_ck
+                timeline.append({"name": "ckpt_append", "tid": "writer",
+                                 "t0": t_ck - t_run0,
+                                 "dur": rec["ckpt_wait_s"], "chunk": idx})
+            if progress is not None:
+                progress(min(done_steps, total_steps), total_steps)
+            obs.flightrec.note("segment_drained", idx=idx)
+        finally:
+            t_end = obs.now()
+            if t_ready is not None and "t0_s" in rec:
+                rec["t_ready_s"] = t_ready - t_run0
+                timeline.append(
+                    {"name": "execute", "tid": "device", "t0": rec["t0_s"],
+                     "dur": max(t_ready - t_run0 - rec["t0_s"], 0.0),
+                     "chunk": idx})
+            timeline.append({"name": "drain", "tid": "writer",
+                             "t0": t_d0 - t_run0, "dur": t_end - t_d0,
+                             "chunk": idx})
+            ev.set()
+
+    def run(self, n_steps: int, seed=0, segment=None, checkpoint=None,
+            pipeline_depth: int = 2, progress=None, eventlog=None) -> dict:
+        """Run ``n_steps`` post-warmup MCMC steps (plus the spec's warmup).
+
+        The chain loop dispatches one jitted SEGMENT program at a time —
+        ``segment`` steps of HMC + tempering + thinning + accumulator
+        updates per dispatch, zero host syncs inside — and drains thinned
+        draws/snapshots through the async writer thread
+        (``pipeline_depth`` in-flight segments, donated-buffer recycling,
+        serial fallback at 0 / multi-process). ``checkpoint`` enables
+        segment-boundary resume that reproduces the uninterrupted chains
+        bit-for-bit. Returns ``theta`` (S, K, D) thinned post-warmup
+        draws, the diagnostics dict (R-hat / ESS / acceptance from the
+        on-device accumulators), a flat ``summary`` and the ``report``
+        RunReport (timeline, HBM watermark, flight-recorder integration —
+        everything ``obs compare``/``gate`` consume).
+        """
+        t_run0 = obs.now()
+        obs.subscribe_jax_monitoring()
+        collector = obs.Collector()
+        retraces_before = self.retraces
+        spec, compiled = self.spec, self.compiled
+        k, t_count, d = spec.n_chains, spec.n_temps, compiled.D
+        segment, warmup_n, post_n = self._normalize(n_steps, segment)
+        total_steps = warmup_n + post_n
+        n_segments = total_steps // segment
+        warm_segments = warmup_n // segment
+        n_out = segment // spec.thin
+        base = rng_utils.as_key(seed)
+        seg_fn, refresh = self._get_programs(segment, warmup_n)
+
+        ident = {"seed": int(seed) if isinstance(seed, (int, np.integer))
+                 else None, "n_chains": k, "n_temps": t_count, "d": d,
+                 "segment": segment, "warmup": warmup_n,
+                 "total_steps": total_steps, "thin": spec.thin}
+        ckpt = None
+        done_segments = 0
+        out: list = []
+        snapshot0 = None
+        if checkpoint is not None:
+            if not isinstance(seed, (int, np.integer)):
+                raise TypeError("checkpointing requires an integer seed")
+            ckpt = SampleCheckpoint(checkpoint)
+            resume = ckpt.load(ident)
+            if resume is not None:
+                done_segments = resume["done"]
+                snapshot0 = resume["snapshot"]
+                out = list(resume["thinned"])
+        state = self._init_state(seed, refresh, snapshot0)
+
+        depth = max(int(pipeline_depth), 0)
+        pipelined = depth > 0 and jax.process_count() == 1
+        ring: collections.deque = collections.deque()
+        ring_size = max(depth, 1)
+        scratch_sharding = NamedSharding(self.mesh, P(None, REAL_AXIS))
+        dt = np.dtype(self._dtype)
+
+        meta = {
+            "kind": "sample",
+            # chain transitions play the role of realizations in the
+            # report's throughput derivations (steps x chains x rungs)
+            "nreal": int(total_steps * k * t_count),
+            "chunk": int(segment * k * t_count),
+            "platform": self.mesh.devices.flat[0].platform,
+            "n_devices": int(self.mesh.devices.size),
+            "mesh_shape": {a: int(v) for a, v in self.mesh.shape.items()},
+            "npsr": int(self.batch.npsr),
+            "pipeline_depth": int(depth if pipelined else 0),
+            "process_index": int(jax.process_index()),
+            "process_count": int(jax.process_count()),
+            "sample": {"k": k, "t": t_count, "d": d,
+                       "steps": int(total_steps), "warmup": int(warmup_n),
+                       "thin": int(spec.thin), "segment": int(segment),
+                       "n_leapfrog": int(spec.n_leapfrog),
+                       "step_size": float(spec.step_size),
+                       "params": list(compiled.param_names)},
+        }
+        if isinstance(seed, (int, np.integer)):
+            meta["seed"] = int(seed)
+
+        timeline: list = []
+        seg_records: list = []
+        ledger = obs.memwatch.PackedLedger(
+            int(n_out) * k * d * dt.itemsize, ring_size, pipelined,
+            self._n_real_shards)
+        sampler = obs.memwatch.HbmSampler(self.mesh.devices.flat)
+        sampler.start()
+        obs.flightrec.note(
+            "run_start", spec_hash=obs.flightrec.spec_hash(meta),
+            steps=int(total_steps), segment=int(segment),
+            depth=int(depth if pipelined else 0),
+            resume_done=int(done_segments))
+        writer = pipeline_mod.make_writer(pipelined)
+        try:
+            with obs.collect(collector):
+                for seg_idx in range(done_segments, n_segments):
+                    t_seg0 = obs.now()
+                    rec = {"idx": seg_idx, "wall_s": 0.0, "stall_s": 0.0,
+                           "ckpt_wait_s": 0.0,
+                           "synced": bool(not pipelined
+                                          and (ckpt is not None
+                                               or progress is not None))}
+                    rec["t0_s"] = t_seg0 - t_run0
+                    scratch = None
+                    recycled_from = None
+                    if pipelined:
+                        if len(ring) >= ring_size:
+                            prev_buf, ev = ring.popleft()
+                            t_wait = obs.now()
+                            ev.wait()
+                            t_now = obs.now()
+                            rec["stall_s"] += t_now - t_wait
+                            timeline.append(
+                                {"name": "stall", "tid": "main",
+                                 "t0": t_wait - t_run0,
+                                 "dur": t_now - t_wait, "chunk": seg_idx})
+                            scratch = prev_buf
+                            recycled_from = seg_idx - ring_size
+                        else:
+                            scratch = jax.device_put(
+                                np.zeros((n_out, k, d), dt),
+                                scratch_sharding)
+                            ledger.alloc()
+                    state, thinned, snapshot = seg_fn(
+                        base, jnp.int32(seg_idx * segment), state, scratch)
+                    obs.flightrec.note("segment_dispatch", idx=seg_idx,
+                                       step=seg_idx * segment)
+                    if recycled_from is not None:
+                        ledger.recycle(bool(scratch.is_deleted()))
+                        timeline.append(
+                            {"name": "recycle", "tid": "main",
+                             "t0": obs.now() - t_run0, "dur": None,
+                             "chunk": seg_idx, "from_chunk": recycled_from})
+                    rec["live_packed"] = ledger.live_buffers
+                    collector.count("pipeline.d2h_async",
+                                    pipeline_mod.start_d2h(thinned))
+                    done_steps = (seg_idx + 1) * segment
+                    slot = len(out)
+                    out.append(None)
+                    ev = threading.Event()
+                    drain = partial(
+                        self._drain_segment, thinned, snapshot, rec, out,
+                        slot, ckpt, ident, seg_idx + 1,
+                        seg_idx >= warm_segments,
+                        "donatable" if pipelined else True, ev, t_run0,
+                        timeline, progress, done_steps, total_steps)
+                    if pipelined:
+                        rec["stall_s"] += writer.submit(drain, ev.set)
+                        ring.append((thinned, ev))
+                    else:
+                        writer.submit(drain)
+                    rec["wall_s"] = obs.now() - t_seg0
+                    timeline.append({"name": "dispatch", "tid": "main",
+                                     "t0": rec["t0_s"],
+                                     "dur": rec["wall_s"],
+                                     "chunk": seg_idx})
+                    seg_records.append(rec)
+                writer.close()
+                ledger.check()
+                t_f0 = obs.now()
+                state_h = {k2: np.asarray(to_host(v))
+                           for k2, v in state.items()
+                           if k2 in _SNAP_KEYS}
+                timeline.append({"name": "final_fetch", "tid": "main",
+                                 "t0": t_f0 - t_run0,
+                                 "dur": obs.now() - t_f0})
+        except BaseException as exc:
+            writer.abort()
+            sampler.stop()
+            obs.flightrec.note("run_abort", error=repr(exc)[:500])
+            rec_dir = obs.flightrec.dump_dir(checkpoint)
+            if rec_dir is not None:
+                obs.flightrec.dump(rec_dir, meta, chunks=seg_records,
+                                   error=repr(exc)[:500],
+                                   process_index=int(jax.process_index()))
+            raise
+        total_s = obs.now() - t_run0
+        obs.flightrec.note("run_end", total_s=round(total_s, 3))
+
+        kept = [a for a in out if a is not None]
+        theta = (np.concatenate(kept, axis=0) if kept
+                 else np.zeros((0, k, d), dt))
+        diag = diagnostics(state_h, k, t_count, total_steps)
+        if diag["divergences"] > 0:
+            obs.flightrec.note("chain_divergences",
+                               count=int(diag["divergences"]))
+        n_dev = max(int(self.mesh.devices.size), 1)
+        summary = {
+            "rhat_max": round(diag.get("rhat_max", float("nan")), 5),
+            "ess_min": round(diag.get("ess_min", 0.0), 2),
+            "ess_per_s_per_chip": round(
+                diag.get("ess_min", 0.0) / total_s / n_dev, 3),
+            "sample_steps_per_s_per_chip": round(
+                total_steps * k * t_count / total_s / n_dev, 2),
+            "accept_rate": round(diag["accept_rate"], 4),
+            "divergences": diag["divergences"],
+            "nonfinite_lnl": diag["nonfinite_lnl"],
+        }
+        if "swap_rate" in diag:
+            summary["swap_rate"] = round(diag["swap_rate"], 4)
+
+        if ckpt is not None and jax.process_index() == 0:
+            ckpt.delete()
+
+        from ..obs import RunReport
+        collector.count("obs.chunks", len(seg_records))
+        memory = sampler.stop()
+        memory.update(ledger.memory_fields())
+        if memory.get("peak_bytes_in_use"):
+            memory["peak_hbm_bytes"] = memory["peak_bytes_in_use"]
+            memory["peak_hbm_source"] = "allocator"
+        meta["extra_metrics"] = dict(summary)
+        report = RunReport.from_collector(
+            collector, meta, retraces=self.retraces - retraces_before,
+            total_s=total_s, memory=memory)
+        report.chunks = seg_records
+        report.spans = sorted(set(collector.spans))
+        report.timeline = sorted(timeline, key=lambda e: e.get("t0", 0.0))
+        self.last_report = report
+        if eventlog is not None:
+            shard_dir = Path(eventlog)
+            shard_dir.mkdir(parents=True, exist_ok=True)
+            report.save(shard_dir /
+                        f"events-p{int(jax.process_index()):03d}.jsonl")
+
+        result = {
+            "schema": SAMPLE_SCHEMA,
+            "theta": theta,
+            "param_names": list(compiled.param_names),
+            "bounds": np.asarray(compiled.bounds),
+            "truth": np.asarray(self.truth),
+            "mode_theta": np.asarray(self.mode_theta),
+            "betas": float(spec.max_temp) ** -(
+                np.arange(t_count, dtype=np.float64)
+                / max(t_count - 1, 1)),
+            "diag": diag,
+            "summary": summary,
+            "report": report,
+        }
+        self.last_result = result
+        return result
+
+    def save(self, path, result=None) -> str:
+        """Write the run's summary artifact (obs JSON-lines framing with
+        the ``fakepta_tpu.sample/1`` payload schema) — diffable with
+        ``python -m fakepta_tpu.obs compare`` and gateable with ``obs
+        gate`` (ESS/throughput higher-better, rhat_max lower-better)."""
+        result = result if result is not None else self.last_result
+        if result is None:
+            raise ValueError("run() the sampler before saving its artifact")
+        report = result["report"]
+        report.meta["sample_schema"] = SAMPLE_SCHEMA
+        report.meta["extra_metrics"] = dict(result["summary"])
+        return report.save(path)

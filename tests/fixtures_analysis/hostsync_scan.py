@@ -1,0 +1,36 @@
+"""Seeded chain-loop host syncs (the host-sync-in-jit scan-body clause)."""
+import jax
+import numpy as np
+from jax import lax
+
+from fakepta_tpu.parallel.mesh import to_host
+
+
+def chain_loop(state, steps):
+    def transition(carry, step):
+        z, lnl = carry
+        z = z + 0.1
+        to_host(lnl)                     # line 13: fetch per MCMC step
+        jax.block_until_ready(z)         # line 14: sync per step
+        eps = float(lnl)                 # line 15: trace-time host cast
+        np.asarray(z)                    # line 16: host materialization
+        return (z + eps, lnl), lnl.item()  # line 17: blocking .item()
+    return lax.scan(transition, state, steps)
+
+
+def counted(state, n):
+    def body(i, carry):
+        return carry + float(i)          # line 23: cast in fori_loop body
+    return lax.fori_loop(0, n, body, state)
+
+
+def clean_chain(state, steps):
+    # clean: pure jnp transitions — the sanctioned chain-loop shape
+    def transition(carry, step):
+        return carry * 0.5, carry
+    return lax.scan(transition, state, steps)
+
+
+def clean_host_driver(chunks):
+    # clean: a comprehension-shaped final gather OUTSIDE any traced body
+    return [to_host(c) for c in chunks]

@@ -334,3 +334,46 @@ def test_cli_gate_smoke_on_real_bench_history(tmp_path):
     cross = _cli("gate", str(tpu), "--fail-on-regression")
     assert cross.returncode == 0
     assert "insufficient history" in cross.stdout
+
+
+def test_cli_gate_bands_sampler_metrics(tmp_path):
+    """The sampling-lane CI smoke (ISSUE 8): the bench rows' new sampler
+    metrics gate with the right directions — a halved-ESS head row exits 1
+    under --fail-on-regression, a doubled-R-hat row too (lower-better
+    default), while acceptance-rate movement stays informational."""
+    base = {"platform": "cpu", "value": 200.0,
+            "ess_per_s_per_chip": 40.0, "sample_steps_per_s_per_chip": 600.0,
+            "rhat_max": 1.005, "accept_rate": 0.9}
+    for i, jitter in enumerate((0.98, 1.0, 1.02)):
+        (tmp_path / f"HIST_r{i}.json").write_text(json.dumps(
+            {k: (v * jitter if isinstance(v, float) and k != "rhat_max"
+                 else v) for k, v in base.items()}))
+    hist = str(tmp_path / "HIST_r*.json")
+
+    ok = _cli("gate", str(tmp_path / "HIST_r1.json"), "--history", hist,
+              "--fail-on-regression")
+    assert ok.returncode == 0, ok.stdout + ok.stderr[-2000:]
+
+    halved = dict(base, ess_per_s_per_chip=base["ess_per_s_per_chip"] / 2)
+    bad = tmp_path / "halved_ess.json"
+    bad.write_text(json.dumps(halved))
+    strict = _cli("gate", str(bad), "--history", hist,
+                  "--fail-on-regression")
+    assert strict.returncode == 1
+    assert "ess_per_s_per_chip" in strict.stdout
+
+    drifted = dict(base, rhat_max=2.0)
+    bad_rhat = tmp_path / "drifted_rhat.json"
+    bad_rhat.write_text(json.dumps(drifted))
+    strict = _cli("gate", str(bad_rhat), "--history", hist,
+                  "--fail-on-regression")
+    assert strict.returncode == 1
+    assert "rhat_max" in strict.stdout
+
+    # acceptance rate is a health diagnostic with a non-monotonic optimum:
+    # exempt, so even a large move never gates
+    moved = dict(base, accept_rate=0.5)
+    info = tmp_path / "moved_accept.json"
+    info.write_text(json.dumps(moved))
+    assert _cli("gate", str(info), "--history", hist,
+                "--fail-on-regression").returncode == 0

@@ -41,6 +41,10 @@ CASES = [
     ("hostsync_loop.py", LIB,
      {("host-sync-in-jit", 11), ("host-sync-in-jit", 12),
       ("host-sync-in-jit", 16)}),
+    ("hostsync_scan.py", LIB,
+     {("host-sync-in-jit", 13), ("host-sync-in-jit", 14),
+      ("host-sync-in-jit", 15), ("host-sync-in-jit", 16),
+      ("host-sync-in-jit", 17), ("host-sync-in-jit", 23)}),
     ("donated_reuse.py", LIB,
      {("donated-buffer-reuse", 18), ("donated-buffer-reuse", 28)}),
     ("tracer_leak.py", LIB,
