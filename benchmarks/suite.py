@@ -19,8 +19,11 @@ measured run with a K=16 CURN hyperparameter grid (the GP-marginalized
 device likelihood, ``fakepta_tpu.infer``) and the sampling-lane figures
 ``ess_per_s_per_chip`` / ``sample_steps_per_s_per_chip`` / ``rhat_max`` /
 ``accept_rate`` from an on-device batched-MCMC free-spectrum posterior
-(``fakepta_tpu.sample``, docs/SAMPLING.md — see the bench.py docstring for
-the full schema).
+(``fakepta_tpu.sample``, docs/SAMPLING.md) and the serving-lane figures
+``serve_qps_per_chip`` / ``serve_p50_ms`` / ``serve_p99_ms`` /
+``coalesce_factor`` / ``serve_speedup_x`` from the built-in synthetic load
+generator over the warm-pool scheduler (``fakepta_tpu.serve``,
+docs/SERVING.md — see the bench.py docstring for the full schema).
 
     python benchmarks/suite.py                 # all configs, default sizes
     python benchmarks/suite.py --configs 1 2   # subset
@@ -480,6 +483,37 @@ def config5():
     for key in ("ess_per_s_per_chip", "sample_steps_per_s_per_chip",
                 "rhat_max", "accept_rate"):
         row[key] = s_sum[key]
+
+    # the serving lane (fakepta_tpu.serve, docs/SERVING.md): the built-in
+    # load generator over a warm pool + microbatch coalescing scheduler —
+    # request throughput, latency SLOs, coalescing stats and the speedup
+    # over serial per-request run() dispatch (bench.py docstring schema;
+    # responses bit-verified against solo runs inside the generator)
+    from fakepta_tpu.serve import ArraySpec, ServeConfig, run_loadgen
+    if jax.devices()[0].platform != "cpu":
+        serve_spec = ArraySpec(npsr=100, ntoa=780, n_red=30, n_dm=100,
+                               gwb_ncomp=30)
+        serve_requests, serve_sizes = 128, (8, 16, 32, 64)
+        serve_buckets = (64, 128, 256, 512)
+    else:
+        # CPU stand-in: many tiny requests over a small array (the
+        # amortizable-fixed-cost regime; see bench.py)
+        serve_spec = ArraySpec(npsr=16, ntoa=128, n_red=8, n_dm=8,
+                               gwb_ncomp=8)
+        serve_requests, serve_sizes = 128, (1, 2, 4)
+        serve_buckets = (16, 128)
+    serve_buckets = tuple(b for b in serve_buckets if b % n_dev == 0)
+    serve_row = run_loadgen(
+        spec=serve_spec, mesh=make_mesh(jax.devices()),
+        n_requests=serve_requests, sizes=serve_sizes, kind="sim",
+        baseline=True, verify=2, seed=5,
+        config=ServeConfig(buckets=serve_buckets))
+    for key in ("serve_qps_per_chip", "serve_p50_ms", "serve_p99_ms",
+                "coalesce_factor", "pad_waste_frac", "serve_speedup_x",
+                "serve_serial_qps_per_chip", "serve_retraces",
+                "serve_steady_compiles"):
+        if key in serve_row:
+            row[key] = serve_row[key]
 
     # per-mode bytes/chunk (the whole-chunk megakernel + bf16-storage
     # mode, bench.py docstring schema): AOT cost capture only — the

@@ -116,6 +116,19 @@ TIMING_MODULES = (
     "fakepta_tpu/obs/flightrec.py",
 )
 
+# unbounded-queue allowlist: library modules whose unbounded queue/deque
+# construction is bounded by an EXTERNAL invariant rather than a maxsize/
+# maxlen argument. pipeline.py's writer queue is the one deliberate case:
+# the run loop's donated-buffer recycling ring blocks dispatch until the
+# oldest in-flight chunk drains, so the queue never holds more than
+# depth + 1 entries (ThreadWriter docstring) — a maxsize would just add a
+# second, redundant blocking point on the dispatch thread. Everything else
+# (the serve scheduler's admission/demux queues, the SLO rings, the flight
+# recorder) carries an explicit bound.
+UNBOUNDED_QUEUE_MODULES = (
+    "fakepta_tpu/parallel/pipeline.py",
+)
+
 # Library code prefix: rules with a library-only clause (literal re-seeding,
 # dtype policy) fire only under it.
 LIBRARY_PREFIXES = ("fakepta_tpu/",)

@@ -116,11 +116,21 @@ def _cmd_gate(args) -> int:
     new_row = gate_mod.load_row(args.row)
     hist_paths = gate_mod.resolve_history(args.history)
     history = gate_mod.load_history(hist_paths)
+    platform = new_row.get("platform")
+    n_same = len([r for r in history if r.get("platform") == platform])
+    if n_same == 0:
+        # an empty same-platform history cannot band anything: say so
+        # plainly and exit 0 — the first accelerator round after CPU
+        # stand-in rows (or a fresh clone with no BENCH_r*.json at all)
+        # is the start of a trajectory, not a regression
+        print(f"no comparable history: 0 same-platform "
+              f"(platform={platform!r}) rows among {len(history)} loaded "
+              f"history row(s); nothing to gate — this row starts the "
+              f"{platform!r} trajectory")
+        return 0
     results = gate_mod.gate_row(new_row, history, k=args.k,
                                 rel_floor=args.rel_floor,
                                 min_history=args.min_history)
-    platform = new_row.get("platform")
-    n_same = len([r for r in history if r.get("platform") == platform])
     text, regressions = gate_mod.format_gate(results, platform, n_same)
     print(text)
     if regressions:

@@ -34,7 +34,15 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # throughputs ride the _per_s_per_chip suffix, and
                     # rhat_max keeps the lower-is-better default: R-hat
                     # drifting up past the noise band IS a regression)
-                    "ess_min"}
+                    "ess_min",
+                    # the serving layer (fakepta_tpu.serve, docs/SERVING
+                    # .md): request throughput and the coalescing speedup
+                    # over serial dispatch are the lane's whole point;
+                    # coalesce_factor dropping means the scheduler stopped
+                    # amortizing dispatches. serve_p50_ms/serve_p99_ms and
+                    # pad_waste_frac keep the lower-is-better default.
+                    "serve_qps_per_chip", "serve_serial_qps_per_chip",
+                    "serve_speedup_x", "coalesce_factor"}
 
 # suffix rules cover the detect lane's per-ORF metric names
 # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
@@ -64,7 +72,18 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # ess_per_s_per_chip / sample_steps_per_s_per_chip
                   # (higher-better) and rhat_max / divergences /
                   # nonfinite_lnl (lower-better defaults)
-                  "accept_rate", "swap_rate", "n_kept"}
+                  "accept_rate", "swap_rate", "n_kept",
+                  # serve load-shape facts: how deep the queue got and how
+                  # many requests/realizations the window saw are traffic
+                  # description, not performance (the regression-bearing
+                  # serve metrics are serve_qps_per_chip / serve_p50_ms /
+                  # serve_p99_ms / coalesce_factor / pad_waste_frac);
+                  # serve_retraces and serve_steady_compiles keep the
+                  # lower-is-better default — any growth past the zero
+                  # history IS the warm pool regressing
+                  "queue_depth", "serve_requests", "serve_dispatches",
+                  "serve_realizations", "serve_kind", "serve_verified",
+                  "serve_warm_s"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")

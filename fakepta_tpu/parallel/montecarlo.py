@@ -998,6 +998,62 @@ def _build_deterministic(batch, cgw, roemer, ephem, toas_abs, pdist, dtype,
     return jnp.where(batch.mask, det, 0.0)
 
 
+def _lane_mode(offset) -> bool:
+    """True when a dispatch carries serve RNG lanes (vector offset)."""
+    return bool(getattr(offset, "ndim", 0))
+
+
+def _chunk_keys(base_key, offset, nreal):
+    """Per-realization keys for one chunk dispatch — both key modes.
+
+    Batch mode (scalar ``offset``): ``fold_in(base_key, offset + i)``, the
+    engine's absolute-index stream (checkpoint resume identity).
+
+    Lane mode (the :mod:`fakepta_tpu.serve` layer): ``base_key`` is an
+    (nreal,) int32 vector of per-slot *request seeds* and ``offset`` the
+    matching (nreal,) int32 vector of within-request indices; slot i draws
+    ``fold_in(key(seed_i), within_i)`` — exactly the key ``run(n,
+    seed=seed_i)`` gives its realization ``within_i``, so a served request's
+    stream is bit-identical to its own solo run regardless of which cohort,
+    bucket pad, or mesh shape served it. Key values are an elementwise map
+    of (seed, index), so lane streams are mesh-shape independent like every
+    other stage.
+    """
+    if _lane_mode(offset):
+        return jax.vmap(lambda s, w: jax.random.fold_in(
+            jax.random.key(s), w))(base_key, offset)
+    return jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
+        offset + jnp.arange(nreal))
+
+
+def _lane_arrays(lanes, nreal):
+    """Per-slot (request seed, within-request index) vectors for a lane run.
+
+    ``lanes`` is a sequence of ``(seed, n)`` pairs in slot order (the serve
+    scheduler's coalesced cohort); slots past the last lane are bucket
+    padding (seed 0, continuing indices) whose results callers discard.
+    """
+    seeds = np.zeros(nreal, dtype=np.int32)
+    within = np.arange(nreal, dtype=np.int32)
+    pos = 0
+    for s, n in lanes:
+        s, n = int(s), int(n)
+        if n <= 0:
+            raise ValueError(f"lane realization count must be > 0, got {n}")
+        if not 0 <= s < 2 ** 31:
+            # int32 seeds ride the device program; jax.random.key(int32 s)
+            # equals key(python s) on this range, which is what makes lane
+            # streams bit-identical to run(n, seed=s)
+            raise ValueError(f"lane seed must be in [0, 2**31), got {s}")
+        if pos + n > nreal:
+            raise ValueError(f"lanes need {pos + n} slots but the run has "
+                             f"nreal={nreal}")
+        seeds[pos:pos + n] = s
+        within[pos:pos + n] = np.arange(n, dtype=np.int32)
+        pos += n
+    return seeds, within
+
+
 def pack_stats(curves, autos, *extras):
     """Pack per-realization statistic lanes into one (n, nbins+1+...) array.
 
@@ -1916,10 +1972,10 @@ class EnsembleSimulator:
                  with_corr=False):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step", nreal, with_corr, stats_bf16,
-                                  scratch is not None))
+                                  scratch is not None,
+                                  _lane_mode(offset)))
             # per-realization keys derived on device: one tiny transfer per chunk
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                offset + jnp.arange(nreal))
+            keys = _chunk_keys(base_key, offset, nreal)
             corr = shmapped(keys, self.batch, self._chol, self._gwb_w,
                             self._det, self._samp_params, self._white_params,
                             self._white_toaerr2, self._white_bid,
@@ -1965,9 +2021,9 @@ class EnsembleSimulator:
             # w_os.shape[0] is a static Python int at trace time
             self._obs_note_trace(("step_os", nreal, w_os.shape[0],
                                   with_null, with_corr, stats_bf16,
-                                  scratch is not None))
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                offset + jnp.arange(nreal))
+                                  scratch is not None,
+                                  _lane_mode(offset)))
+            keys = _chunk_keys(base_key, offset, nreal)
             out = shmapped(keys, self.batch, self._chol, self._gwb_w,
                            self._det, self._samp_params, self._white_params,
                            self._white_toaerr2, self._white_bid,
@@ -2095,9 +2151,9 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal, w_os, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_fused", nreal, n_os, with_null,
-                                  kernel_prec, scratch is not None))
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                offset + jnp.arange(nreal))
+                                  kernel_prec, scratch is not None,
+                                  _lane_mode(offset)))
+            keys = _chunk_keys(base_key, offset, nreal)
             if n_os:
                 weights = jnp.concatenate(
                     [self._stat_weights[:nbins], w_os,
@@ -2324,9 +2380,9 @@ class EnsembleSimulator:
         def step(base_key, offset, nreal, w_os, cgw_bulks, scratch):
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_mega", nreal, n_os, with_null,
-                                  precision, scratch is not None))
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                offset + jnp.arange(nreal))
+                                  precision, scratch is not None,
+                                  _lane_mode(offset)))
+            keys = _chunk_keys(base_key, offset, nreal)
             if n_os:
                 weights = jnp.concatenate(
                     [self._stat_weights[:nbins], w_os,
@@ -2485,9 +2541,9 @@ class EnsembleSimulator:
                 # trace-time only: the retrace guard (see _obs_note_trace)
                 self._obs_note_trace(("step_lnlike", nreal, theta.shape,
                                       mode, with_corr, stats_bf16,
-                                      scratch is not None))
-                keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                    offset + jnp.arange(nreal))
+                                      scratch is not None,
+                                      _lane_mode(offset)))
+                keys = _chunk_keys(base_key, offset, nreal)
                 corr, lanes = shmapped(
                     keys, self.batch, self._chol, self._gwb_w, theta,
                     self._det, self._samp_params, self._white_params,
@@ -2563,9 +2619,9 @@ class EnsembleSimulator:
                 # trace-time only: the retrace guard (see _obs_note_trace)
                 self._obs_note_trace(("step_mega_lnlike", nreal,
                                       theta.shape, mode, precision,
-                                      scratch is not None))
-                keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                    offset + jnp.arange(nreal))
+                                      scratch is not None,
+                                      _lane_mode(offset)))
+                keys = _chunk_keys(base_key, offset, nreal)
                 curves, autos, lanes = shmapped(
                     keys, self.batch, self._chol, self._gwb_w, theta,
                     times, scales, self._stat_weights, self._det,
@@ -2620,9 +2676,9 @@ class EnsembleSimulator:
             # trace-time only: the retrace guard (see _obs_note_trace)
             self._obs_note_trace(("step_fused_lnlike", nreal, theta.shape,
                                   mode, kernel_prec,
-                                  scratch is not None))
-            keys = jax.vmap(lambda i: jax.random.fold_in(base_key, i))(
-                offset + jnp.arange(nreal))
+                                  scratch is not None,
+                                  _lane_mode(offset)))
+            keys = _chunk_keys(base_key, offset, nreal)
             curves, autos, lanes = shmapped(
                 keys, self.batch, self._chol, self._gwb_w, theta,
                 self._stat_weights, self._det, self._samp_params,
@@ -2687,6 +2743,84 @@ class EnsembleSimulator:
             lanes["n_os"] = len(os_ops)
             lanes["n_extra"] = lanes["n_os"] * (2 if os_spec.null else 1)
         return lanes
+
+    def _exec_plan(self, lane_cfg: dict, path: str, prec: str, precision,
+                   keep_corr: bool):
+        """Bind ONE chunk dispatch's step executable and argument layout.
+
+        The single source of the step-selection ladder, shared by
+        :meth:`run`'s dispatch loop, :meth:`warm_start`, and the serve warm
+        pool (:mod:`fakepta_tpu.serve`) — all three MUST select the
+        identical executable, so an AOT warm start (or a pool bucket
+        prewarm) populates the exact persistent-compile-cache entry the
+        later dispatch loads instead of recompiling. Returns ``(invoke,
+        lower, sig)``: ``invoke(base, offset, nreal, bulks, scratch) ->
+        (packed, corr_or_None)``; ``lower`` the matching ``Lowered``
+        factory for AOT compilation; ``sig`` a stable hashable signature of
+        the selected executable (the warm pool's bookkeeping key).
+        """
+        stats_bf16 = prec == "bf16"
+        if lane_cfg["lnl_compiled"] is not None:
+            spec = lane_cfg["lnl_spec"]
+            step = self._get_step_lnlike(spec.model, spec.mode, path,
+                                         lane_cfg["lnl_compiled"], precision)
+            theta = lane_cfg["lnl_theta"]
+            if path != "xla":
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, theta, bulks, scratch)
+                paired = False
+            else:
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, theta, bulks, scratch, keep_corr)
+                paired = keep_corr
+            sig = ("lnlike", spec.mode, lane_cfg["lnl_k"], lane_cfg["lnl_l"],
+                   path, prec, keep_corr)
+        elif lane_cfg["os_ops"] is not None:
+            null = lane_cfg["os_spec"].null
+            w_os = lane_cfg["w_os"]
+            if path == "mega":
+                step = self._get_step_mega(lane_cfg["n_os"], null, prec)
+            elif path == "fused":
+                step = self._get_step_fused_os(lane_cfg["n_os"], null, prec)
+            else:
+                step = self._get_step_os(null, stats_bf16)
+            if path == "xla":
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, w_os, bulks, scratch, keep_corr)
+                paired = keep_corr
+            else:
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, w_os, bulks, scratch)
+                paired = False
+            sig = ("os", tuple(lane_cfg["os_spec"].orfs), bool(null), path,
+                   prec, keep_corr)
+        else:
+            if path == "mega":
+                step = self._get_step_mega(0, False, prec)
+            elif path == "fused":
+                step = self._get_step_fused_os(0, False, prec)
+            else:
+                step = self._get_step_xla(stats_bf16)
+            if path == "xla":
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, bulks, scratch, keep_corr)
+                paired = keep_corr
+            else:
+                w_os = self._w_os_empty
+
+                def args(b, o, n, bulks, scratch):
+                    return (b, o, n, w_os, bulks, scratch)
+                paired = False
+            sig = ("plain", path, prec, keep_corr)
+
+        def invoke(b, o, n, bulks, scratch):
+            out = step(*args(b, o, n, bulks, scratch))
+            return out if paired else (out, None)
+
+        def lower(b, o, n, bulks, scratch):
+            return step.lower(*args(b, o, n, bulks, scratch))
+
+        return invoke, lower, sig
 
     def _normalize_chunk(self, chunk: int, nreal: int) -> int:
         """Clamp the chunk size to the realization-shard contract."""
@@ -2823,7 +2957,8 @@ class EnsembleSimulator:
             else False, lnl=lnl))
 
     def warm_start(self, chunk: int, *, keep_corr: bool = False, os=None,
-                   lnlike=None, precision=None) -> float:
+                   lnlike=None, precision=None, lane_keys: bool = False,
+                   ) -> float:
         """AOT-compile the chunk program ahead of the first :meth:`run`.
 
         Lowers and compiles the exact step executable ``run(chunk=chunk,
@@ -2835,66 +2970,61 @@ class EnsembleSimulator:
         process or later round sharing the cache dir — loads it instead of
         recompiling, and the obs-measured ``compile_s`` amortizes instead of
         being paid per process. Returns the wall seconds spent.
+
+        ``lane_keys=True`` compiles the *serve-key* variant of the same
+        program — per-slot ``(request seed, within-request index)`` vectors
+        instead of one ``(base key, offset)`` pair (see :func:`_chunk_keys`
+        and ``run(lanes=...)``). The serve warm pool prewarms its bucket
+        ladder through exactly this call, so a pool bucket and a manual
+        ``warm_start(bucket, lane_keys=True)`` of the same spec hit the
+        same compile-cache entry by construction (the step selection is
+        single-sourced in :meth:`_exec_plan`).
         """
         t0 = obs.now()
         chunk = self._normalize_chunk(chunk, chunk)
-        lanes = self._prepare_lanes(os, lnlike)
+        lane_cfg = self._prepare_lanes(os, lnlike)
         path = "xla" if keep_corr else self._stat_path
         prec = self._resolve_precision(path, precision)
-        stats_bf16 = prec == "bf16"
-        base = rng_utils.as_key(0)
         dtype = self.batch.t_own.dtype
-        n_lanes = self.nbins + 1 + lanes["n_extra"]
+        n_lanes = self.nbins + 1 + lane_cfg["n_extra"]
         bulks = tuple(jax.ShapeDtypeStruct((chunk, self.batch.npsr), dtype)
                       for _ in self._cgw_psrterm)
         scratch = jax.ShapeDtypeStruct(
             (chunk, n_lanes), dtype,
             sharding=NamedSharding(self.mesh, P(REAL_AXIS)))
+        if lane_keys:
+            base = jnp.zeros((chunk,), jnp.int32)
+            offset = jnp.zeros((chunk,), jnp.int32)
+        else:
+            base = rng_utils.as_key(0)
+            offset = 0
         prev = self._obs_in_capture
         self._obs_in_capture = True     # an AOT lower is not a user retrace
         try:
-            if lanes["lnl_compiled"] is not None:
-                step = self._get_step_lnlike(
-                    lanes["lnl_spec"].model, lanes["lnl_spec"].mode, path,
-                    lanes["lnl_compiled"], precision)
-                if path != "xla":
-                    lowered = step.lower(base, 0, chunk, lanes["lnl_theta"],
-                                         bulks, scratch)
-                else:
-                    lowered = step.lower(base, 0, chunk, lanes["lnl_theta"],
-                                         bulks, scratch, keep_corr)
-            elif lanes["os_ops"] is not None:
-                null = lanes["os_spec"].null
-                if path == "mega":
-                    lowered = self._get_step_mega(
-                        lanes["n_os"], null, prec).lower(
-                            base, 0, chunk, lanes["w_os"], bulks, scratch)
-                elif path == "fused":
-                    lowered = self._get_step_fused_os(
-                        lanes["n_os"], null, prec).lower(
-                            base, 0, chunk, lanes["w_os"], bulks, scratch)
-                else:
-                    lowered = self._get_step_os(null, stats_bf16).lower(
-                        base, 0, chunk, lanes["w_os"], bulks, scratch,
-                        keep_corr)
-            elif path == "mega":
-                lowered = self._get_step_mega(0, False, prec).lower(
-                    base, 0, chunk, self._w_os_empty, bulks, scratch)
-            elif path == "fused":
-                lowered = self._get_step_fused_os(0, False, prec).lower(
-                    base, 0, chunk, self._w_os_empty, bulks, scratch)
-            else:
-                lowered = self._get_step_xla(stats_bf16).lower(
-                    base, 0, chunk, bulks, scratch, keep_corr)
-            lowered.compile()
+            _, lower, _ = self._exec_plan(lane_cfg, path, prec, precision,
+                                          keep_corr)
+            lower(base, offset, chunk, bulks, scratch).compile()
         finally:
             self._obs_in_capture = prev
         return obs.now() - t0
 
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
             checkpoint=None, progress=None, os=None, lnlike=None,
-            pipeline_depth: int = 2, precision=None, eventlog=None):
+            pipeline_depth: int = 2, precision=None, eventlog=None,
+            lanes=None):
         """Run the ensemble in device-memory-bounded chunks.
+
+        ``lanes``: per-request RNG lanes (the :mod:`fakepta_tpu.serve`
+        coalescing contract) — a sequence of ``(seed, n)`` pairs laid out in
+        slot order. Slot ``i`` of lane ``(s, n)`` draws from
+        ``fold_in(key(s), i)``, the exact key ``run(n, seed=s)`` gives its
+        realization ``i``, so each lane's results are bit-identical to its
+        own solo run regardless of which batchmates, bucket padding, or
+        mesh shape it was coalesced with. Slots past the last lane are
+        padding (discarded by the caller). ``seed`` is ignored for key
+        derivation on a lane run; checkpointing and psrterm CGW sampling
+        (whose host-f64 bulk staging replays the scalar base-key chain) are
+        unsupported with lanes.
 
         Returns a dict with per-realization binned curves ``(nreal, nbins)``,
         mean autocorrelations ``(nreal,)``, bin centers and (optionally) the raw
@@ -3020,14 +3150,29 @@ class EnsembleSimulator:
 
         # the OS lane's host-f64 operator precompute / the lnlike lane's
         # compiled model + staged theta (shared with warm_start)
-        lanes = self._prepare_lanes(os, lnlike)
-        os_spec, os_ops, w_os, n_os = (lanes["os_spec"], lanes["os_ops"],
-                                       lanes["w_os"], lanes["n_os"])
-        lnl_spec, lnl_compiled, lnl_theta = (lanes["lnl_spec"],
-                                             lanes["lnl_compiled"],
-                                             lanes["lnl_theta"])
-        lnl_k, lnl_l, n_extra = lanes["lnl_k"], lanes["lnl_l"], \
-            lanes["n_extra"]
+        lane_cfg = self._prepare_lanes(os, lnlike)
+        os_spec, os_ops, w_os, n_os = (lane_cfg["os_spec"],
+                                       lane_cfg["os_ops"],
+                                       lane_cfg["w_os"], lane_cfg["n_os"])
+        lnl_spec, lnl_compiled, lnl_theta = (lane_cfg["lnl_spec"],
+                                             lane_cfg["lnl_compiled"],
+                                             lane_cfg["lnl_theta"])
+        lnl_k, lnl_l, n_extra = lane_cfg["lnl_k"], lane_cfg["lnl_l"], \
+            lane_cfg["n_extra"]
+
+        lane_seeds = lane_within = None
+        if lanes is not None:
+            if checkpoint is not None:
+                raise ValueError(
+                    "run(lanes=...) cannot checkpoint: the resume identity "
+                    "is keyed on one (seed, nreal, chunk) triple, not a "
+                    "cohort; serve requests are short-lived by design")
+            if self._cgw_psrterm:
+                raise ValueError(
+                    "run(lanes=...) is incompatible with psrterm CGW "
+                    "sampling (its host-f64 bulk staging replays the scalar "
+                    "base-key chain; lane keys have no single base key)")
+            lane_seeds, lane_within = _lane_arrays(lanes, nreal)
 
         ckpt = None
         if checkpoint is not None:
@@ -3067,8 +3212,11 @@ class EnsembleSimulator:
         # flat each through a remote-TPU tunnel).
         depth = max(int(pipeline_depth), 0)
         pipelined = depth > 0 and jax.process_count() == 1
-        ring: collections.deque = collections.deque()   # (packed, drained ev)
         ring_size = max(depth, 1)
+        # (packed, drained ev) per in-flight chunk; maxlen pins the depth
+        # bound structurally (the loop popleft-waits before every append at
+        # capacity, so the cap is never exercised — it is the invariant)
+        ring: collections.deque = collections.deque(maxlen=ring_size)
         sync_each = ckpt is not None and not pipelined
         n_lanes = nb + 1 + n_extra
         dtype = self.batch.t_own.dtype
@@ -3098,6 +3246,10 @@ class EnsembleSimulator:
         }
         if isinstance(seed, (int, np.integer)):
             meta["seed"] = int(seed)
+        if lanes is not None:
+            # a serve-coalesced dispatch: how many request lanes rode this
+            # run (slots beyond their sum are bucket padding)
+            meta["serve_lanes"] = len(list(lanes))
         if os_spec is not None:
             meta["os"] = {"orfs": list(os_spec.orfs),
                           "weighting": os_spec.weighting,
@@ -3123,46 +3275,21 @@ class EnsembleSimulator:
             nreal=int(nreal), chunk=int(chunk), path=path,
             depth=int(depth if pipelined else 0), resume_done=int(done))
 
+        # ONE step-selection ladder for run/warm_start/the serve warm pool
+        # (_exec_plan): the dispatch below and an AOT warm start select the
+        # identical executable by construction
+        invoke, _, _ = self._exec_plan(lane_cfg, path, prec, precision,
+                                       keep_corr)
+
         def dispatch(offset, bulks, scratch):
             """One async chunk dispatch -> (packed, corr-or-None)."""
-            if lnl_compiled is not None:
-                lnl_step = self._get_step_lnlike(
-                    lnl_spec.model, lnl_spec.mode, path, lnl_compiled,
-                    precision)
-                if path != "xla":
-                    return lnl_step(base, offset, chunk, lnl_theta, bulks,
-                                    scratch), None
-                if keep_corr:
-                    return lnl_step(base, offset, chunk, lnl_theta, bulks,
-                                    scratch, True)
-                return lnl_step(base, offset, chunk, lnl_theta, bulks,
-                                scratch, False), None
-            if os_ops is not None:
-                if path == "mega":
-                    return self._get_step_mega(n_os, os_spec.null, prec)(
-                        base, offset, chunk, w_os, bulks, scratch), None
-                if path == "fused":
-                    return self._get_step_fused_os(n_os, os_spec.null,
-                                                   prec)(
-                        base, offset, chunk, w_os, bulks, scratch), None
-                if keep_corr:
-                    return self._get_step_os(os_spec.null, stats_bf16)(
-                        base, offset, chunk, w_os, bulks, scratch, True)
-                return self._get_step_os(os_spec.null, stats_bf16)(
-                    base, offset, chunk, w_os, bulks, scratch, False), None
-            if path == "mega":
-                return self._get_step_mega(0, False, prec)(
-                    base, offset, chunk, self._w_os_empty, bulks,
-                    scratch), None
-            if path == "fused":
-                return self._get_step_fused_os(0, False, prec)(
-                    base, offset, chunk, self._w_os_empty, bulks,
-                    scratch), None
-            step = self._get_step_xla(stats_bf16)
-            if keep_corr:
-                return step(base, offset, chunk, bulks, scratch, True)
-            return step(base, offset, chunk, bulks, scratch,
-                        False), None
+            if lane_seeds is not None:
+                # serve lane keys: per-slot (request seed, within-request
+                # index) vectors replace the (base key, offset) pair
+                return invoke(jnp.asarray(lane_seeds[offset:offset + chunk]),
+                              jnp.asarray(lane_within[offset:offset + chunk]),
+                              chunk, bulks, scratch)
+            return invoke(base, offset, chunk, bulks, scratch)
 
         # chunk 0's staged host inputs are the one precompute the first
         # dispatch genuinely waits on (recorded as its stall_s); every later

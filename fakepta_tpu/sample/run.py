@@ -775,8 +775,10 @@ class SamplingRun:
 
         depth = max(int(pipeline_depth), 0)
         pipelined = depth > 0 and jax.process_count() == 1
-        ring: collections.deque = collections.deque()
         ring_size = max(depth, 1)
+        # maxlen pins the depth bound structurally (the segment loop
+        # popleft-waits before every append at capacity)
+        ring: collections.deque = collections.deque(maxlen=ring_size)
         scratch_sharding = NamedSharding(self.mesh, P(None, REAL_AXIS))
         dt = np.dtype(self._dtype)
 

@@ -1,0 +1,35 @@
+"""fakepta_tpu.serve — warm-pool serving layer + microbatch coalescing.
+
+The request-shaped front door to the ensemble engine (docs/SERVING.md):
+many small user requests coalesce into one padded chunk dispatch over a
+warm pool of AOT-compiled executables, each request riding its own RNG
+lane so responses are bit-identical to a solo ``run(n, seed)`` no matter
+how they were batched. Backpressure (:class:`ServeBusy`), per-request
+deadlines (:class:`ServeTimeout`), flight-recorder failure notes, and SLO
+telemetry (``serve_p50_ms``/``serve_p99_ms``/``serve_qps_per_chip``,
+``coalesce_factor``, ``pad_waste_frac``) through ``fakepta_tpu.obs`` are
+part of the lane.
+
+Embeddable surface::
+
+    from fakepta_tpu.serve import ArraySpec, ServePool, SimRequest
+    pool = ServePool()
+    res = pool.serve(SimRequest(spec=ArraySpec(npsr=20), n=32, seed=7))
+
+CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket`` (the load
+generator prints the benchmark row ``bench.py`` records).
+"""
+
+from .loadgen import run_loadgen
+from .pool import PoolEntry, WarmPool
+from .scheduler import ServeConfig, ServePool, ServeResult
+from .spec import (DEFAULT_BUCKETS, ArraySpec, InferRequest, OSRequest,
+                   ServeBusy, ServeClosed, ServeError, ServeTimeout,
+                   SimRequest, curn_grid_spec)
+
+__all__ = [
+    "DEFAULT_BUCKETS", "ArraySpec", "InferRequest", "OSRequest",
+    "PoolEntry", "ServeBusy", "ServeClosed", "ServeConfig", "ServeError",
+    "ServePool", "ServeResult", "ServeTimeout", "SimRequest", "WarmPool",
+    "curn_grid_spec", "run_loadgen",
+]

@@ -1,0 +1,306 @@
+"""CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket ...``.
+
+Three drivers over one :class:`ServePool`:
+
+- ``loadgen`` — the built-in synthetic load generator / benchmark
+  (:mod:`.loadgen`): prints ONE JSON row with the SLO metrics (and, with
+  ``--baseline``, the serial-dispatch comparison + ``serve_speedup_x``);
+- ``stdin`` — JSON-lines request/response over stdin/stdout: each input
+  line is a request object, each output line a response (responses stream
+  in completion order; match them by ``id``);
+- ``socket`` — the same JSON-lines protocol over TCP (one connection per
+  client, threaded), for processes that are not children of the server.
+
+Request line schema (shared by stdin/socket)::
+
+    {"id": 1, "kind": "sim"|"os"|"infer", "n": 16, "seed": 7,
+     "spec": {"npsr": 20, ...} | "registered-name",   # optional: default spec
+     "deadline_ms": 250,                               # optional
+     "orf": "hd", "weighting": "noise", "null": false, # kind == "os"
+     "grid": {"k": 4, "nbin": 10}}                     # kind == "infer"
+
+Responses: ``{"id", "ok": true, "n", "latency_ms", "queued_ms", "bucket",
+"cohort_requests", ...results}`` with ``--emit summary`` (per-request curve
+means) or ``--emit full`` (full per-realization arrays). Failures:
+``{"id", "ok": false, "code": "busy"|"timeout"|"error", "error": msg}`` —
+``busy`` is the 429-style admission rejection (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+import threading
+
+import numpy as np
+
+from .scheduler import ServeConfig, ServePool
+from .spec import (ArraySpec, InferRequest, OSRequest, ServeBusy,
+                   ServeTimeout, SimRequest, curn_grid_spec)
+
+
+def _spec_from_args(args) -> ArraySpec:
+    return ArraySpec(npsr=args.npsr, ntoa=args.ntoa,
+                     tspan_years=args.tspan_years, n_red=args.n_red,
+                     n_dm=args.n_dm, gwb_orf=args.gwb_orf,
+                     gwb_ncomp=args.gwb_ncomp)
+
+
+def _config_from_args(args) -> ServeConfig:
+    kw = {}
+    if args.buckets:
+        kw["buckets"] = tuple(args.buckets)
+    if args.max_queue_depth is not None:
+        kw["max_queue_depth"] = args.max_queue_depth
+    if args.window_ms is not None:
+        kw["coalesce_window_s"] = args.window_ms / 1e3
+    if args.prewarm_buckets:
+        kw["prewarm_buckets"] = tuple(args.prewarm_buckets)
+    return ServeConfig(**kw)
+
+
+def request_from_json(d: dict, default_spec: ArraySpec):
+    """One request line -> request object (see module docstring schema)."""
+    kind = d.get("kind", "sim")
+    spec = d.get("spec")
+    if spec is None:
+        spec = default_spec
+    elif isinstance(spec, dict):
+        spec = ArraySpec(**spec)
+    elif not isinstance(spec, str):
+        raise ValueError("spec must be an object or a registered name")
+    n = int(d["n"])
+    seed = int(d.get("seed", 0))
+    deadline = d.get("deadline_ms")
+    deadline_s = float(deadline) / 1e3 if deadline is not None else None
+    if kind == "sim":
+        return SimRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s)
+    if kind == "os":
+        return OSRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
+                         orf=d.get("orf", "hd"),
+                         weighting=d.get("weighting", "noise"),
+                         null=bool(d.get("null", False)))
+    if kind == "infer":
+        grid = d.get("grid") or {}
+        lnlike = curn_grid_spec(
+            k=int(grid.get("k", 4)),
+            log10_A=tuple(grid.get("log10_A", (-15.2, -14.2))),
+            gamma=tuple(grid.get("gamma", (3.0, 6.0))),
+            nbin=int(grid.get("nbin", 10)))
+        return InferRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
+                            lnlike=lnlike)
+    raise ValueError(f"unknown request kind {kind!r}")
+
+
+def response_json(req_id, res, emit: str = "summary") -> dict:
+    out = {
+        "id": req_id, "ok": True, "n": int(res.curves.shape[0]),
+        "latency_ms": round(res.latency_s * 1e3, 3),
+        "queued_ms": round(res.queued_s * 1e3, 3),
+        "bucket": res.bucket, "cohort_requests": res.cohort_requests,
+    }
+    if emit == "full":
+        out["curves"] = np.asarray(res.curves).tolist()
+        out["autos"] = np.asarray(res.autos).tolist()
+        out["bin_centers"] = np.asarray(res.bin_centers).tolist()
+        if res.os is not None:
+            out["os"] = {orf: {k: (np.asarray(v).tolist()
+                                   if isinstance(v, np.ndarray) else v)
+                               for k, v in entry.items()}
+                         for orf, entry in res.os["stats"].items()}
+        if res.lnlike is not None:
+            out["lnl"] = np.asarray(res.lnlike["lnl"]).tolist()
+    else:
+        out["curve_mean"] = np.asarray(res.curves).mean(axis=0).tolist()
+        out["autos_mean"] = float(np.asarray(res.autos).mean())
+        if res.os is not None:
+            out["os"] = {orf: {"amp2_mean": float(np.mean(e["amp2"])),
+                               "snr_mean": float(np.mean(e["snr"]))}
+                         for orf, e in res.os["stats"].items()}
+        if res.lnlike is not None:
+            out["lnl_max"] = float(np.max(res.lnlike["lnl"]))
+    return out
+
+
+def error_json(req_id, exc) -> dict:
+    code = ("busy" if isinstance(exc, ServeBusy)
+            else "timeout" if isinstance(exc, ServeTimeout) else "error")
+    return {"id": req_id, "ok": False, "code": code, "error": str(exc)}
+
+
+def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
+    """Drive the pool from an iterator of request lines; responses stream
+    through ``write`` in completion order. Returns served count."""
+    wlock = threading.Lock()
+    futs = []
+
+    def emit_line(obj):
+        with wlock:
+            write(json.dumps(obj) + "\n")
+
+    for raw in lines:
+        raw = raw.strip()
+        if not raw:
+            continue
+        try:
+            d = json.loads(raw)
+            req = request_from_json(d, default_spec)
+            req_id = d.get("id")
+        except (ValueError, KeyError, TypeError) as exc:
+            emit_line({"id": None, "ok": False, "code": "bad_request",
+                       "error": str(exc)})
+            continue
+        try:
+            fut = pool.submit(req)
+        except Exception as exc:   # Busy/Closed/ValueError -> error line
+            emit_line(error_json(req_id, exc))
+            continue
+
+        def _done(f, req_id=req_id):
+            exc = f.exception()
+            emit_line(error_json(req_id, exc) if exc is not None
+                      else response_json(req_id, f.result(), emit))
+
+        fut.add_done_callback(_done)
+        futs.append(fut)
+    for f in futs:
+        try:
+            f.result(timeout=600.0)
+        except Exception:
+            pass   # already reported through the done callback
+    return len(futs)
+
+
+def _cmd_loadgen(args) -> int:
+    from .loadgen import run_loadgen
+
+    row = run_loadgen(
+        spec=_spec_from_args(args), n_requests=args.requests,
+        sizes=tuple(args.sizes), kind=args.kind, rate_hz=args.rate,
+        seed=args.seed, baseline=args.baseline, verify=args.verify,
+        config=_config_from_args(args),
+        compile_cache_dir=args.compile_cache, report_path=args.report)
+    print(json.dumps(row))
+    return 0
+
+
+def _cmd_stdin(args) -> int:
+    pool = ServePool(config=_config_from_args(args),
+                     compile_cache_dir=args.compile_cache)
+    try:
+        n = _serve_stream(pool, sys.stdin, sys.stdout.write,
+                          _spec_from_args(args), args.emit)
+        sys.stdout.flush()
+    finally:
+        if args.report:
+            pool.save_report(args.report)
+        pool.close()
+    print(f"served {n} request(s)", file=sys.stderr)
+    return 0
+
+
+def _cmd_socket(args) -> int:
+    import socketserver
+
+    pool = ServePool(config=_config_from_args(args),
+                     compile_cache_dir=args.compile_cache)
+    default_spec = _spec_from_args(args)
+    emit = args.emit
+
+    class Handler(socketserver.StreamRequestHandler):
+        def handle(self):
+            lines = (raw.decode("utf-8", "replace") for raw in self.rfile)
+            _serve_stream(pool, lines,
+                          lambda s: (self.wfile.write(s.encode()),
+                                     self.wfile.flush()),
+                          default_spec, emit)
+
+    class Server(socketserver.ThreadingTCPServer):
+        allow_reuse_address = True
+        daemon_threads = True
+
+    with Server((args.host, args.port), Handler) as server:
+        print(f"serving on {args.host}:{server.server_address[1]} "
+              f"(JSON-lines; ^C to stop)", file=sys.stderr)
+        try:
+            server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+    if args.report:
+        pool.save_report(args.report)
+    pool.close()
+    return 0
+
+
+def _add_common(p):
+    p.add_argument("--npsr", type=int, default=20)
+    p.add_argument("--ntoa", type=int, default=156)
+    p.add_argument("--tspan-years", type=float, default=15.0)
+    p.add_argument("--n-red", type=int, default=10)
+    p.add_argument("--n-dm", type=int, default=10)
+    p.add_argument("--gwb-orf", default="hd",
+                   help="common-signal ORF ('' disables the GWB)")
+    p.add_argument("--gwb-ncomp", type=int, default=10)
+    p.add_argument("--buckets", type=int, nargs="*", default=None,
+                   help="microbatch bucket ladder (default: "
+                        "16..1024, ratio 2)")
+    p.add_argument("--prewarm-buckets", type=int, nargs="*", default=None)
+    p.add_argument("--max-queue-depth", type=int, default=None)
+    p.add_argument("--window-ms", type=float, default=None,
+                   help="coalesce window in milliseconds (default 2)")
+    p.add_argument("--compile-cache", default=None,
+                   help="persistent compile cache dir (default: "
+                        "$FAKEPTA_TPU_COMPILE_CACHE)")
+    p.add_argument("--report", default=None,
+                   help="write the pool's obs RunReport artifact here")
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m fakepta_tpu.serve",
+        description="warm-pool serving layer with a microbatch coalescing "
+                    "scheduler (docs/SERVING.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    lg = sub.add_parser("loadgen", help="synthetic load benchmark: one "
+                                        "JSON row of SLO metrics")
+    _add_common(lg)
+    lg.add_argument("--requests", type=int, default=64)
+    lg.add_argument("--sizes", type=int, nargs="*", default=[4, 8, 16, 32])
+    lg.add_argument("--kind", choices=("sim", "os", "infer"), default="sim")
+    lg.add_argument("--rate", type=float, default=None,
+                    help="submission rate in Hz (default: flat-out)")
+    lg.add_argument("--seed", type=int, default=0)
+    lg.add_argument("--baseline", action="store_true",
+                    help="also measure serial per-request run() dispatch "
+                         "and report serve_speedup_x")
+    lg.add_argument("--verify", type=int, default=3,
+                    help="solo-check this many served responses "
+                         "bit-for-bit (0 disables)")
+
+    st = sub.add_parser("stdin", help="JSON-lines request/response over "
+                                      "stdin/stdout")
+    _add_common(st)
+    st.add_argument("--emit", choices=("summary", "full"), default="summary")
+
+    so = sub.add_parser("socket", help="JSON-lines over TCP")
+    _add_common(so)
+    so.add_argument("--host", default="127.0.0.1")
+    so.add_argument("--port", type=int, default=8791)
+    so.add_argument("--emit", choices=("summary", "full"), default="summary")
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "loadgen":
+        return _cmd_loadgen(args)
+    if args.command == "stdin":
+        return _cmd_stdin(args)
+    return _cmd_socket(args)
+
+
+if __name__ == "__main__":                               # pragma: no cover
+    sys.exit(main())

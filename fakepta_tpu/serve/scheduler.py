@@ -1,0 +1,582 @@
+"""Microbatch coalescing scheduler + the :class:`ServePool` facade.
+
+The serving-side analogue of the engine's batching argument: one compiled
+executable and one device round-trip amortize across as many users as the
+queue holds. Requests are admitted into per-``(spec_hash, lane token)``
+queues, coalesced into cohorts inside a short window, padded up to a fixed
+**bucket ladder** shape (so dispatch never recompiles — every bucket's
+executable is prewarmed or compiled exactly once), dispatched through the
+existing ``EnsembleSimulator.run()`` pipeline with one RNG **lane** per
+request, and demultiplexed into per-request slices on a writer-side demux
+thread. Results are bit-identical to each request's own solo
+``run(n, seed)`` regardless of cohort, padding, or mesh (the engine's
+``_chunk_keys`` lane contract).
+
+Robustness is part of the lane, not an afterthought:
+
+- **backpressure**: admission past ``max_queue_depth`` pending requests
+  raises :class:`ServeBusy` (429-style — the caller backs off); the demux
+  hand-off queue is bounded too, so a slow consumer throttles dispatch
+  instead of growing host memory;
+- **deadlines**: a request whose relative ``deadline_s`` expires before
+  its cohort dispatches is cancelled with :class:`ServeTimeout`
+  (dispatched work always completes — device programs are not preempted);
+- **failure telemetry**: a failed dispatch fails every cohort member with
+  :class:`ServeError` and drops a note in the crash flight recorder
+  (``obs.flightrec``), so a dead serving process leaves evidence.
+
+Observability: every request contributes a timeline span and the pool
+rolls them up into SLO summaries (``serve_p50_ms`` / ``serve_p99_ms`` /
+``serve_qps_per_chip``, ``queue_depth``, ``coalesce_factor``,
+``pad_waste_frac``) through the existing ``fakepta_tpu.obs`` schema —
+:meth:`ServePool.save_report` writes a RunReport artifact that
+``obs summarize`` prints and ``obs compare`` / ``obs gate`` band with the
+serve-aware direction tables (docs/SERVING.md).
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import queue
+import threading
+from concurrent.futures import Future
+from typing import Optional, Tuple
+
+import numpy as np
+
+from .. import obs
+from ..obs import flightrec
+from .pool import WarmPool
+from .spec import (DEFAULT_BUCKETS, ArraySpec, ServeBusy, ServeClosed,
+                   ServeError, ServeTimeout, SimRequest, resolve_spec_hash)
+
+_STOP = object()
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Scheduler/pool knobs (defaults serve small-array traffic).
+
+    ``buckets`` is the microbatch ladder: cohorts pad to the smallest
+    bucket >= their total realization count, so every dispatch reuses one
+    of O(len(ladder)) executables — the pad-waste / compile-count tradeoff
+    is the ladder ratio (docs/SERVING.md). ``max_queue_depth`` bounds the
+    pending-request count across all queues (admission past it raises
+    ServeBusy). ``coalesce_window_s`` is how long the scheduler holds the
+    oldest request to let batchmates arrive; a full max-size cohort
+    dispatches immediately. ``prewarm_buckets`` (default: none) AOT-warms
+    the plain-sim lane for those buckets when a spec enters the pool.
+    """
+
+    buckets: Tuple[int, ...] = DEFAULT_BUCKETS
+    max_queue_depth: int = 256
+    coalesce_window_s: float = 0.002
+    max_specs: int = 4
+    prewarm_buckets: Tuple[int, ...] = ()
+    pipeline_depth: int = 0          # single-chunk dispatches: serial loop
+    result_window: int = 4096        # SLO ring capacity (requests)
+
+
+@dataclasses.dataclass
+class ServeResult:
+    """One request's demultiplexed slice of its cohort dispatch."""
+
+    curves: np.ndarray               # (n, nbins)
+    autos: np.ndarray                # (n,)
+    bin_centers: np.ndarray
+    os: Optional[dict] = None        # per-request detect assembly
+    lnlike: Optional[dict] = None    # per-request infer lanes
+    queued_s: float = 0.0            # admission -> dispatch
+    service_s: float = 0.0           # dispatch -> result ready
+    latency_s: float = 0.0           # admission -> result ready
+    cohort_requests: int = 1         # how many requests rode the dispatch
+    bucket: int = 0                  # padded dispatch shape
+    pad_waste_frac: float = 0.0      # 1 - cohort realizations / bucket
+
+
+class _Pending:
+    __slots__ = ("req", "fut", "spec_hash", "cohort_key", "t_enq",
+                 "deadline")
+
+    def __init__(self, req, fut, spec_hash, cohort_key, t_enq, deadline):
+        self.req = req
+        self.fut = fut
+        self.spec_hash = spec_hash
+        self.cohort_key = cohort_key
+        self.t_enq = t_enq
+        self.deadline = deadline
+
+
+class _CohortQueue:
+    """FIFO of pending requests plus an O(1) realization total, so the
+    dispatcher's window check never rescans the queue under the lock (a
+    rescan per submit notification is O(n^2) across a burst)."""
+
+    __slots__ = ("q", "total", "min_deadline")
+
+    def __init__(self, maxlen: int):
+        self.q = collections.deque(maxlen=maxlen)
+        self.total = 0
+        # earliest deadline ever queued here — conservative (never relaxed
+        # on pop): the dispatcher may wake a beat early and recheck, but a
+        # deadline can never sleep through its own coalesce window
+        self.min_deadline = None
+
+    def append(self, p) -> None:
+        self.q.append(p)
+        self.total += int(p.req.n)
+        if p.deadline is not None and (self.min_deadline is None
+                                       or p.deadline < self.min_deadline):
+            self.min_deadline = p.deadline
+
+    def popleft(self):
+        p = self.q.popleft()
+        self.total -= int(p.req.n)
+        return p
+
+    def __bool__(self) -> bool:
+        return bool(self.q)
+
+    def __len__(self) -> int:
+        return len(self.q)
+
+
+class _Stats:
+    """SLO accumulators (bounded rings; guarded by the pool lock)."""
+
+    def __init__(self, window: int):
+        self.latency_ms = collections.deque(maxlen=window)
+        self.queued_ms = collections.deque(maxlen=window)
+        self.service_ms = collections.deque(maxlen=window)
+        self.coalesce = collections.deque(maxlen=window)
+        self.pad_waste = collections.deque(maxlen=window)
+        self.submitted = 0
+        self.completed = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self.failed = 0
+        self.dispatches = 0
+        self.realizations = 0
+        self.queue_depth_max = 0
+        self.retraces = 0
+        self.steady_compiles = 0     # compiles on an already-warm cohort
+        self.warm_s = 0.0
+        self.t_first = None          # first admission
+        self.t_last = None           # last completion
+
+
+class ServePool:
+    """The embeddable serving facade (docs/SERVING.md).
+
+    One dispatcher thread forms cohorts and drives the device; one demux
+    thread slices results and resolves futures — so result assembly for
+    cohort *k* overlaps the dispatch of cohort *k+1*. All jax dispatch
+    happens on the dispatcher thread.
+
+    >>> pool = ServePool()
+    >>> res = pool.serve(SimRequest(spec=ArraySpec(npsr=8), n=32, seed=7))
+    >>> pool.close()
+    """
+
+    def __init__(self, mesh=None, config: Optional[ServeConfig] = None,
+                 compile_cache_dir: Optional[str] = None):
+        import jax
+
+        self.config = config or ServeConfig()
+        if mesh is None:
+            from ..parallel.mesh import make_mesh
+            mesh = make_mesh(jax.devices())
+        self.mesh = mesh
+        self.n_devices = int(mesh.devices.size)
+        n_real = int(mesh.shape.get("real", 1))
+        buckets = sorted({int(b) for b in self.config.buckets})
+        bad = [b for b in buckets if b % n_real]
+        if bad or not buckets:
+            raise ValueError(
+                f"every bucket must be a positive multiple of the mesh's "
+                f"'real' axis ({n_real}); offending buckets: {bad or buckets}")
+        self._buckets = tuple(buckets)
+        self._max_bucket = buckets[-1]
+        self._pool = WarmPool(mesh, max_entries=self.config.max_specs,
+                              compile_cache_dir=compile_cache_dir)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._queues: dict = {}          # cohort_key -> deque[_Pending]
+        self._pending = 0
+        self._closed = False
+        self._t0 = obs.now()             # pool epoch for timeline spans
+        self._stats = _Stats(self.config.result_window)
+        self._timeline = collections.deque(maxlen=self.config.result_window)
+        # bounded hand-off to the demux thread: a slow consumer throttles
+        # dispatch instead of buffering unbounded cohorts on the host
+        self._demux_q: "queue.Queue" = queue.Queue(maxsize=8)
+        self._demux_thread = threading.Thread(
+            target=self._demux_loop, name="fakepta-serve-demux", daemon=True)
+        self._demux_thread.start()
+        self._dispatcher = threading.Thread(
+            target=self._dispatch_loop, name="fakepta-serve-dispatch",
+            daemon=True)
+        self._dispatcher.start()
+
+    # -- registration / admission ------------------------------------------
+    def register(self, name: str, sim, prewarm: bool = True) -> str:
+        """Pin a prebuilt simulator under ``name`` (multi-tenant surface);
+        requests then pass ``spec=name``. Returns the spec hash."""
+        spec_hash = self._pool.register(name, sim)
+        if prewarm and self.config.prewarm_buckets:
+            entry = self._pool.get(spec_hash, None)
+            self._stats.warm_s += self._pool.prewarm(
+                entry, self.config.prewarm_buckets)
+        return spec_hash
+
+    def submit(self, req: SimRequest) -> Future:
+        """Admit one request; returns a Future resolving to a
+        :class:`ServeResult`. Raises :class:`ServeBusy` past the configured
+        queue depth, :class:`ServeClosed` after shutdown, ``ValueError``
+        for an unserveable shape."""
+        n = int(req.n)
+        if not 0 < n <= self._max_bucket:
+            raise ValueError(
+                f"request n={n} does not fit the bucket ladder (max "
+                f"{self._max_bucket}); split the request or extend "
+                f"ServeConfig.buckets")
+        spec_hash = resolve_spec_hash(req.spec, self._pool.named)
+        cohort_key = (spec_hash, req.lane_token())
+        fut: Future = Future()
+        t = obs.now()
+        deadline = t + req.deadline_s if req.deadline_s is not None else None
+        with self._cond:
+            if self._closed:
+                raise ServeClosed("pool is closed")
+            if self._pending >= self.config.max_queue_depth:
+                self._stats.rejected += 1
+                flightrec.note("serve_busy", pending=self._pending,
+                               depth=self.config.max_queue_depth)
+                raise ServeBusy(
+                    f"{self._pending} requests pending >= max_queue_depth="
+                    f"{self.config.max_queue_depth}; retry with backoff")
+            q = self._queues.get(cohort_key)
+            if q is None:
+                # per-cohort FIFO; maxlen mirrors the global admission bound
+                q = _CohortQueue(self.config.max_queue_depth)
+                self._queues[cohort_key] = q
+            q.append(_Pending(req, fut, spec_hash, cohort_key, t, deadline))
+            self._pending += 1
+            self._stats.submitted += 1
+            if self._stats.t_first is None:
+                self._stats.t_first = t
+            self._stats.queue_depth_max = max(self._stats.queue_depth_max,
+                                              self._pending)
+            self._cond.notify_all()
+        return fut
+
+    def serve(self, req: SimRequest, timeout: Optional[float] = None
+              ) -> ServeResult:
+        """Blocking convenience: ``submit`` + wait."""
+        return self.submit(req).result(timeout=timeout)
+
+    @property
+    def buckets(self) -> Tuple[int, ...]:
+        """The validated microbatch bucket ladder."""
+        return self._buckets
+
+    # -- scheduling ---------------------------------------------------------
+    def bucket_for(self, total: int) -> int:
+        """Smallest ladder bucket >= ``total`` realizations."""
+        for b in self._buckets:
+            if b >= total:
+                return b
+        return self._max_bucket
+
+    def _oldest_key(self):
+        best = None
+        for key, q in self._queues.items():
+            if q and (best is None or q.q[0].t_enq < best[1]):
+                best = (key, q.q[0].t_enq)
+        return best[0] if best else None
+
+    def _dispatch_loop(self):
+        while True:
+            with self._cond:
+                while self._pending == 0 and not self._closed:
+                    self._cond.wait()
+                if self._pending == 0 and self._closed:
+                    return
+                key = self._oldest_key()
+                q = self._queues[key]
+                # hold the oldest request one coalesce window so batchmates
+                # land in the same dispatch; a ladder-filling cohort (or
+                # shutdown drain) goes immediately
+                window_end = q.q[0].t_enq + self.config.coalesce_window_s
+                while not self._closed and q.total < self._max_bucket:
+                    # the window closes early at the earliest queued
+                    # deadline, so an expiring request is cancelled
+                    # promptly instead of sleeping out the full window
+                    t_end = (window_end if q.min_deadline is None
+                             else min(window_end, q.min_deadline))
+                    now = obs.now()
+                    if now >= t_end:
+                        break
+                    self._cond.wait(timeout=max(t_end - now, 1e-4))
+                cohort, expired, total = [], [], 0
+                now = obs.now()
+                while q:
+                    p = q.q[0]
+                    if p.deadline is not None and now > p.deadline:
+                        expired.append(q.popleft())
+                        continue
+                    if total + p.req.n > self._max_bucket:
+                        break
+                    cohort.append(q.popleft())
+                    total += p.req.n
+                self._pending -= len(cohort) + len(expired)
+                self._stats.cancelled += len(expired)
+            for p in expired:
+                flightrec.note("serve_deadline_cancel", kind=p.req.kind,
+                               n=int(p.req.n), waited_s=round(
+                                   obs.now() - p.t_enq, 4))
+                p.fut.set_exception(ServeTimeout(
+                    f"deadline ({p.req.deadline_s}s) expired before "
+                    f"dispatch"))
+            if cohort:
+                self._dispatch(cohort, total)
+
+    def _dispatch(self, cohort, total: int):
+        p0 = cohort[0]
+        run_kwargs = p0.req.run_kwargs()
+        bucket = self.bucket_for(total)
+        t_d0 = obs.now()
+        try:
+            entry = self._pool.get(p0.spec_hash, p0.req.spec)
+            warm_s = entry.ensure_warm(
+                bucket, p0.req.lane_token(), run_kwargs,
+                cache_active=bool(self._pool.cache_dir))
+            lanes = [(p.req.seed, p.req.n) for p in cohort]
+            out = entry.sim.run(bucket, chunk=bucket, lanes=lanes,
+                                pipeline_depth=self.config.pipeline_depth,
+                                **run_kwargs)
+        except BaseException as exc:   # noqa: BLE001 — forwarded to callers
+            flightrec.note("serve_request_failed", kind=p0.req.kind,
+                           cohort=len(cohort), bucket=int(bucket),
+                           error=repr(exc)[:300])
+            err = ServeError(f"dispatch failed: {exc!r}")
+            err.__cause__ = exc
+            with self._lock:
+                self._stats.failed += len(cohort)
+            for p in cohort:
+                p.fut.set_exception(err)
+            return
+        t_d1 = obs.now()
+        rep = out["report"]
+        with self._lock:
+            st = self._stats
+            st.dispatches += 1
+            st.realizations += total
+            st.coalesce.append(len(cohort))
+            st.pad_waste.append(1.0 - total / bucket)
+            st.retraces += rep.retraces
+            st.warm_s += warm_s
+            if warm_s == 0.0 and rep.compile_s > 0:
+                # an already-warm (lane, bucket) pair paid a compile: the
+                # steady-state recompile the warm pool exists to prevent
+                st.steady_compiles += 1
+            self._timeline.append(
+                {"name": "serve_dispatch", "tid": "serve",
+                 "t0": t_d0 - self._t0, "dur": t_d1 - t_d0,
+                 "cohort": len(cohort), "bucket": int(bucket),
+                 "req_kind": p0.req.kind})
+        # writer-side demux: slicing/assembly happens off the dispatch
+        # thread so the next cohort's device work starts immediately
+        self._demux_q.put((cohort, out, entry, run_kwargs, bucket, total,
+                           t_d0, t_d1))
+
+    # -- demux --------------------------------------------------------------
+    def _demux_loop(self):
+        while True:
+            item = self._demux_q.get()
+            if item is _STOP:
+                return
+            cohort, out, entry, run_kwargs, bucket, total, t_d0, t_d1 = item
+            try:
+                self._demux(cohort, out, entry, run_kwargs, bucket, total,
+                            t_d0)
+            except BaseException as exc:   # noqa: BLE001 — forwarded
+                err = ServeError(f"demux failed: {exc!r}")
+                err.__cause__ = exc
+                for p in cohort:
+                    if not p.fut.done():
+                        p.fut.set_exception(err)
+                flightrec.note("serve_demux_failed", error=repr(exc)[:300])
+                with self._lock:
+                    self._stats.failed += sum(
+                        1 for p in cohort if p.fut.exception() is err)
+
+    def _demux(self, cohort, out, entry, run_kwargs, bucket, total, t_d0):
+        os_vals = null_vals = os_ops = os_spec = None
+        if out.get("os") is not None:
+            from ..detect import operators as detect_ops
+
+            res = out["os"]
+            os_spec = run_kwargs["os"]
+            # the engine's assembly is per-realization except the null
+            # calibration (quantiles/p-values over the cohort's null
+            # sample); re-assembling each request's slice keeps every
+            # response a pure function of its own lane — cohort-independent
+            os_vals = np.stack([res["stats"][o]["amp2"] for o in res["orfs"]],
+                               axis=1)
+            if res["null"]:
+                null_vals = np.stack([res["stats"][o]["null_amp2"]
+                                      for o in res["orfs"]], axis=1)
+            token = cohort[0].req.lane_token()
+            os_ops = entry.os_ops.get(token)
+            if os_ops is None:
+                os_ops = entry.sim._prepare_lanes(os_spec, None)["os_ops"]
+                entry.os_ops[token] = os_ops
+            assemble = detect_ops.assemble
+        pos = 0
+        done = []
+        for p in cohort:
+            n = int(p.req.n)
+            sl = slice(pos, pos + n)
+            pos += n
+            result = ServeResult(
+                curves=np.array(out["curves"][sl]),
+                autos=np.array(out["autos"][sl]),
+                bin_centers=out["bin_centers"],
+                cohort_requests=len(cohort), bucket=int(bucket),
+                pad_waste_frac=1.0 - total / bucket)
+            if os_vals is not None:
+                result.os = assemble(
+                    os_spec, os_ops, os_vals[sl],
+                    null_vals[sl] if null_vals is not None else None)
+            if out.get("lnlike") is not None:
+                lnl = out["lnlike"]
+                # only the per-realization lanes slice; theta/param_names/
+                # schema are cohort-shape-independent and pass through
+                result.lnlike = {k: (np.array(v[sl])
+                                     if k in ("lnl", "grad", "fisher")
+                                     else v)
+                                 for k, v in lnl.items()}
+            t_done = obs.now()
+            result.queued_s = t_d0 - p.t_enq
+            result.service_s = t_done - t_d0
+            result.latency_s = t_done - p.t_enq
+            p.fut.set_result(result)
+            done.append((p, result, t_done))
+        # ONE stats/timeline critical section per cohort, after every
+        # future is already resolved: the hot serving path never makes a
+        # waiting caller contend with bookkeeping
+        with self._lock:
+            st = self._stats
+            for p, result, t_done in done:
+                st.completed += 1
+                st.t_last = t_done
+                st.latency_ms.append(result.latency_s * 1e3)
+                st.queued_ms.append(result.queued_s * 1e3)
+                st.service_ms.append(result.service_s * 1e3)
+                self._timeline.append(
+                    {"name": "request", "tid": "serve",
+                     "t0": p.t_enq - self._t0, "dur": result.latency_s,
+                     "req_kind": p.req.kind, "n": int(p.req.n)})
+
+    def reset_stats(self) -> None:
+        """Zero the SLO accumulators and timeline (the load generator's
+        warmup/measure boundary); warm-pool state is untouched."""
+        with self._lock:
+            self._stats = _Stats(self.config.result_window)
+            self._timeline.clear()
+            self._t0 = obs.now()
+
+    # -- observability -------------------------------------------------------
+    def slo_summary(self) -> dict:
+        """The SLO rollup (docs/SERVING.md metric table): gate-/compare-
+        aware via the ``fakepta_tpu.obs`` direction tables."""
+        with self._lock:
+            st = self._stats
+            lat = np.asarray(st.latency_ms, dtype=float)
+            span = ((st.t_last - st.t_first)
+                    if st.t_last is not None and st.t_first is not None
+                    else 0.0)
+            qps = st.completed / span if span > 0 else 0.0
+            out = {
+                "serve_requests": st.completed,
+                "serve_rejected": st.rejected,
+                "serve_deadline_cancelled": st.cancelled,
+                "serve_failed": st.failed,
+                "serve_dispatches": st.dispatches,
+                "serve_realizations": st.realizations,
+                "serve_qps_per_chip": round(qps / self.n_devices, 3),
+                "serve_real_per_s_per_chip": round(
+                    st.realizations / span / self.n_devices
+                    if span > 0 else 0.0, 3),
+                "serve_p50_ms": round(float(np.percentile(lat, 50)), 3)
+                if lat.size else 0.0,
+                "serve_p99_ms": round(float(np.percentile(lat, 99)), 3)
+                if lat.size else 0.0,
+                "coalesce_factor": round(float(np.mean(st.coalesce)), 3)
+                if st.coalesce else 0.0,
+                "pad_waste_frac": round(float(np.mean(st.pad_waste)), 4)
+                if st.pad_waste else 0.0,
+                "queue_depth": st.queue_depth_max,
+                "serve_retraces": st.retraces,
+                "serve_steady_compiles": st.steady_compiles,
+                "serve_warm_s": round(st.warm_s, 3),
+            }
+        return out
+
+    def save_report(self, path) -> str:
+        """Write the pool's telemetry as a RunReport artifact: ``obs
+        summarize`` prints it, ``obs compare``/``obs gate`` band its SLO
+        metrics, ``obs trace`` renders the per-request spans."""
+        rep = self.report()
+        return rep.save(path)
+
+    def report(self):
+        from ..obs import RunReport
+
+        with self._lock:
+            timeline = list(self._timeline)
+            st = self._stats
+            total_s = ((st.t_last - self._t0)
+                       if st.t_last is not None else 0.0)
+        meta = {
+            "kind": "serve",
+            "platform": self.mesh.devices.flat[0].platform,
+            "n_devices": self.n_devices,
+            "mesh_shape": {k: int(v) for k, v in self.mesh.shape.items()},
+            "buckets": list(self._buckets),
+            "max_queue_depth": int(self.config.max_queue_depth),
+            "coalesce_window_s": float(self.config.coalesce_window_s),
+            "extra_metrics": self.slo_summary(),
+        }
+        rep = RunReport(meta=meta, total_s=total_s)
+        rep.timeline = sorted(timeline, key=lambda e: e.get("t0", 0.0))
+        return rep
+
+    # -- lifecycle ----------------------------------------------------------
+    def close(self, drain: bool = True) -> None:
+        """Shut down: ``drain=True`` serves everything already admitted
+        (new submissions raise ServeClosed), ``drain=False`` fails pending
+        requests with ServeClosed."""
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            if not drain:
+                for q in self._queues.values():
+                    while q:
+                        p = q.popleft()
+                        p.fut.set_exception(ServeClosed("pool closed"))
+                        self._pending -= 1
+            self._cond.notify_all()
+        self._dispatcher.join()
+        self._demux_q.put(_STOP)
+        self._demux_thread.join()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,215 @@
+"""Request and spec surface of the serving layer (docs/SERVING.md).
+
+A request names *what* to simulate (a spec), *how much* of it
+(``n`` realizations), and *whose stream* it is (``seed``) — nothing about
+executables, buckets, or batching. The scheduler owns those: requests with
+the same ``(spec_hash, lane token)`` coalesce into one padded chunk
+dispatch, and each request's results come from its own RNG lane
+(``fold_in(key(seed), i)``), so a response is bit-identical to
+``EnsembleSimulator.run(n, seed=seed)`` no matter how it was batched.
+
+Specs come in two forms: a declarative :class:`ArraySpec` (synthetic array
++ GWB parameters, hashed structurally — the CLI/JSON surface), or a name
+registered on the pool with a prebuilt :class:`EnsembleSimulator` (the
+embeddable multi-tenant surface). Both resolve to a stable ``spec_hash``
+via :func:`fakepta_tpu.obs.flightrec.spec_hash` — the same identity hash
+the crash flight recorder stamps on runs, so serve artifacts and engine
+artifacts group by configuration the same way.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from ..obs import flightrec
+
+#: default microbatch bucket ladder: geometric with ratio 2, so padding a
+#: cohort up to the next bucket wastes < 50% of slots in the worst case and
+#: the warm pool compiles O(log(max/min)) executables per lane config
+DEFAULT_BUCKETS: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024)
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class ServeBusy(ServeError):
+    """Admission rejected: the pending-request queue is at its configured
+    depth (the 429 of the serving layer — back off and retry)."""
+
+
+class ServeTimeout(ServeError):
+    """The request's deadline expired before its cohort dispatched (the
+    scheduler cancels not-yet-dispatched work only; a dispatched cohort
+    always completes)."""
+
+
+class ServeClosed(ServeError):
+    """The pool is shut down and admits no new requests."""
+
+
+@dataclasses.dataclass(frozen=True)
+class ArraySpec:
+    """Declarative synthetic-array + ensemble spec a request names.
+
+    The JSON-facing subset of what ``PulsarBatch.synthetic`` +
+    ``GWBConfig`` + ``EnsembleSimulator`` accept: enough to serve
+    simulation/detection/likelihood requests over a synthetic PTA. Richer
+    configurations (real arrays, sampled hyperpriors, CGW populations)
+    enter through :meth:`ServePool.register` with a prebuilt simulator.
+    ``gwb_orf=''`` disables the common signal. ``data_seed`` seeds the
+    array geometry, NOT the realization streams — those are per-request.
+    """
+
+    npsr: int = 20
+    ntoa: int = 156
+    tspan_years: float = 15.0
+    toaerr: float = 1e-7
+    n_red: int = 10
+    n_dm: int = 10
+    data_seed: int = 0
+    gwb_log10_A: float = float(np.log10(2e-15))
+    gwb_gamma: float = 13.0 / 3.0
+    gwb_ncomp: int = 10
+    gwb_orf: str = "hd"
+    nbins: int = 15
+
+    def spec_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["kind"] = "ArraySpec"
+        return d
+
+    def spec_hash(self) -> str:
+        """Stable identity of this spec (the warm-pool key ingredient) —
+        single-sourced with the flight recorder's run identity hash."""
+        return flightrec.spec_hash(self.spec_dict())
+
+    def build(self, mesh=None, compile_cache_dir=None):
+        """Construct the :class:`EnsembleSimulator` this spec describes."""
+        from .. import spectrum as spectrum_lib
+        from ..batch import PulsarBatch
+        from ..parallel.montecarlo import EnsembleSimulator, GWBConfig
+
+        batch = PulsarBatch.synthetic(
+            npsr=self.npsr, ntoa=self.ntoa, tspan_years=self.tspan_years,
+            toaerr=self.toaerr, n_red=self.n_red, n_dm=self.n_dm,
+            seed=self.data_seed)
+        gwb = None
+        if self.gwb_orf:
+            f = np.arange(1, self.gwb_ncomp + 1) / float(batch.tspan_common)
+            psd = np.asarray(spectrum_lib.powerlaw(
+                f, log10_A=self.gwb_log10_A, gamma=self.gwb_gamma))
+            gwb = GWBConfig(psd=psd, orf=self.gwb_orf)
+        return EnsembleSimulator(batch, gwb=gwb, mesh=mesh,
+                                 nbins=self.nbins,
+                                 compile_cache_dir=compile_cache_dir)
+
+
+SpecLike = Union[str, ArraySpec]
+
+
+@dataclasses.dataclass(frozen=True)
+class SimRequest:
+    """One user's simulation request: ``n`` realizations of ``spec`` drawn
+    from the request's own RNG lane (``seed``). ``deadline_s`` is relative
+    to submission; expired requests are cancelled *before* dispatch with
+    :class:`ServeTimeout` (dispatched work always completes)."""
+
+    spec: SpecLike
+    n: int
+    seed: int = 0
+    deadline_s: Optional[float] = None
+
+    kind = "sim"
+
+    def lane_token(self):
+        """Hashable executable-lane identity: requests coalesce only when
+        their (spec, lane token) match — one packed-extras layout and one
+        step executable per cohort."""
+        return ("sim",)
+
+    def run_kwargs(self) -> dict:
+        """The ``EnsembleSimulator.run``/``warm_start`` lane kwargs."""
+        return {}
+
+
+@dataclasses.dataclass(frozen=True)
+class OSRequest(SimRequest):
+    """A detection request: the on-device optimal-statistic lane rides the
+    cohort's chunk program; per-request ``amp2``/``snr`` (and, with
+    ``null=True``, the request's own paired-null calibration) come from the
+    request's slice alone, so results are cohort-independent."""
+
+    orf: Union[str, Sequence[str]] = "hd"
+    weighting: str = "noise"
+    null: bool = False
+
+    kind = "os"
+
+    def os_spec(self):
+        from ..detect import operators as detect_ops
+        orf = self.orf if isinstance(self.orf, str) else tuple(self.orf)
+        return detect_ops.as_spec(detect_ops.OSSpec(
+            orf=orf, weighting=self.weighting, null=bool(self.null)))
+
+    def lane_token(self):
+        spec = self.os_spec()
+        return ("os", spec.orfs, spec.weighting, bool(spec.null))
+
+    def run_kwargs(self) -> dict:
+        return {"os": self.os_spec()}
+
+
+@dataclasses.dataclass(frozen=True)
+class InferRequest(SimRequest):
+    """A likelihood request: the GP-marginalized Woodbury lnL lane
+    (``fakepta_tpu.infer``) evaluated at the request's theta grid for each
+    of its realizations. ``lnlike`` is an :class:`~fakepta_tpu.infer
+    .InferSpec`; requests sharing (spec, model, mode, theta) coalesce."""
+
+    lnlike: object = None
+
+    kind = "infer"
+
+    def lane_token(self):
+        if self.lnlike is None:
+            raise ValueError("InferRequest needs an InferSpec (lnlike=...)")
+        theta = np.asarray(self.lnlike.theta)
+        return ("infer", self.lnlike.model, self.lnlike.mode,
+                theta.shape, theta.tobytes())
+
+    def run_kwargs(self) -> dict:
+        return {"lnlike": self.lnlike}
+
+
+def curn_grid_spec(k: int = 4, log10_A=(-15.2, -14.2), gamma=(3.0, 6.0),
+                   nbin: int = 10):
+    """A small CURN (log10_A, gamma) grid InferSpec — the JSON-expressible
+    likelihood request (the CLI's ``"grid"`` form and the bench recipe)."""
+    from ..infer import (ComponentSpec, FreeParam, InferSpec, LikelihoodSpec,
+                         theta_grid)
+
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="red", spectrum="batch"),
+        ComponentSpec(target="dm", spectrum="batch"),
+        ComponentSpec(target="curn", nbin=nbin, free=(
+            FreeParam("log10_A", tuple(log10_A)),
+            FreeParam("gamma", tuple(gamma)))),
+    ))
+    return InferSpec(model=model, theta=theta_grid(model, k))
+
+
+def resolve_spec_hash(spec: SpecLike, named: dict) -> str:
+    """spec -> stable hash; named registrations resolve through ``named``."""
+    if isinstance(spec, str):
+        if spec not in named:
+            raise ServeError(f"unknown registered spec {spec!r}; "
+                             f"known: {sorted(named)}")
+        return named[spec]
+    if isinstance(spec, ArraySpec):
+        return spec.spec_hash()
+    raise TypeError(f"request spec must be a registered name or an "
+                    f"ArraySpec, got {type(spec).__name__}")

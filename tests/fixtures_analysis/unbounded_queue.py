@@ -1,0 +1,18 @@
+"""Seeded true positives + near misses for the unbounded-queue rule."""
+import collections
+import queue
+from collections import deque
+from queue import Queue
+
+bad_q = queue.Queue()                       # line 7: no bound
+bad_deque = collections.deque()             # line 8: no bound
+bad_zero = Queue(maxsize=0)                 # line 9: explicit unbounded
+bad_none = deque([1, 2], maxlen=None)       # line 10: explicit unbounded
+bad_simple = queue.SimpleQueue()            # line 11: no bounded form
+bad_lifo = queue.LifoQueue(-1)              # line 12: negative = unbounded
+
+ok_q = queue.Queue(maxsize=8)               # bounded: fine
+ok_pos = Queue(16)                          # bounded positionally: fine
+ok_deque = deque(maxlen=256)                # bounded: fine
+ok_var = queue.Queue(maxsize=len(ok_deque))  # variable bound: accepted
+allowed = collections.deque()  # fakepta: allow[unbounded-queue] drained each loop iteration by construction

@@ -111,6 +111,10 @@ def test_os_no_corr_fetch_and_validation(batch):
     assert as_spec(["hd", "dipole"]).orfs == ("hd", "dipole")
 
 
+@pytest.mark.slow   # ~36 s: heaviest tier-1 entry; the OS x mesh surface
+# stays covered by test_os_fused_pallas_matches_xla + the pipeline OS-lane
+# equivalence tests, and this full sweep rides the slow lane (ISSUE 9
+# tier-1 budget reclaim)
 def test_os_mesh_invariance(batch):
     """OS lanes under (real, psr, toa) shardings reproduce the single-device
     run: the contraction closes with the declared psums only."""

@@ -182,6 +182,9 @@ def test_mega_mesh_invariance(batch, mega_sim):
         np.testing.assert_allclose(other["autos"], o1["autos"], rtol=1e-5)
 
 
+@pytest.mark.slow   # ~27 s: the mega OS+null engine parity sweep; the
+# kernel-level OS slots stay covered by the f64 kernel oracle and the
+# fused-path OS tests in tier-1 (ISSUE 9 tier-1 budget reclaim)
 def test_mega_os_lanes_and_null(batch, mega_sim):
     """OS lanes ride the megernel's extra weight slots; the paired null
     stream runs its own kernel invocation with the GWB stage dropped.

@@ -439,6 +439,9 @@ def test_bf16_bases_parity_and_validation(small_batch):
         EnsembleSimulator(small_batch, gwb=cfg, mesh=mesh, bases_dtype="fp8")
 
 
+@pytest.mark.slow   # ~27 s: bf16 statistic certification also rides
+# test_megakernel.py::test_mega_bf16_certified_against_f32 in tier-1;
+# the XLA-path parity sweep moves to the slow lane (ISSUE 9 reclaim)
 def test_bf16_stats_parity_and_validation(small_batch):
     """stats_dtype='bf16' halves the (R, P, T) residual traffic through the
     all_gather + correlation contraction (the roofline's dominant bytes);

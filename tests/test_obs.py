@@ -326,14 +326,42 @@ def test_cli_gate_smoke_on_real_bench_history(tmp_path):
     assert "value" in strict.stdout
 
     # an accelerator-platform row finds no same-platform band in the
-    # committed history (r02 predates the platform field): informational,
-    # exit 0 — the cross-platform gating trap the MAD bands exist to avoid
+    # committed history (r02 predates the platform field): a clear
+    # "no comparable history" message and exit 0 — the cross-platform
+    # gating trap the MAD bands exist to avoid (ISSUE 9 satellite)
     tpu_row = dict(row, platform="tpu", value=48000.0)
     tpu = tmp_path / "tpu.json"
     tpu.write_text(json.dumps(tpu_row))
     cross = _cli("gate", str(tpu), "--fail-on-regression")
     assert cross.returncode == 0
-    assert "insufficient history" in cross.stdout
+    assert "no comparable history" in cross.stdout
+
+
+def test_cli_gate_empty_history_is_a_clear_noop(tmp_path):
+    """A fresh clone (no BENCH_r*.json anywhere) or a first accelerator
+    round after CPU stand-in rows must say "no comparable history" and
+    exit 0 even under --fail-on-regression, instead of printing a
+    confusing band-against-nothing table (ISSUE 9 satellite)."""
+    row = {"platform": "tpu", "value": 48000.0,
+           "steady_real_per_s_per_chip": 48105.0}
+    head = tmp_path / "head.json"
+    head.write_text(json.dumps(row))
+
+    # no history files at all: point --history at an empty directory glob
+    empty = _cli("gate", str(head), "--history",
+                 str(tmp_path / "BENCH_r*.json"), "--fail-on-regression")
+    assert empty.returncode == 0, empty.stdout + empty.stderr[-2000:]
+    assert "no comparable history" in empty.stdout
+    assert "0 same-platform" in empty.stdout
+
+    # history exists but only on another platform: same clear no-op
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"platform": "cpu", "value": 200.0}))
+    cross = _cli("gate", str(head), "--history",
+                 str(tmp_path / "BENCH_r*.json"), "--fail-on-regression")
+    assert cross.returncode == 0
+    assert "no comparable history" in cross.stdout
+    assert "1 loaded history row" in cross.stdout
 
 
 def test_cli_gate_bands_sampler_metrics(tmp_path):

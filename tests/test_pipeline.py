@@ -117,6 +117,9 @@ def test_pipelined_equals_serial_2x2x2_mesh(batch):
                                atol=1e-4 * scale)
 
 
+@pytest.mark.slow   # ~25 s: the psrterm bulk-prefetch equivalence runs a
+# CGW-sampled ensemble twice; depth equivalence of every other lane stays
+# tier-1 (test_pipelined_equals_serial_*) (ISSUE 9 budget reclaim)
 def test_pipeline_with_sampled_cgw_bulk_prefetch(batch):
     """The host-f64 psrterm bulk precompute prefetches chunk i+1 while chunk
     i computes; streams must stay bit-identical to the serial loop."""

@@ -1,0 +1,296 @@
+"""The serving layer (ISSUE 9): warm pool + microbatch coalescing.
+
+Lean by construction: one module-scoped pool serves every cohort-shaped
+case (each distinct (lane, bucket) executable compiles once), engine-level
+solo runs ride the same simulator's jit caches, and the failure-path tests
+(backpressure, deadlines, validation) are built to never compile anything.
+"""
+
+import numpy as np
+import pytest
+
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.serve import (ArraySpec, OSRequest, ServeBusy, ServeConfig,
+                               ServePool, ServeTimeout, SimRequest, WarmPool)
+
+SPEC = ArraySpec(npsr=6, ntoa=48, n_red=4, n_dm=4, gwb_ncomp=4)
+
+
+@pytest.fixture(scope="module")
+def served():
+    """One pool, every served case the module asserts on.
+
+    Cohorts are steered deterministically: the scheduler coalesces
+    whatever is queued when the window closes, so each phase submits its
+    requests together and waits before the next phase.
+    """
+    import jax
+
+    pool = ServePool(mesh=make_mesh(jax.devices()[:1]),
+                     config=ServeConfig(buckets=(8, 16),
+                                        coalesce_window_s=0.05,
+                                        max_queue_depth=32))
+    out = {"pool": pool}
+    # phase 1: A+B coalesce into one bucket-8 dispatch (5+3 fills it)
+    fa = pool.submit(SimRequest(spec=SPEC, n=5, seed=11))
+    fb = pool.submit(SimRequest(spec=SPEC, n=3, seed=22))
+    out["A"], out["B"] = fa.result(timeout=300), fb.result(timeout=300)
+    # phase 2: the same request as A served ALONE (3 padding slots)
+    out["A_alone"] = pool.serve(SimRequest(spec=SPEC, n=5, seed=11),
+                                timeout=300)
+    # phase 3: the same request again, in a bucket-16 cohort (different
+    # batchmate, different pad shape)
+    fa2 = pool.submit(SimRequest(spec=SPEC, n=5, seed=11))
+    fc = pool.submit(SimRequest(spec=SPEC, n=9, seed=33))
+    out["A_b16"], out["C"] = fa2.result(timeout=300), fc.result(timeout=300)
+    # phase 4: a detection request with on-device null calibration
+    out["OS"] = pool.serve(OSRequest(spec=SPEC, n=4, seed=44, null=True),
+                           timeout=300)
+    # phase 5: the multi-tenant surface — the SAME simulator registered by
+    # name serves from its already-warm executables
+    entry = pool._pool.get(SPEC.spec_hash(), SPEC)
+    out["entry"] = entry
+    pool.register("tenant", entry.sim)
+    out["named"] = pool.serve(SimRequest(spec="tenant", n=3, seed=22),
+                              timeout=300)
+    yield out
+    pool.close()
+
+
+def test_coalesced_request_is_bit_identical_to_solo_run(served):
+    """The RNG-lane contract, both layers: bit-identical to the same
+    request served ALONE at the same bucket shape (cohort/pad/slot cannot
+    change a response), and equal to the classic solo ``run(n, seed)`` at
+    the engine's reduction tolerance (XLA's statistic-reduction order is
+    executable-shape-dependent — drawn streams are bit-identical, the
+    binned reduction may differ in the last ULP between shapes)."""
+    sim = served["entry"].sim
+    alone_a = sim.run(8, chunk=8, lanes=[(11, 5)], pipeline_depth=0)
+    alone_b = sim.run(8, chunk=8, lanes=[(22, 3)], pipeline_depth=0)
+    assert np.array_equal(served["A"].curves, alone_a["curves"][:5])
+    assert np.array_equal(served["A"].autos, alone_a["autos"][:5])
+    assert np.array_equal(served["B"].curves, alone_b["curves"][:3])
+    solo_a = sim.run(5, seed=11, chunk=5, pipeline_depth=0)
+    scale = np.abs(solo_a["curves"]).max()
+    np.testing.assert_allclose(served["A"].curves, solo_a["curves"],
+                               rtol=1e-5, atol=1e-5 * scale)
+    assert served["A"].cohort_requests == 2
+    assert served["A"].bucket == 8
+    assert served["A"].pad_waste_frac == 0.0          # 5 + 3 fills it
+
+
+def test_cohort_pad_and_bucket_invariance(served):
+    """Identical request => bit-identical result when served alone (padded
+    cohort of one) at the same bucket; a different-bucket cohort agrees at
+    reduction tolerance (different executable shape)."""
+    assert np.array_equal(served["A_alone"].curves, served["A"].curves)
+    assert np.array_equal(served["A_alone"].autos, served["A"].autos)
+    assert served["A_alone"].cohort_requests == 1
+    assert served["A_alone"].pad_waste_frac > 0.0     # 3 padded slots
+    assert served["A_b16"].bucket == 16
+    scale = np.abs(served["A"].curves).max()
+    np.testing.assert_allclose(served["A_b16"].curves, served["A"].curves,
+                               rtol=1e-5, atol=1e-7 * scale)
+    np.testing.assert_allclose(served["A_b16"].autos, served["A"].autos,
+                               rtol=1e-5)
+
+
+def test_registered_tenant_serves_identically(served):
+    assert np.array_equal(served["named"].curves, served["B"].curves)
+    assert np.array_equal(served["named"].autos, served["B"].autos)
+
+
+def test_os_request_is_cohort_independent(served):
+    """A detection request's statistics — including its paired-null
+    calibration — are re-assembled from the request's own slice: bit-equal
+    to the same request served alone at the same bucket, and matching the
+    classic solo run at reduction tolerance."""
+    from fakepta_tpu.detect.operators import OSSpec
+
+    sim = served["entry"].sim
+    os_spec = OSSpec(orf="hd", null=True)
+    alone = sim.run(8, chunk=8, lanes=[(44, 4)], pipeline_depth=0,
+                    os=os_spec)
+    got = served["OS"].os["stats"]["hd"]
+    want = alone["os"]["stats"]["hd"]
+    np.testing.assert_array_equal(got["amp2"], want["amp2"][:4])
+    np.testing.assert_array_equal(got["null_amp2"], want["null_amp2"][:4])
+    solo = sim.run(4, seed=44, chunk=4, pipeline_depth=0, os=os_spec)
+    np.testing.assert_allclose(got["amp2"], solo["os"]["stats"]["hd"]["amp2"],
+                               rtol=1e-5)
+    # the per-request re-assembly itself: p-values/sigma from the
+    # request's OWN 4-realization null sample, not the cohort's
+    rank = np.searchsorted(np.sort(got["null_amp2"]), got["amp2"],
+                           side="left")
+    want_p = (1.0 + 4 - rank) / 5.0
+    np.testing.assert_allclose(got["p_value"], want_p)
+
+
+def test_mesh_shape_invariance_2x2x2(served):
+    """The same request served by a 2x2x2-mesh pool reproduces the
+    single-device response at the engine's mesh-invariance tolerance (the
+    lane keys are bit-identical; only psum order differs)."""
+    import jax
+
+    pool = ServePool(mesh=make_mesh(jax.devices(), psr_shards=2,
+                                    toa_shards=2),
+                     config=ServeConfig(buckets=(8,),
+                                        coalesce_window_s=0.01))
+    try:
+        res = pool.serve(SimRequest(spec=SPEC, n=5, seed=11), timeout=300)
+    finally:
+        pool.close()
+    scale = np.abs(served["A"].curves).max()
+    np.testing.assert_allclose(res.curves, served["A"].curves,
+                               rtol=1e-5, atol=1e-4 * scale)
+    np.testing.assert_allclose(res.autos, served["A"].autos, rtol=1e-5)
+
+
+def test_zero_recompiles_after_warmup(served):
+    """The warm-pool acceptance: after each (lane, bucket) pair's first
+    dispatch, no retraces and no steady-state compiles — every later
+    request reuses the pooled executable."""
+    slo = served["pool"].slo_summary()
+    assert slo["serve_retraces"] == 0
+    assert slo["serve_steady_compiles"] == 0
+    assert slo["serve_requests"] >= 6
+    assert slo["coalesce_factor"] > 1.0
+
+
+def test_slo_report_roundtrips_through_obs(served, tmp_path):
+    """The pool's telemetry is a first-class obs artifact: RunReport
+    save/load, per-request timeline spans, SLO metrics under summary."""
+    from fakepta_tpu.obs import RunReport
+
+    path = tmp_path / "serve.jsonl"
+    served["pool"].save_report(path)
+    rep = RunReport.load(path)
+    assert rep.meta["kind"] == "serve"
+    summ = rep.summary()
+    assert summ["serve_requests"] >= 6
+    assert summ["serve_p50_ms"] > 0
+    kinds = {e.get("name") for e in rep.timeline}
+    assert {"request", "serve_dispatch"} <= kinds
+
+
+def test_serve_metric_directions_gate_and_compare():
+    """serve metrics are direction-aware in obs: throughput/coalescing
+    down = regression, latency up = regression, queue depth exempt."""
+    from fakepta_tpu.obs.gate import gate_row
+    from fakepta_tpu.obs.report import metric_exempt, metric_higher_is_better
+
+    assert metric_higher_is_better("serve_qps_per_chip")
+    assert metric_higher_is_better("coalesce_factor")
+    assert metric_higher_is_better("serve_speedup_x")
+    assert not metric_higher_is_better("serve_p50_ms")
+    assert not metric_higher_is_better("serve_p99_ms")
+    assert not metric_higher_is_better("pad_waste_frac")
+    assert metric_exempt("queue_depth")
+
+    hist = [{"platform": "cpu", "serve_qps_per_chip": 1000.0 * j,
+             "serve_p99_ms": 20.0, "queue_depth": 48} for j in (0.98, 1.02)]
+    head = {"platform": "cpu", "serve_qps_per_chip": 400.0,
+            "serve_p99_ms": 80.0, "queue_depth": 300}
+    verdicts = {r.metric: r.verdict for r in gate_row(head, hist)}
+    assert verdicts["serve_qps_per_chip"] == "regression"
+    assert verdicts["serve_p99_ms"] == "regression"
+    assert verdicts["queue_depth"] == "info"
+
+
+def test_backpressure_deadline_and_validation():
+    """Admission control without ever compiling: a long coalesce window
+    holds requests queued, so ServeBusy/ServeTimeout surface before any
+    dispatch happens."""
+    import jax
+
+    pool = ServePool(mesh=make_mesh(jax.devices()[:1]),
+                     config=ServeConfig(buckets=(8,), max_queue_depth=2,
+                                        coalesce_window_s=30.0))
+    try:
+        f1 = pool.submit(SimRequest(spec=SPEC, n=2, seed=1,
+                                    deadline_s=0.05))
+        f2 = pool.submit(SimRequest(spec=SPEC, n=2, seed=2,
+                                    deadline_s=0.05))
+        # the queue is at depth 2: 429-style rejection, synchronous
+        with pytest.raises(ServeBusy):
+            pool.submit(SimRequest(spec=SPEC, n=2, seed=3))
+        # a request larger than the ladder is unserveable
+        with pytest.raises(ValueError, match="bucket ladder"):
+            pool.submit(SimRequest(spec=SPEC, n=64, seed=4))
+        # unregistered named spec
+        from fakepta_tpu.serve import ServeError
+        with pytest.raises(ServeError, match="unknown registered spec"):
+            pool.submit(SimRequest(spec="nope", n=2, seed=5))
+        # both queued requests expire inside the window: cancelled with
+        # ServeTimeout, never dispatched (nothing was ever compiled)
+        with pytest.raises(ServeTimeout):
+            f1.result(timeout=60)
+        with pytest.raises(ServeTimeout):
+            f2.result(timeout=60)
+        slo = pool.slo_summary()
+        assert slo["serve_rejected"] == 1
+        assert slo["serve_deadline_cancelled"] == 2
+        assert slo["serve_dispatches"] == 0
+    finally:
+        pool.close()
+
+
+def test_warm_pool_and_manual_warm_start_share_cache_entry(tmp_path):
+    """ISSUE 9 satellite: the spec-hash/executable-key selection is one
+    shared helper (_exec_plan), so a serve bucket prewarm and a manual
+    ``warm_start(bucket, lane_keys=True)`` of the same spec hit the SAME
+    persistent-compile-cache entry — the second compiles nothing new."""
+    import jax
+
+    cache = tmp_path / "compile_cache"
+    spec = ArraySpec(npsr=4, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                     data_seed=7)
+    mesh = make_mesh(jax.devices()[:1])
+
+    wp = WarmPool(mesh, compile_cache_dir=str(cache))
+    entry = wp.get(spec.spec_hash(), spec)
+    wp.prewarm(entry, (8,))
+    files_after_pool = sorted(f.name for f in cache.glob("*"))
+    assert files_after_pool, "prewarm wrote nothing to the compile cache"
+
+    # a FRESH simulator of the same spec, manually warm-started: the
+    # shared executable-key path must land on the existing cache entries
+    sim = spec.build(mesh=mesh, compile_cache_dir=str(cache))
+    sim.warm_start(8, lane_keys=True)
+    files_after_manual = sorted(f.name for f in cache.glob("*"))
+    assert files_after_manual == files_after_pool, (
+        "manual warm_start of the same spec/bucket compiled a NEW "
+        "executable — the warm pool and warm_start diverged")
+
+
+def test_lane_arrays_validation():
+    """run(lanes=...) rejects malformed cohorts up front."""
+    from fakepta_tpu.parallel.montecarlo import _lane_arrays
+
+    seeds, within = _lane_arrays([(11, 3), (22, 2)], 8)
+    assert seeds.tolist() == [11, 11, 11, 22, 22, 0, 0, 0]
+    assert within.tolist() == [0, 1, 2, 0, 1, 5, 6, 7]
+    with pytest.raises(ValueError, match="slots"):
+        _lane_arrays([(1, 9)], 8)
+    with pytest.raises(ValueError, match="seed"):
+        _lane_arrays([(-3, 2)], 8)
+    with pytest.raises(ValueError, match="> 0"):
+        _lane_arrays([(1, 0)], 8)
+
+
+def test_loadgen_json_cli_request_parsing():
+    """The stdin/socket JSON surface builds the right request objects."""
+    from fakepta_tpu.serve.cli import request_from_json
+
+    default = SPEC
+    r = request_from_json({"n": 4, "seed": 9}, default)
+    assert isinstance(r, SimRequest) and r.spec is default
+    r = request_from_json({"kind": "os", "n": 2, "orf": "dipole",
+                          "null": True, "deadline_ms": 250}, default)
+    assert isinstance(r, OSRequest)
+    assert r.orf == "dipole" and r.null and r.deadline_s == 0.25
+    r = request_from_json({"kind": "infer", "n": 2,
+                           "grid": {"k": 2, "nbin": 3}}, default)
+    assert r.lnlike.theta.shape[0] == 4          # k^2 grid points
+    with pytest.raises(ValueError, match="unknown request kind"):
+        request_from_json({"kind": "wat", "n": 1}, default)

@@ -62,6 +62,10 @@ CASES = [
     ("timing_clock.py", LIB,
      {("timing-discipline", 9), ("timing-discipline", 11),
       ("timing-discipline", 15)}),
+    ("unbounded_queue.py", LIB,
+     {("unbounded-queue", 7), ("unbounded-queue", 8),
+      ("unbounded-queue", 9), ("unbounded-queue", 10),
+      ("unbounded-queue", 11), ("unbounded-queue", 12)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -107,6 +111,9 @@ def test_dtype_policy_paths_exist():
             f"stale BF16_STORAGE_MODULES entry: {rel}"
     for rel in policy.TIMING_MODULES:
         assert (REPO / rel).is_file(), f"stale TIMING_MODULES entry: {rel}"
+    for rel in policy.UNBOUNDED_QUEUE_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale UNBOUNDED_QUEUE_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
