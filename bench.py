@@ -91,6 +91,17 @@ RunReport each ``sim.run()`` attaches):
   steady-compile counters must stay 0 — a warm-pool request never pays a
   recompile after warmup. The accelerator lane serves the flagship-sized
   spec; the CPU stand-in a reduced one (``platform`` disambiguates);
+- ``faults_retries`` / ``faults_degradations`` / ``faults_rollbacks``: the
+  measured run's recovery counters (``fakepta_tpu.faults``,
+  docs/RELIABILITY.md) — transient dispatch/drain retries, degradation-
+  ladder steps (mega->fused->xla, bf16->f32, donation-off) and torn-
+  checkpoint rollbacks that engaged during the benchmark. All three are
+  expected 0 on a healthy round; any growth past the zero history flags
+  under ``obs gate``, because a benchmark number produced THROUGH the
+  recovery ladder is not a clean steady-state figure.
+  ``benchmarks/suite.py`` config 12 additionally measures the recovery
+  overhead itself (``fault_recovery_overhead_frac``: wall-clock cost of
+  one injected-and-retried transient per run, bit-identity asserted);
 - ``peak_hbm_bytes``: the measured run's HBM watermark from the RunReport's
   memwatch lane (allocator ``peak_bytes_in_use`` max-aggregated over local
   devices and over the low-rate in-run sampler where the backend exposes
@@ -195,6 +206,15 @@ def main():
     row["ckpt_wait_s"] = rep_sum.get("ckpt_wait_s", 0.0)
     if rep_sum.get("peak_hbm_bytes"):
         row["peak_hbm_bytes"] = rep_sum["peak_hbm_bytes"]
+    # recovery health (fakepta_tpu.faults, docs/RELIABILITY.md): the
+    # measured run's recovery counters. Nonzero means the engine retried,
+    # degraded or rolled back mid-benchmark — the throughput figure is
+    # then not a clean steady-state number (lower-is-better under
+    # `obs compare`/`gate`, and any growth past the zero history flags)
+    for key, counter in (("faults_retries", "faults.retries"),
+                         ("faults_degradations", "faults.degradations"),
+                         ("faults_rollbacks", "faults.rollbacks")):
+        row[key] = int(rep.counters.get(counter, 0))
 
     # the detection lane (fakepta_tpu.detect): same flagship program with the
     # on-device optimal statistic packed beside curves/autos — measured

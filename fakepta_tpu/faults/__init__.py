@@ -1,0 +1,37 @@
+"""fakepta_tpu.faults — deterministic fault injection + engine recovery.
+
+Two halves (docs/RELIABILITY.md):
+
+- the **chaos harness** (:mod:`.plan`): a seeded :class:`FaultPlan` arms
+  named sites threaded through the engine (chunk dispatch/drain, the
+  pipeline writer, checkpoint appends, compile-cache load, serve dispatch,
+  sampler segments) and fires scripted faults — transient errors, NaN
+  poisoning, torn checkpoint writes, hung drains, simulated kills — at
+  deterministic hit indices, each mirrored into the crash flight recorder;
+- the **recovery policy** (:mod:`.recovery`): bounded exponential-backoff
+  retry that re-dispatches the same RNG lanes (bit-identical at the same
+  executable shape), the degradation ladders (``mega -> fused -> xla`` on
+  Pallas failure, ``bf16 -> f32`` on certification failure, donation-off
+  on a broken recycle, serve warm-pool eviction of a poisoned executable),
+  and the per-chunk watchdog deadline that dumps the flight recorder and
+  aborts hung dispatches.
+
+The contract the chaos tests (tests/test_faults.py) assert: every injected
+fault either **recovers** — packed streams bit-identical to the unfaulted
+run at the same executable shape, tolerance-certified when a degradation
+changes the shape — or **fails loudly** with a flight-recorder dump.
+Silent corruption is never an outcome.
+"""
+
+from .plan import (FaultError, FaultPlan, FaultSpec, DegradeFault,
+                   FatalFault, KillFault, PrecisionFault, TransientFault,
+                   WatchdogTimeout, active, check, inject)
+from .recovery import (DISABLED, PATH_LADDER, RecoveryPolicy, as_policy,
+                       classify, sleep)
+
+__all__ = [
+    "DISABLED", "DegradeFault", "FatalFault", "FaultError", "FaultPlan",
+    "FaultSpec", "KillFault", "PATH_LADDER", "PrecisionFault",
+    "RecoveryPolicy", "TransientFault", "WatchdogTimeout", "active",
+    "as_policy", "check", "classify", "inject", "sleep",
+]

@@ -1,0 +1,220 @@
+"""Deterministic fault injection: a seeded plan arming named engine sites.
+
+Chaos testing for the engine (docs/RELIABILITY.md): a :class:`FaultPlan`
+arms **named sites** threaded through the hot paths — the chunk dispatch
+and drain of :meth:`EnsembleSimulator.run`, the pipeline writer thread,
+checkpoint appends, the persistent-compile-cache wiring, the serve
+dispatcher, the sampler's segment step — and fires scripted faults at
+exact, reproducible hit indices. Every fired fault is mirrored into the
+crash flight recorder (``obs.flightrec``) and counted
+(``faults.injected``), so a chaos run's telemetry shows precisely what was
+injected where.
+
+The plan is **deterministic by construction**: each site keeps a per-plan
+hit counter, and a :class:`FaultSpec` names the hit indices (``at``) that
+fire. Two runs under the same plan inject the same faults at the same
+sites in the same order — which is what lets the chaos tests assert the
+recovered run's packed streams *bit-identical* to the unfaulted run.
+
+Sites in the engine (the canonical list, docs/RELIABILITY.md):
+
+========================  ====================================================
+site                      where it is checked
+========================  ====================================================
+``mc.dispatch``           montecarlo.run, before each chunk dispatch
+``mc.recycle``            montecarlo.run, the donated-scratch recycle check
+``pipeline.writer``       the per-chunk/segment drain (writer thread)
+``ckpt.append``           EnsembleCheckpoint/SampleCheckpoint ``save``
+``cache.load``            pipeline.configure_compile_cache
+``serve.dispatch``        ServePool's dispatcher thread, per cohort
+``sample.segment``        SamplingRun.run, before each segment dispatch
+========================  ====================================================
+
+Fault kinds: ``transient`` / ``fatal`` raise (:class:`TransientFault` /
+:class:`FatalFault`); ``degrade`` / ``precision`` raise the ladder triggers
+(:class:`DegradeFault` — a Pallas compile/runtime failure stand-in — and
+:class:`PrecisionFault` — a bf16 certification failure); ``kill`` raises
+:class:`KillFault` (a ``BaseException``: simulated process death, never
+caught by recovery); ``hang`` sleeps ``hang_s`` at the site (a stuck drain
+the watchdog must catch); ``poison`` / ``torn`` / ``donation`` return the
+kind string so the site applies the corruption itself (NaN the dispatched
+output, tear the checkpoint write, fake a failed donation).
+
+No plan installed means every site check is one global read and a ``None``
+return — the harness costs nothing in production.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Optional, Sequence, Tuple
+
+from ..obs import flightrec
+
+#: fault kinds that raise at the site
+_RAISING_KINDS = ("transient", "fatal", "degrade", "precision", "kill")
+#: fault kinds returned to the site for in-place corruption
+_ACTING_KINDS = ("poison", "torn", "donation", "hang")
+KINDS = _RAISING_KINDS + _ACTING_KINDS
+
+
+class FaultError(RuntimeError):
+    """Base class of every injected (raising) fault."""
+
+
+class TransientFault(FaultError):
+    """A retryable failure (the injected stand-in for preemptions, evicted
+    executables, transient RPC errors); recovery retries with backoff."""
+
+
+class FatalFault(FaultError):
+    """A non-retryable failure: recovery must fail loudly, never mask it."""
+
+
+class DegradeFault(FaultError):
+    """A Pallas/megakernel compile-or-runtime failure stand-in: recovery
+    steps down the statistic-path ladder (mega -> fused -> xla)."""
+
+
+class PrecisionFault(FaultError):
+    """A bf16 certification failure stand-in: recovery re-dispatches the
+    chunk at f32."""
+
+
+class KillFault(BaseException):
+    """Simulated process death (SIGKILL analog) — derives from
+    ``BaseException`` so no recovery path can swallow it; the kill-resume
+    chaos tests raise it mid-checkpoint-write."""
+
+
+class WatchdogTimeout(RuntimeError):
+    """A per-chunk watchdog deadline expired: the oldest in-flight drain
+    never completed. The engine dumps the flight recorder and aborts."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FaultSpec:
+    """One armed site: fire ``kind`` at the site's hit indices ``at``.
+
+    ``at`` is a tuple of 0-based per-site hit counters (the Nth time the
+    engine reaches the site under this plan); ``times`` caps total fires
+    (default: one per ``at`` entry). ``hang_s`` is the sleep of a ``hang``
+    fault — size it against the watchdog deadline under test.
+    """
+
+    site: str
+    kind: str = "transient"
+    at: Tuple[int, ...] = (0,)
+    times: Optional[int] = None
+    hang_s: float = 2.0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"known: {KINDS}")
+        object.__setattr__(self, "at", tuple(int(i) for i in self.at))
+
+
+class FaultPlan:
+    """A deterministic schedule of faults over named sites.
+
+    >>> plan = FaultPlan([FaultSpec("mc.dispatch", "transient", at=(1,))])
+    >>> with fakepta_tpu.faults.inject(plan):
+    ...     sim.run(...)        # chunk 1's dispatch fails once, is retried
+
+    ``hits``/``fired`` record what actually happened (the chaos tests
+    assert on them); both are plain host bookkeeping.
+    """
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0):
+        self.specs = tuple(specs)
+        self.seed = int(seed)
+        self.hits: dict = {}          # site -> times the site was reached
+        self.fired: list = []         # (site, kind, hit_index) in fire order
+        self._remaining = {id(s): (len(s.at) if s.times is None else s.times)
+                           for s in self.specs}
+
+    def sites(self) -> Tuple[str, ...]:
+        return tuple(sorted({s.site for s in self.specs}))
+
+    def hit(self, site: str, **ctx) -> Optional[str]:
+        """One site visit: fire any armed spec whose ``at`` matches.
+
+        Raising kinds raise; acting kinds return the kind string for the
+        site to apply. Every fire is flight-recorded and counted.
+        """
+        idx = self.hits.get(site, 0)
+        self.hits[site] = idx + 1
+        for spec in self.specs:
+            if spec.site != site or idx not in spec.at:
+                continue
+            if self._remaining[id(spec)] <= 0:
+                continue
+            self._remaining[id(spec)] -= 1
+            self.fired.append((site, spec.kind, idx))
+            flightrec.note("fault_fired", site=site, kind=spec.kind,
+                           hit=idx, **{k: v for k, v in ctx.items()
+                                       if isinstance(v, (int, float, str))})
+            from ..obs import count as _count
+            _count("faults.injected")
+            if spec.kind == "transient":
+                raise TransientFault(f"injected transient fault at {site} "
+                                     f"(hit {idx})")
+            if spec.kind == "fatal":
+                raise FatalFault(f"injected fatal fault at {site} "
+                                 f"(hit {idx})")
+            if spec.kind == "degrade":
+                raise DegradeFault(f"injected pallas failure at {site} "
+                                   f"(hit {idx})")
+            if spec.kind == "precision":
+                raise PrecisionFault(f"injected bf16 certification failure "
+                                     f"at {site} (hit {idx})")
+            if spec.kind == "kill":
+                raise KillFault(f"injected process kill at {site} "
+                                f"(hit {idx})")
+            if spec.kind == "hang":
+                time.sleep(spec.hang_s)
+                return "hang"
+            return spec.kind          # poison / torn / donation
+        return None
+
+
+# process-wide active plan: a single slot, installed by inject(). Reads are
+# unlocked (one global load on the hot path); tests install one plan at a
+# time, and the writer/serve threads only ever read it.
+_ACTIVE: Optional[FaultPlan] = None
+
+
+def active() -> Optional[FaultPlan]:
+    """The installed plan, if any."""
+    return _ACTIVE
+
+
+def check(site: str, **ctx) -> Optional[str]:
+    """Site hook: fire any armed fault at ``site`` (see FaultPlan.hit).
+
+    Returns ``None`` with no plan installed — a single global read, so the
+    harness is free when idle.
+    """
+    plan = _ACTIVE
+    if plan is None:
+        return None
+    return plan.hit(site, **ctx)
+
+
+@contextlib.contextmanager
+def inject(plan: FaultPlan):
+    """Install ``plan`` process-wide for the scope of the context."""
+    global _ACTIVE
+    if _ACTIVE is not None:
+        raise RuntimeError("a FaultPlan is already installed; nest-injecting "
+                           "plans would make the hit counters ambiguous")
+    flightrec.note("fault_plan_armed", sites=",".join(plan.sites()),
+                   seed=plan.seed)
+    _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        _ACTIVE = None

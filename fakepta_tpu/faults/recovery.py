@@ -1,0 +1,113 @@
+"""Recovery policy: bounded retry, degradation ladders, failure triage.
+
+One policy object (:class:`RecoveryPolicy`) governs every recovery site in
+the engine (docs/RELIABILITY.md):
+
+- **retry**: transient dispatch/drain failures are retried up to
+  ``max_retries`` times with exponential backoff (``backoff_s`` doubling by
+  ``backoff_mult`` up to ``max_backoff_s``). A retried chunk/segment
+  re-dispatches the *same* RNG lanes at the same offsets — per-realization
+  keys fold absolute indices, so the retried chunk is bit-identical to the
+  unfaulted run at the same executable shape.
+- **degradation ladders**: a Pallas/megakernel compile-or-runtime failure
+  steps the statistic path down :data:`PATH_LADDER` (``mega -> fused ->
+  xla``); a bf16 certification failure re-dispatches at f32; a broken
+  donated-buffer recycle turns donation off for the rest of the run (the
+  ``pipeline_depth -> 0`` analog: depth bounding stays, the peak-HBM claim
+  is withdrawn). Degraded dispatches change the executable shape, so their
+  streams certify at the engine's mesh-invariance tolerance instead of
+  bit-identity (the shape-dependent-reduction rule, docs/INVARIANTS.md).
+- **watchdog**: ``watchdog_s`` arms a per-chunk deadline on the oldest
+  in-flight drain; expiry dumps the flight recorder and aborts the run
+  with :class:`~fakepta_tpu.faults.WatchdogTimeout` (pipelined runs only —
+  the serial loop drains inline on the dispatch thread).
+
+:func:`classify` is the failure triage shared by every site: injected
+fault types map directly; real-world exceptions match conservative message
+patterns (RPC-ish transients, Pallas/Mosaic compiles). Anything
+unrecognized is ``fatal`` — recovery must never retry blindly.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Optional
+
+from .plan import DegradeFault, FatalFault, KillFault, PrecisionFault, \
+    TransientFault
+
+#: statistic-path degradation ladder: on a Pallas compile/runtime failure
+#: the run steps down one rung and re-dispatches (docs/RELIABILITY.md)
+PATH_LADDER = {"mega": "fused", "fused": "xla"}
+
+# conservative message fingerprints of retryable runtime failures (RPC /
+# allocator transients a re-dispatch can outlive); matched case-insensitive
+_TRANSIENT_PATTERNS = ("resource_exhausted", "resource exhausted",
+                       "unavailable", "deadline_exceeded", "deadline "
+                       "exceeded", "aborted", "connection reset",
+                       "socket closed", "preempt")
+# fingerprints of a failing Pallas/Mosaic lowering or kernel
+_PALLAS_PATTERNS = ("pallas", "mosaic")
+
+
+@dataclasses.dataclass(frozen=True)
+class RecoveryPolicy:
+    """Knobs for the engine-wide recovery ladder (module docstring)."""
+
+    max_retries: int = 2
+    backoff_s: float = 0.05
+    backoff_mult: float = 2.0
+    max_backoff_s: float = 2.0
+    degrade_paths: bool = True        # mega -> fused -> xla
+    degrade_precision: bool = True    # bf16 -> f32
+    degrade_pipeline: bool = True     # donation off on a broken recycle
+    watchdog_s: Optional[float] = None
+
+    def next_backoff(self, delay: float) -> float:
+        return min(delay * self.backoff_mult, self.max_backoff_s)
+
+
+#: recovery disabled: no retries, no ladders, no watchdog — every failure
+#: propagates like the pre-recovery engine (run(recovery=False))
+DISABLED = RecoveryPolicy(max_retries=0, backoff_s=0.0,
+                          degrade_paths=False, degrade_precision=False,
+                          degrade_pipeline=False, watchdog_s=None)
+
+
+def as_policy(recovery) -> RecoveryPolicy:
+    """Normalize the ``run(recovery=...)`` argument: ``None`` -> defaults,
+    ``False`` -> :data:`DISABLED`, a policy -> itself."""
+    if recovery is None:
+        return RecoveryPolicy()
+    if recovery is False:
+        return DISABLED
+    if isinstance(recovery, RecoveryPolicy):
+        return recovery
+    raise TypeError(f"recovery must be None, False or a RecoveryPolicy, "
+                    f"got {type(recovery).__name__}")
+
+
+def classify(exc: BaseException) -> str:
+    """Triage one failure: 'transient' | 'pallas' | 'precision' | 'fatal'."""
+    if isinstance(exc, TransientFault):
+        return "transient"
+    if isinstance(exc, DegradeFault):
+        return "pallas"
+    if isinstance(exc, PrecisionFault):
+        return "precision"
+    if isinstance(exc, (FatalFault, KillFault)):
+        return "fatal"
+    msg = f"{type(exc).__name__}: {exc}".lower()
+    if any(p in msg for p in _PALLAS_PATTERNS):
+        return "pallas"
+    if any(p in msg for p in _TRANSIENT_PATTERNS):
+        return "transient"
+    return "fatal"
+
+
+def sleep(seconds: float) -> None:
+    """Backoff sleep (a hook the chaos tests could stub; bounded by the
+    policy's ``max_backoff_s``)."""
+    if seconds > 0:
+        time.sleep(seconds)

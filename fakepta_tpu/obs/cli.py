@@ -115,7 +115,11 @@ def _cmd_gate(args) -> int:
 
     new_row = gate_mod.load_row(args.row)
     hist_paths = gate_mod.resolve_history(args.history)
-    history = gate_mod.load_history(hist_paths)
+    # malformed / schema-partial / crashed history rows are skipped with a
+    # visible warning, never a traceback: a gate that dies on one corrupt
+    # BENCH row silently stops gating everything else
+    history = gate_mod.load_history(
+        hist_paths, warn=lambda m: print(f"warning: {m}", file=sys.stderr))
     platform = new_row.get("platform")
     n_same = len([r for r in history if r.get("platform") == platform])
     if n_same == 0:

@@ -39,6 +39,7 @@ from __future__ import annotations
 import glob
 import json
 import statistics
+import warnings
 from dataclasses import dataclass
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -83,17 +84,28 @@ def load_row(path) -> dict:
     return row
 
 
-def load_history(paths: Sequence) -> List[dict]:
-    """Parse history rows, silently dropping unparseable/crashed rounds
-    (a round that produced no row cannot band anything)."""
+def load_history(paths: Sequence, warn=None) -> List[dict]:
+    """Parse history rows, dropping unparseable/crashed rounds WITH a
+    warning (a round that produced no row cannot band anything, but a
+    silently-vanishing history file is how a gate quietly stops gating).
+
+    ``warn`` is a ``callable(str)`` (the CLI prints to stderr); the default
+    routes through :mod:`warnings` so library callers see it too.
+    """
+    if warn is None:
+        warn = lambda m: warnings.warn(m, RuntimeWarning, stacklevel=3)  # noqa: E731
     rows: List[dict] = []
     for p in paths:
         try:
             row = parse_row(Path(p).read_text())
-        except (OSError, ValueError, json.JSONDecodeError):
+        except (OSError, ValueError, json.JSONDecodeError) as exc:
+            warn(f"skipping malformed history row {p}: {exc}")
             continue
         if row:
             rows.append(row)
+        else:
+            warn(f"skipping history row {p}: crashed round "
+                 f"(parsed=null) or empty row")
     return rows
 
 

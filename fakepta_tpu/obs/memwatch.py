@@ -141,9 +141,25 @@ class PackedLedger:
         self.fresh_allocs = 0
         self.recycles = 0
         self.donation_misses = 0
+        self.replacements = 0
+        self.degraded = False
 
     def alloc(self) -> None:
         self.fresh_allocs += 1
+
+    def alloc_replacement(self) -> None:
+        """A retry replaced a donated-and-consumed scratch buffer: the old
+        buffer is already deleted, so the live count is unchanged."""
+        self.replacements += 1
+
+    def disable(self) -> None:
+        """Recovery degraded donation off mid-run (docs/RELIABILITY.md):
+        the depth-bound claim is withdrawn for this run — :meth:`check`
+        becomes a no-op — and the degradation is recorded by the engine
+        (``faults.degradations`` counter + flight recorder), never
+        silent."""
+        self.degraded = True
+        self.pipelined = False
 
     def recycle(self, donated_consumed: bool) -> None:
         self.recycles += 1
@@ -178,6 +194,8 @@ class PackedLedger:
         if self.pipelined:
             out["packed_depth_bound_bytes"] = (
                 self.ring_size * self.buffer_bytes)
+        if self.degraded:
+            out["packed_ring_degraded"] = 1
         return out
 
     def model_extra_bytes_per_device(self) -> int:

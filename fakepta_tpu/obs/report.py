@@ -83,7 +83,14 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # history IS the warm pool regressing
                   "queue_depth", "serve_requests", "serve_dispatches",
                   "serve_realizations", "serve_kind", "serve_verified",
-                  "serve_warm_s"}
+                  "serve_warm_s",
+                  # chaos-lane shape fact (benchmarks/suite.py config 12):
+                  # how many injected faults the run recovered — the
+                  # regression-bearing metrics are the recovery counters
+                  # themselves (faults_retries / faults_degradations /
+                  # faults_rollbacks, lower-better defaults) and
+                  # fault_recovery_overhead_frac (lower-better default)
+                  "faults_recovered", "packed_ring_degraded"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")
@@ -338,9 +345,15 @@ def format_delta(a: RunReport, b: RunReport,
     # about which way is "worse"
     lines = [f"{'metric':<28} {'a':>14} {'b':>14} {'delta':>12}"]
     regressions = []
+    def _num(v):
+        return (float(v) if isinstance(v, (int, float))
+                and not isinstance(v, bool) else None)
+
     for k in keys:
         va, vb = ma.get(k), mb.get(k)
-        if va is None or vb is None:
+        if _num(va) is None or _num(vb) is None:
+            # missing on one side, or a non-numeric (schema-partial) value
+            # — informational row, never a TypeError traceback
             lines.append(f"{k:<28} {va if va is not None else '-':>14} "
                          f"{vb if vb is not None else '-':>14} {'-':>12}")
             continue

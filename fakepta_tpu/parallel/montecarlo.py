@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from .. import faults as faults_mod
 from .. import obs
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
@@ -1687,10 +1688,15 @@ class EnsembleSimulator:
                         ma.temp_size_in_bytes + ma.argument_size_in_bytes
                         + ma.output_size_in_bytes
                         + ma.generated_code_size_in_bytes)
-                except Exception:
-                    pass
-        except Exception:
-            pass    # best-effort: absent on some backends/jax builds
+                except (AttributeError, TypeError, ValueError):
+                    pass    # memory_analysis absent/shape-different on
+                    #         this jax build; the cost dict just omits it
+        except Exception as exc:   # noqa: BLE001 — recorded, not swallowed
+            # best-effort capture (cost model absent on some backends/jax
+            # builds), but never SILENT: the flight recorder keeps the
+            # reason the roofline fields are missing from this run
+            obs.flightrec.note("cost_capture_failed", path=str(path),
+                               error=repr(exc)[:200])
         finally:
             self._obs_in_capture = False
         try:
@@ -1709,8 +1715,9 @@ class EnsembleSimulator:
                 stage_k(self._mega_tables[0]), mode=mode,
                 psr_shards=self.mesh.shape[PSR_AXIS],
                 dtype_bytes=np.dtype(self.batch.t_own.dtype).itemsize)
-        except Exception:
-            pass
+        except Exception as exc:   # noqa: BLE001 — recorded, not swallowed
+            obs.flightrec.note("cost_model_failed", path=str(path),
+                               error=repr(exc)[:200])
         self._obs_cost[cache_key] = cost
         return cost
 
@@ -2830,7 +2837,8 @@ class EnsembleSimulator:
 
     def _drain_chunk(self, packed, corr, rec, packed_out, slot, corr_out,
                      ckpt, seed, nreal, chunk, done, progress, nb, n_extra,
-                     materialize, ev=None, t_run0=None, timeline=None):
+                     materialize, ev=None, t_run0=None, timeline=None,
+                     retries=0, backoff_s=0.05, on_retry=None):
         """Host-side completion work for ONE dispatched chunk.
 
         Runs on the pipeline's writer thread (pipelined runs) or inline at
@@ -2861,7 +2869,19 @@ class EnsembleSimulator:
         idx = rec.get("idx", slot)
         t_d0 = obs.now()
         t_ready = None
-        try:
+
+        def body():
+            # transient failures in here retry IN PLACE (bounded backoff,
+            # run_drain_with_retry below) — crucially BEFORE the finally
+            # sets ``ev``, so the dispatch loop can never donate this
+            # chunk's buffer out from under a retrying materialize. Drains
+            # are idempotent: fixed slot, same checkpoint chunk file, same
+            # progress counts.
+            nonlocal t_ready
+            # chaos site: the writer-thread drain (docs/RELIABILITY.md);
+            # a 'hang' here sleeps long enough for the dispatch loop's
+            # watchdog to catch it
+            faults_mod.check("pipeline.writer", idx=idx)
             if materialize == "donatable":
                 # pipelined path: the device buffer is recycled as a later
                 # dispatch's donated scratch, so the copy must not leave
@@ -2880,6 +2900,16 @@ class EnsembleSimulator:
             if corr_out is not None:
                 corr_out[slot] = to_host(corr)
                 t_ready = obs.now()
+            if arr is not None and not np.isfinite(arr[:, :nb + 1]).all():
+                # poisoned output (an injected NaN, a genuinely non-finite
+                # kernel): fail LOUDLY before the checkpoint can absorb it
+                # — the run aborts with a flight-recorder dump, never a
+                # silently corrupt statistic (docs/RELIABILITY.md)
+                obs.flightrec.note("poisoned_chunk", idx=idx)
+                raise FloatingPointError(
+                    f"chunk {idx} produced non-finite packed statistics "
+                    f"(poisoned output); aborting — see the flight-"
+                    f"recorder dump")
             if ckpt is not None and jax.process_index() == 0:
                 # append-only: each save writes this chunk's arrays,
                 # O(chunk) I/O. Only process 0 writes — to_host replicates
@@ -2907,6 +2937,10 @@ class EnsembleSimulator:
                     t_ready = obs.now()
                 progress(min(done, nreal), nreal)
             obs.flightrec.note("chunk_drained", idx=idx)
+
+        try:
+            pipeline_mod.run_drain_with_retry(body, retries, backoff_s,
+                                              on_retry=on_retry)
         finally:
             if timeline is not None:
                 t_end = obs.now()
@@ -3008,10 +3042,35 @@ class EnsembleSimulator:
             self._obs_in_capture = prev
         return obs.now() - t0
 
+    def clear_executables(self) -> None:
+        """Drop every compiled/traced step executable (and the cost-capture
+        cache) and rebuild the defaults.
+
+        The recovery hook for a *poisoned executable* (docs/RELIABILITY.md):
+        the serve warm pool calls this when a dispatch returns non-finite
+        statistics from a simulator it cannot evict wholesale (registered
+        multi-tenant entries own their simulator's lifecycle) — the next
+        dispatch re-traces and recompiles from clean state. Host-staged
+        data (batch arrays, operators, deterministic delays) is untouched:
+        it is input, not executable state.
+        """
+        for cache in (self._step_xla_cache, self._step_os_cache,
+                      self._step_fused_os_cache, self._step_lnlike_cache,
+                      self._step_mega_cache, self._obs_cost,
+                      self._obs_trace_counts):
+            cache.clear()
+        self._step = self._build_step(self._stats_bf16)
+        self._step_xla_cache[self._stats_bf16] = self._step
+        self._step_fused = (self._build_step_fused()
+                            if self._stat_path == "fused" else None)
+        self._step_mega = (self._get_step_mega(0, False, "f32")
+                           if self._stat_path == "mega" else None)
+        obs.flightrec.note("executables_cleared")
+
     def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
             checkpoint=None, progress=None, os=None, lnlike=None,
             pipeline_depth: int = 2, precision=None, eventlog=None,
-            lanes=None):
+            lanes=None, recovery=None):
         """Run the ensemble in device-memory-bounded chunks.
 
         ``lanes``: per-request RNG lanes (the :mod:`fakepta_tpu.serve`
@@ -3136,6 +3195,26 @@ class EnsembleSimulator:
         merge them into a single Perfetto timeline with
         ``python -m fakepta_tpu.obs trace <dir>/events-p*.jsonl -o
         trace.json`` (one pid lane per host).
+
+        ``recovery``: the engine-wide recovery policy
+        (:class:`fakepta_tpu.faults.RecoveryPolicy`; ``None`` = defaults,
+        ``False`` = disabled — every failure propagates unchanged).
+        Transient chunk dispatch/drain failures retry with bounded
+        exponential backoff, re-dispatching the same RNG lanes — the
+        retried chunk is bit-identical to the unfaulted run. A Pallas
+        compile/runtime failure degrades the statistic path (``mega ->
+        fused -> xla``), a bf16 certification failure degrades to f32, and
+        a broken donated-buffer recycle turns donation off for the rest of
+        the run — each degradation counted (``faults.degradations``),
+        flight-recorded and visible in the timeline; degraded chunks
+        certify at the engine's mesh-invariance tolerance because the
+        executable shape changed. ``RecoveryPolicy(watchdog_s=...)`` arms
+        a per-chunk deadline on the oldest in-flight drain (pipelined runs)
+        that dumps the flight recorder and aborts instead of hanging
+        forever. Non-finite packed statistics abort loudly before any
+        checkpoint write; torn checkpoint files detected at resume roll
+        back to the last good chunk (``faults.rollbacks``). See
+        docs/RELIABILITY.md.
         """
         t_run0 = obs.now()
         obs.subscribe_jax_monitoring()
@@ -3147,6 +3226,7 @@ class EnsembleSimulator:
         packed_out, corr_out = [], []
         nb = self.nbins
         done = 0
+        policy = faults_mod.as_policy(recovery)
 
         # the OS lane's host-f64 operator precompute / the lnlike lane's
         # compiled model + staged theta (shared with warm_start)
@@ -3185,6 +3265,11 @@ class EnsembleSimulator:
                               n_extra=n_extra)
             if state is not None:
                 done = int(state["done"])
+                if state.get("rolled_back"):
+                    # torn chunk file(s) detected and dropped by the
+                    # checkpoint's checksum verification (utils.io)
+                    collector.count("faults.rollbacks",
+                                    int(state["rolled_back"]))
                 extra = ([state["extra"]] if n_extra else [])
                 packed_out.append(pack_stats(state["curves"], state["autos"],
                                              *extra))
@@ -3277,19 +3362,103 @@ class EnsembleSimulator:
 
         # ONE step-selection ladder for run/warm_start/the serve warm pool
         # (_exec_plan): the dispatch below and an AOT warm start select the
-        # identical executable by construction
+        # identical executable by construction. The selection is held in a
+        # mutable cell because the degradation ladder (docs/RELIABILITY.md)
+        # may re-select mid-run: mega -> fused -> xla on a Pallas failure,
+        # bf16 -> f32 on a certification failure.
         invoke, _, _ = self._exec_plan(lane_cfg, path, prec, precision,
                                        keep_corr)
+        exec_sel = {"path": path, "prec": prec, "precision": precision,
+                    "invoke": invoke}
 
         def dispatch(offset, bulks, scratch):
             """One async chunk dispatch -> (packed, corr-or-None)."""
             if lane_seeds is not None:
                 # serve lane keys: per-slot (request seed, within-request
                 # index) vectors replace the (base key, offset) pair
-                return invoke(jnp.asarray(lane_seeds[offset:offset + chunk]),
-                              jnp.asarray(lane_within[offset:offset + chunk]),
-                              chunk, bulks, scratch)
-            return invoke(base, offset, chunk, bulks, scratch)
+                return exec_sel["invoke"](
+                    jnp.asarray(lane_seeds[offset:offset + chunk]),
+                    jnp.asarray(lane_within[offset:offset + chunk]),
+                    chunk, bulks, scratch)
+            return exec_sel["invoke"](base, offset, chunk, bulks, scratch)
+
+        def degrade_to(new_path, new_prec, new_precision, rec, why):
+            """Step the executable selection down one ladder rung."""
+            frm = f"{exec_sel['path']}/{exec_sel['prec']}"
+            exec_sel["invoke"], _, _ = self._exec_plan(
+                lane_cfg, new_path, new_prec, new_precision, keep_corr)
+            exec_sel.update(path=new_path, prec=new_prec,
+                            precision=new_precision)
+            collector.count("faults.degradations")
+            obs.flightrec.note("degrade", idx=rec["idx"], frm=frm,
+                               to=f"{new_path}/{new_prec}",
+                               error=why[:200])
+            timeline.append({"name": "degrade", "tid": "main",
+                             "t0": obs.now() - t_run0, "dur": None,
+                             "chunk": rec["idx"], "from": frm,
+                             "to": f"{new_path}/{new_prec}"})
+            meta["degraded_path"] = new_path
+            meta["degraded_precision"] = new_prec
+
+        def dispatch_recover(offset, bulks, scratch, rec):
+            """Dispatch one chunk under the recovery policy: bounded
+            exponential-backoff retry of transient failures (same offsets,
+            same RNG lanes — the retried chunk is bit-identical to the
+            unfaulted run), the degradation ladders on Pallas/precision
+            failures, and NaN poisoning of the packed output when the
+            chaos harness asks for it (caught loudly by the drain guard).
+            """
+            attempts, delay = 0, policy.backoff_s
+            while True:
+                try:
+                    act = faults_mod.check("mc.dispatch", idx=rec["idx"],
+                                           offset=int(offset))
+                    if scratch is not None and scratch.is_deleted():
+                        # an earlier attempt's donation consumed the
+                        # recycled buffer before failing: replace it (the
+                        # old one is dead, so the live count is unchanged)
+                        ledger.alloc_replacement()
+                        scratch = jax.device_put(
+                            np.zeros((chunk, n_lanes), dtype),
+                            scratch_sharding)
+                    packed, corr = dispatch(offset, bulks, scratch)
+                    if act == "poison":
+                        packed = packed * jnp.asarray(float("nan"),
+                                                      packed.dtype)
+                    return packed, corr
+                except Exception as exc:   # noqa: BLE001 — triaged below;
+                    # unrecognized failures re-raise unchanged (KillFault
+                    # is BaseException and never enters this clause)
+                    kind = faults_mod.classify(exc)
+                    if (kind == "transient"
+                            and attempts < policy.max_retries):
+                        attempts += 1
+                        collector.count("faults.retries")
+                        obs.flightrec.note(
+                            "chunk_retry", idx=rec["idx"], attempt=attempts,
+                            error=repr(exc)[:200])
+                        timeline.append(
+                            {"name": "retry", "tid": "main",
+                             "t0": obs.now() - t_run0, "dur": delay,
+                             "chunk": rec["idx"], "attempt": attempts})
+                        faults_mod.sleep(delay)
+                        delay = policy.next_backoff(delay)
+                        continue
+                    if (kind == "pallas" and policy.degrade_paths
+                            and exec_sel["path"] in faults_mod.PATH_LADDER):
+                        # step down the ladder at the SAME effective
+                        # precision — degrading the path must not silently
+                        # change the precision mode too
+                        new_path = faults_mod.PATH_LADDER[exec_sel["path"]]
+                        degrade_to(new_path, exec_sel["prec"],
+                                   exec_sel["prec"], rec, repr(exc))
+                        continue
+                    if (kind == "precision" and policy.degrade_precision
+                            and exec_sel["prec"] == "bf16"):
+                        degrade_to(exec_sel["path"], "f32", "f32", rec,
+                                   repr(exc))
+                        continue
+                    raise
 
         # chunk 0's staged host inputs are the one precompute the first
         # dispatch genuinely waits on (recorded as its stall_s); every later
@@ -3303,6 +3472,24 @@ class EnsembleSimulator:
                              "chunk": 0})
         # created last before the loop so no earlier failure leaks the thread
         writer = pipeline_mod.make_writer(pipelined)
+        donation_on = True
+        if pipelined and pipeline_mod.donation_unsafe(self.mesh):
+            # XLA:CPU + persistent compile cache: executables loaded from
+            # the on-disk cache carry input-output aliasing metadata that
+            # can disagree with jax's runtime donation bookkeeping — the
+            # execution then writes a buffer jax already released, and a
+            # later chunk's output lands inside another chunk's drained
+            # host copy (observed as whole-chunk stream swaps; see
+            # docs/RELIABILITY.md and tests/test_faults.py's warm-cache
+            # chaos lane). Donation is a memory optimization, never a
+            # values change, so the safe degradation is donation OFF for
+            # the run — loudly: flight-recorded, counted, ledger claim
+            # withdrawn.
+            donation_on = False
+            ledger.disable()
+            meta["degraded_donation"] = True
+            collector.count("faults.degradations")
+            obs.flightrec.note("donation_disabled_cpu_cache")
         try:
             with obs.collect(collector):
                 while done < nreal:
@@ -3325,32 +3512,76 @@ class EnsembleSimulator:
                         if len(ring) >= ring_size:
                             # depth bound + donation: wait for the oldest
                             # in-flight chunk's drain, then hand its packed
-                            # buffer to this dispatch as donated scratch
+                            # buffer to this dispatch as donated scratch.
+                            # The wait doubles as the per-chunk WATCHDOG
+                            # deadline when the recovery policy arms one: a
+                            # drain that never completes (hung device
+                            # fetch, stuck checkpoint I/O) aborts the run
+                            # with a flight-recorder dump instead of
+                            # blocking forever (docs/RELIABILITY.md).
                             prev_packed, ev = ring.popleft()
                             t_wait = obs.now()
-                            ev.wait()
+                            if policy.watchdog_s:
+                                if not ev.wait(policy.watchdog_s):
+                                    obs.flightrec.note(
+                                        "watchdog_abort",
+                                        idx=rec["idx"] - ring_size,
+                                        deadline_s=policy.watchdog_s)
+                                    raise faults_mod.WatchdogTimeout(
+                                        f"drain of chunk "
+                                        f"{rec['idx'] - ring_size} exceeded "
+                                        f"the watchdog deadline "
+                                        f"({policy.watchdog_s}s); aborting "
+                                        f"— see the flight-recorder dump")
+                            else:
+                                ev.wait()
                             t_now = obs.now()
                             rec["stall_s"] += t_now - t_wait
                             timeline.append(
                                 {"name": "stall", "tid": "main",
                                  "t0": t_wait - t_run0, "dur": t_now - t_wait,
                                  "chunk": rec["idx"]})
-                            scratch = prev_packed
-                            recycled_from = rec["idx"] - ring_size
-                        else:
+                            scratch = prev_packed if donation_on else None
+                            recycled_from = (rec["idx"] - ring_size
+                                             if donation_on else None)
+                        elif donation_on:
                             scratch = jax.device_put(
                                 np.zeros((chunk, n_lanes), dtype),
                                 scratch_sharding)
                             ledger.alloc()
-                    packed, corr = dispatch(done, bulks, scratch)
+                    packed, corr = dispatch_recover(done, bulks, scratch,
+                                                    rec)
                     obs.flightrec.note("chunk_dispatch", idx=rec["idx"],
                                        offset=done)
-                    if recycled_from is not None:
+                    if recycled_from is not None and scratch is not None:
                         # runtime evidence for the depth-bounded peak-HBM
                         # claim: donation must have consumed the recycled
                         # buffer at dispatch (obs.memwatch; ledger.check()
-                        # raises after the loop if it ever did not)
-                        ledger.recycle(bool(scratch.is_deleted()))
+                        # raises after the loop if it ever did not). The
+                        # chaos harness can fake a miss (mc.recycle site);
+                        # under the recovery policy a miss DEGRADES —
+                        # donation turns off for the rest of the run, the
+                        # peak-HBM claim is withdrawn loudly — instead of
+                        # aborting at the end-of-run check.
+                        consumed = bool(scratch.is_deleted())
+                        if faults_mod.check("mc.recycle",
+                                            idx=rec["idx"]) == "donation":
+                            consumed = False
+                        if not consumed and policy.degrade_pipeline:
+                            donation_on = False
+                            ledger.disable()
+                            collector.count("faults.degradations")
+                            obs.flightrec.note("degrade_donation",
+                                               idx=rec["idx"])
+                            timeline.append(
+                                {"name": "degrade", "tid": "main",
+                                 "t0": obs.now() - t_run0, "dur": None,
+                                 "chunk": rec["idx"],
+                                 "from": "donated-ring",
+                                 "to": "no-donation"})
+                            meta["degraded_donation"] = True
+                        else:
+                            ledger.recycle(consumed)
                         timeline.append(
                             {"name": "recycle", "tid": "main",
                              "t0": obs.now() - t_run0, "dur": None,
@@ -3384,7 +3615,9 @@ class EnsembleSimulator:
                         slot, corr_out if keep_corr else None, ckpt, seed,
                         nreal, chunk, this_done, progress, nb, n_extra,
                         "donatable" if pipelined else sync_each, ev,
-                        t_run0, timeline)
+                        t_run0, timeline, retries=policy.max_retries,
+                        backoff_s=policy.backoff_s,
+                        on_retry=lambda a: collector.count("faults.retries"))
                     if pipelined:
                         rec["stall_s"] += writer.submit(drain, ev.set)
                         ring.append((packed, ev))
@@ -3395,7 +3628,11 @@ class EnsembleSimulator:
                                      "t0": rec["t0_s"], "dur": rec["wall_s"],
                                      "chunk": rec["idx"]})
                     chunk_records.append(rec)
-                writer.close()
+                # the watchdog also bounds the final flush: a drain hung
+                # at close would otherwise block the join forever
+                writer.close(timeout=(policy.watchdog_s
+                                      * (len(ring) + 2)
+                                      if policy.watchdog_s else None))
                 # the donated-ring memory bound, asserted with this run's
                 # own evidence (never fires unless the engine regressed)
                 ledger.check()
@@ -3405,6 +3642,16 @@ class EnsembleSimulator:
                 timeline.append({"name": "final_fetch", "tid": "main",
                                  "t0": t_f0 - t_run0,
                                  "dur": obs.now() - t_f0})
+                if not np.isfinite(packed_h[:, :nb + 1]).all():
+                    # the zero-silent-corruption contract for paths where
+                    # no drain materialized host arrays (serial, no
+                    # checkpoint/progress): a poisoned output still fails
+                    # LOUDLY with a flight-recorder dump
+                    obs.flightrec.note("poisoned_output")
+                    raise FloatingPointError(
+                        "run produced non-finite packed statistics "
+                        "(poisoned output); aborting — see the flight-"
+                        "recorder dump")
         except BaseException as exc:
             writer.abort()
             sampler.stop()
@@ -3447,11 +3694,15 @@ class EnsembleSimulator:
         self._obs_spans |= set(collector.spans)
         from ..obs import RunReport
         collector.count("obs.chunks", len(chunk_records))
+        # cost capture targets the executable the run FINISHED on (the
+        # degradation ladder may have stepped the path/precision down)
         lnl_cost = (None if lnl_compiled is None else
                     (self._get_step_lnlike(lnl_spec.model, lnl_spec.mode,
-                                           path, lnl_compiled, precision),
+                                           exec_sel["path"], lnl_compiled,
+                                           exec_sel["precision"]),
                      lnl_theta, (lnl_k, lnl_l, lnl_spec.mode)))
-        cost = self._obs_capture_cost(base, chunk, path, prec, w_os=w_os,
+        cost = self._obs_capture_cost(base, chunk, exec_sel["path"],
+                                      exec_sel["prec"], w_os=w_os,
                                       with_null=bool(os_spec.null)
                                       if os_spec else False,
                                       lnl=lnl_cost)

@@ -41,6 +41,10 @@ from typing import Callable, Optional
 import jax
 import numpy as np
 
+from ..faults import check as faults_check
+from ..faults import classify as faults_classify
+from ..faults import sleep as faults_sleep
+from ..obs import flightrec
 from ..obs.timing import now as _now
 
 # opt-in env var for the persistent XLA compile cache; the kwarg
@@ -60,28 +64,83 @@ def configure_compile_cache(path=None) -> Optional[str]:
     then loads from disk on every later process/round instead of recompiling
     (the AOT warm-start :meth:`EnsembleSimulator.warm_start` populates the
     same cache ahead of the first run).
+
+    A cache that cannot be wired — unwritable directory, a jax build
+    without the knobs, an injected ``cache.load`` fault — **degrades, never
+    aborts**: the failure is flight-recorded and the run proceeds without a
+    persistent cache (it recompiles; it does not die). Returns the wired
+    path, or None when no cache is active.
     """
     if path is None:
         path = os.environ.get(COMPILE_CACHE_ENV)
     if not path:
         return None
     path = str(path)
-    jax.config.update("jax_compilation_cache_dir", path)
-    for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
-                     ("jax_persistent_cache_min_entry_size_bytes", 0)):
-        try:
-            jax.config.update(opt, val)
-        except Exception:
-            pass   # knob missing in this jax version; the cache still works
     try:
-        # jax memoizes the cache-used decision at the FIRST compile of the
-        # process; a sim constructed after any compile would silently get no
-        # cache without this re-evaluation
-        from jax.experimental.compilation_cache import compilation_cache
-        compilation_cache.reset_cache()
-    except Exception:
-        pass
+        faults_check("cache.load", path=path)
+        jax.config.update("jax_compilation_cache_dir", path)
+        for opt, val in (("jax_persistent_cache_min_compile_time_secs", 0.0),
+                         ("jax_persistent_cache_min_entry_size_bytes", 0)):
+            try:
+                jax.config.update(opt, val)
+            # fakepta: allow[swallowed-exception] knob missing in this jax
+            # version; the cache still works without it
+            except Exception:
+                pass
+        try:
+            # jax memoizes the cache-used decision at the FIRST compile of
+            # the process; a sim constructed after any compile would
+            # silently get no cache without this re-evaluation
+            from jax.experimental.compilation_cache import compilation_cache
+            compilation_cache.reset_cache()
+        # fakepta: allow[swallowed-exception] optional API surface; older
+        # jax versions arm the cache at first compile anyway
+        except Exception:
+            pass
+    except Exception as exc:   # noqa: BLE001 — recorded + degraded below
+        # graceful degradation (docs/RELIABILITY.md): a broken cache dir
+        # must cost recompiles, not the run
+        flightrec.note("cache_load_failed", path=path,
+                       error=repr(exc)[:200])
+        try:
+            jax.config.update("jax_compilation_cache_dir", None)
+        # fakepta: allow[swallowed-exception] best-effort un-wiring after a
+        # cache failure that is already flight-recorded above
+        except Exception:
+            pass
+        return None
     return path
+
+
+def run_drain_with_retry(drain: Callable[[], None], retries: int,
+                         backoff_s: float, backoff_mult: float = 2.0,
+                         max_backoff_s: float = 2.0,
+                         on_retry: Optional[Callable[[int], None]] = None
+                         ) -> None:
+    """Run one drain thunk, retrying *transient* failures with backoff.
+
+    Drains are idempotent by construction — materialize into a fixed slot,
+    overwrite the same checkpoint chunk file, re-invoke the progress
+    callback with the same counts — so a transient failure (an injected
+    ``pipeline.writer`` fault, a flaky filesystem) costs a bounded retry
+    instead of aborting the run. Non-transient failures propagate
+    unchanged; :class:`~fakepta_tpu.faults.KillFault` (simulated process
+    death) is BaseException and never enters the except clause.
+    """
+    delay = backoff_s
+    for attempt in range(retries + 1):
+        try:
+            drain()
+            return
+        except Exception as exc:   # noqa: BLE001 — triaged + bounded below
+            if faults_classify(exc) != "transient" or attempt >= retries:
+                raise
+            flightrec.note("drain_retry", attempt=attempt + 1,
+                           error=repr(exc)[:200])
+            if on_retry is not None:
+                on_retry(attempt + 1)
+            faults_sleep(delay)
+            delay = min(delay * backoff_mult, max_backoff_s)
 
 
 class InlineWriter:
@@ -92,15 +151,23 @@ class InlineWriter:
     ``to_host``) could interleave with the main thread's chunk dispatches in
     a different order on different processes, which deadlocks multi-host
     collectives; inline drains keep the per-process launch order identical.
+    Transient drain failures retry like the threaded writer's.
     """
 
     pipelined = False
 
+    def __init__(self, retries: int = 0, backoff_s: float = 0.05,
+                 on_retry: Optional[Callable[[int], None]] = None):
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.on_retry = on_retry
+
     def submit(self, drain: Callable[[], None]) -> float:
-        drain()
+        run_drain_with_retry(drain, self.retries, self.backoff_s,
+                             on_retry=self.on_retry)
         return 0.0
 
-    def close(self) -> None:
+    def close(self, timeout: Optional[float] = None) -> None:
         pass
 
     def abort(self) -> None:
@@ -113,16 +180,22 @@ class ThreadWriter:
     The queue is unbounded — in-flight depth is bounded by the run loop's
     donated-buffer ring (the dispatch of chunk ``i`` waits for chunk
     ``i - depth``'s drain before reusing its output buffer), so the queue
-    never grows past ``depth + 1`` entries in practice. The first exception a
-    drain raises is recorded, the remaining queued drains are *cancelled*
-    (their completion events still fire so the dispatch loop cannot
-    deadlock), and the exception re-raises at the next ``submit``/``close``
-    — the pipelined analog of the serial loop aborting mid-run.
+    never grows past ``depth + 1`` entries in practice. A *transient* drain
+    failure retries in place with bounded backoff
+    (:func:`run_drain_with_retry`); the first non-recovered exception is
+    recorded, the remaining queued drains are *cancelled* (their completion
+    events still fire so the dispatch loop cannot deadlock), and the
+    exception re-raises at the next ``submit``/``close`` — the pipelined
+    analog of the serial loop aborting mid-run.
     """
 
     pipelined = True
 
-    def __init__(self):
+    def __init__(self, retries: int = 0, backoff_s: float = 0.05,
+                 on_retry: Optional[Callable[[int], None]] = None):
+        self.retries = int(retries)
+        self.backoff_s = float(backoff_s)
+        self.on_retry = on_retry
         self._q: "queue.Queue" = queue.Queue()
         self._exc: Optional[BaseException] = None
         self._thread = threading.Thread(
@@ -137,7 +210,9 @@ class ThreadWriter:
             drain, cancel = item
             if self._exc is None:
                 try:
-                    drain()
+                    run_drain_with_retry(drain, self.retries,
+                                         self.backoff_s,
+                                         on_retry=self.on_retry)
                 except BaseException as exc:   # noqa: BLE001 — re-raised
                     self._exc = exc            # in the dispatch thread
                     cancel()
@@ -161,10 +236,22 @@ class ThreadWriter:
             exc, self._exc = self._exc, None
             raise exc
 
-    def close(self) -> None:
-        """Flush the queue, join the thread, re-raise any drain exception."""
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Flush the queue, join the thread, re-raise any drain exception.
+
+        ``timeout`` arms the watchdog variant: a writer thread that does
+        not finish within it (a hung drain — a stuck device fetch, an
+        injected hang) raises :class:`~fakepta_tpu.faults.WatchdogTimeout`
+        instead of blocking forever; the caller dumps the flight recorder.
+        """
         self._q.put(_STOP)
-        self._thread.join()
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            from ..faults import WatchdogTimeout
+            flightrec.note("watchdog_close_timeout", timeout_s=timeout)
+            raise WatchdogTimeout(
+                f"writer thread still draining after {timeout}s at close "
+                f"(hung drain); aborting — see the flight-recorder dump")
         self._raise_pending()
 
     def abort(self) -> None:
@@ -174,9 +261,40 @@ class ThreadWriter:
         self._exc = None
 
 
-def make_writer(pipelined: bool):
-    """The writer the run loop drains through: threaded iff pipelined."""
-    return ThreadWriter() if pipelined else InlineWriter()
+def donation_unsafe(mesh) -> bool:
+    """True when donated-scratch recycling must be disabled for this run.
+
+    XLA:CPU executables loaded from the **persistent compile cache** carry
+    input-output aliasing metadata that can disagree with jax's runtime
+    donation bookkeeping: the async execution then writes into a buffer
+    jax already released, and — after malloc reuse — a later chunk's
+    output lands inside another chunk's already-drained host copy. The
+    observed symptom is a whole chunk of one run's packed stream equal to
+    a *different* chunk's values (a silent stream swap), reproduced only
+    on CPU with a warm on-disk cache (tests/test_faults.py pins the
+    degradation; docs/RELIABILITY.md the analysis). Donation never changes
+    values — only peak memory — so the safe engine response is to run the
+    pipeline without it on that configuration. TPU keeps donation + cache.
+    """
+    if mesh.devices.flat[0].platform != "cpu":
+        return False
+    cache_dir = getattr(jax.config, "jax_compilation_cache_dir", None)
+    return bool(cache_dir)
+
+
+def make_writer(pipelined: bool, retries: int = 0, backoff_s: float = 0.05,
+                on_retry: Optional[Callable[[int], None]] = None):
+    """The writer the run loop drains through: threaded iff pipelined.
+
+    ``retries``/``backoff_s`` wire the recovery policy's transient-drain
+    retry into either writer; ``on_retry`` is the engine's counter hook
+    (``faults.retries``), called with the attempt number.
+    """
+    if pipelined:
+        return ThreadWriter(retries=retries, backoff_s=backoff_s,
+                            on_retry=on_retry)
+    return InlineWriter(retries=retries, backoff_s=backoff_s,
+                        on_retry=on_retry)
 
 
 def materialize_copy(x):

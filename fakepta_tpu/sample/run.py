@@ -31,8 +31,11 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import io
 import json
 import threading
+import zipfile
+import zlib
 from functools import partial
 from pathlib import Path
 from types import SimpleNamespace
@@ -44,6 +47,7 @@ from jax import lax
 from jax.sharding import NamedSharding
 from jax.sharding import PartitionSpec as P
 
+from .. import faults as faults_mod
 from .. import obs
 from ..infer import model as infer_model
 from ..infer.model import (box_from_unconstrained, box_unconstrained_log_prior,
@@ -84,10 +88,20 @@ class SampleCheckpoint:
     overwrites ``<path>.state.npz`` via rename. Because per-step keys fold
     the ABSOLUTE step index, a resumed run reproduces the uninterrupted
     chain bit-for-bit. All files are removed on successful completion.
+
+    **Hardened** (docs/RELIABILITY.md): every file lands via
+    ``utils.io.write_atomic`` (tmp + fsync + rename + dir fsync) and the
+    manifest records a CRC32 per kept segment plus the state snapshot. A
+    torn or corrupt file detected at resume is flight-recorded and the
+    checkpoint discarded — the restarted run reproduces the uninterrupted
+    chains bit-for-bit from step 0 (absolute-index keys), which is the only
+    sound rollback here: the state snapshot accumulates *every* earlier
+    segment, so a single bad file invalidates the whole resume.
     """
 
     def __init__(self, path):
         self.path = Path(path)
+        self._sums: dict = {}       # "s<idx>"/"state" -> CRC32
 
     def _seg_path(self, idx: int) -> Path:
         return self.path.with_name(self.path.name + f".s{idx:05d}.npz")
@@ -96,32 +110,69 @@ class SampleCheckpoint:
         return self.path.with_name(self.path.name + ".state.npz")
 
     def save(self, ident: dict, done: int, snapshot: dict, thinned):
+        from .. import faults
+        from ..utils.io import npz_bytes, write_atomic
+        act = faults.check("ckpt.append", done=int(done))
         if thinned is not None:
-            np.savez(self._seg_path(done - 1), thinned=thinned)
-        tmp = self._state_path().with_suffix(".tmp.npz")
-        np.savez(tmp, **snapshot)
-        tmp.replace(self._state_path())
+            self._sums[f"s{done - 1:05d}"] = write_atomic(
+                self._seg_path(done - 1), npz_bytes(thinned=thinned))
+        self._sums["state"] = write_atomic(self._state_path(),
+                                           npz_bytes(**snapshot))
         manifest = dict(ident, schema=SAMPLE_SCHEMA, done=int(done),
                         kept=sorted(int(p.name.rsplit(".s", 1)[1][:5])
-                                    for p in self._glob_segs()))
-        tmp_m = self.path.with_suffix(self.path.suffix + ".tmp")
-        tmp_m.write_text(json.dumps(manifest))
-        tmp_m.replace(self.path)
+                                    for p in self._glob_segs()),
+                        sums=dict(self._sums))
+        write_atomic(self.path, json.dumps(manifest).encode())
+        if act == "torn":
+            # chaos harness: the torn write fsync cannot prevent (failing
+            # storage drops pages after the rename), plus process death —
+            # resume must detect the bad CRC and restart loudly
+            sp = self._state_path()
+            data = sp.read_bytes()
+            sp.write_bytes(data[:max(len(data) // 2, 1)])
+            raise faults.KillFault(
+                f"injected torn sample-checkpoint write at segment "
+                f"{done - 1}")
 
     def _glob_segs(self):
         return self.path.parent.glob(
             self.path.name + ".s" + "[0-9]" * 5 + ".npz")
 
+    def _corrupt(self, what: str, exc) -> None:
+        obs.flightrec.note("ckpt_rollback", path=str(self.path), what=what,
+                           error=repr(exc)[:200])
+        self.delete()
+
     def load(self, ident: dict):
         if not self.path.exists():
             return None
-        manifest = json.loads(self.path.read_text())
+        try:
+            manifest = json.loads(self.path.read_text())
+        except (OSError, ValueError) as exc:
+            self._corrupt("manifest", exc)
+            return None
         for k, v in ident.items():
             if manifest.get(k) != v:
                 return None
-        snap = dict(np.load(self._state_path()))
-        thinned = [np.load(self._seg_path(i))["thinned"]
-                   for i in manifest["kept"]]
+        sums = manifest.get("sums", {})
+        try:
+            data = self._state_path().read_bytes()
+            if "state" in sums and zlib.crc32(data) != int(sums["state"]):
+                raise ValueError("state snapshot checksum mismatch "
+                                 "(torn write)")
+            snap = dict(np.load(io.BytesIO(data)))
+            thinned = []
+            for i in manifest["kept"]:
+                data = self._seg_path(i).read_bytes()
+                key = f"s{i:05d}"
+                if key in sums and zlib.crc32(data) != int(sums[key]):
+                    raise ValueError(f"segment {i} checksum mismatch "
+                                     f"(torn write)")
+                thinned.append(np.load(io.BytesIO(data))["thinned"])
+        except (OSError, ValueError, KeyError, zipfile.BadZipFile) as exc:
+            self._corrupt("segments", exc)
+            return None
+        self._sums = {k: int(v) for k, v in sums.items()}
         return {"done": int(manifest["done"]), "snapshot": snap,
                 "thinned": thinned}
 
@@ -131,6 +182,7 @@ class SampleCheckpoint:
                 p.unlink()
             except FileNotFoundError:
                 pass
+        self._sums = {}
 
 
 class SamplingRun:
@@ -675,17 +727,24 @@ class SamplingRun:
 
     def _drain_segment(self, thinned, snapshot, rec, out, slot, ckpt,
                        ident, done_segments, is_post, materialize, ev,
-                       t_run0, timeline, progress, done_steps, total_steps):
+                       t_run0, timeline, progress, done_steps, total_steps,
+                       retries=0, backoff_s=0.05, on_retry=None):
         """Writer-thread completion work for ONE segment (the analog of
         montecarlo._drain_chunk): materialize the thinned buffer so its
         device storage stays donatable, guard against NaN chains (a
         nan-lnL abort surfaces through the flight recorder), append the
         checkpoint, tick progress. Never called from inside the dispatch
-        loop's device path."""
+        loop's device path. Transient failures retry in place (before the
+        finally releases ``ev``, so the dispatch loop can never donate the
+        buffer out from under a retrying materialize)."""
         idx = rec["idx"]
         t_d0 = obs.now()
         t_ready = None
-        try:
+
+        def body():
+            nonlocal t_ready
+            # chaos site: the writer-thread drain (docs/RELIABILITY.md)
+            faults_mod.check("pipeline.writer", idx=idx)
             if materialize == "donatable":
                 arr = pipeline_mod.materialize_copy(thinned)
             else:
@@ -710,6 +769,10 @@ class SamplingRun:
             if progress is not None:
                 progress(min(done_steps, total_steps), total_steps)
             obs.flightrec.note("segment_drained", idx=idx)
+
+        try:
+            pipeline_mod.run_drain_with_retry(body, retries, backoff_s,
+                                              on_retry=on_retry)
         finally:
             t_end = obs.now()
             if t_ready is not None and "t0_s" in rec:
@@ -724,7 +787,8 @@ class SamplingRun:
             ev.set()
 
     def run(self, n_steps: int, seed=0, segment=None, checkpoint=None,
-            pipeline_depth: int = 2, progress=None, eventlog=None) -> dict:
+            pipeline_depth: int = 2, progress=None, eventlog=None,
+            recovery=None) -> dict:
         """Run ``n_steps`` post-warmup MCMC steps (plus the spec's warmup).
 
         The chain loop dispatches one jitted SEGMENT program at a time —
@@ -739,11 +803,23 @@ class SamplingRun:
         on-device accumulators), a flat ``summary`` and the ``report``
         RunReport (timeline, HBM watermark, flight-recorder integration —
         everything ``obs compare``/``gate`` consume).
+
+        ``recovery``: the engine-wide recovery policy
+        (:class:`fakepta_tpu.faults.RecoveryPolicy`; ``None`` = defaults,
+        ``False`` = disabled). Transient segment dispatch/drain failures
+        retry with bounded backoff — the segment program is a pure
+        function of ``(base key, seg_start, state)``, and the state carry
+        is never donated, so a retried segment reproduces the
+        uninterrupted chains bit-for-bit. ``watchdog_s`` arms the
+        per-segment deadline on the oldest in-flight drain (pipelined
+        runs). Torn checkpoint files detected at resume restart loudly
+        from step 0 (docs/RELIABILITY.md).
         """
         t_run0 = obs.now()
         obs.subscribe_jax_monitoring()
         collector = obs.Collector()
         retraces_before = self.retraces
+        policy = faults_mod.as_policy(recovery)
         spec, compiled = self.spec, self.compiled
         k, t_count, d = spec.n_chains, spec.n_temps, compiled.D
         segment, warmup_n, post_n = self._normalize(n_steps, segment)
@@ -818,6 +894,57 @@ class SamplingRun:
             depth=int(depth if pipelined else 0),
             resume_done=int(done_segments))
         writer = pipeline_mod.make_writer(pipelined)
+        donation_on = True
+        if pipelined and pipeline_mod.donation_unsafe(self.mesh):
+            # XLA:CPU + persistent compile cache: cache-loaded executables'
+            # aliasing metadata can disagree with jax's donation
+            # bookkeeping (montecarlo.run has the full account;
+            # docs/RELIABILITY.md) — run the segment pipeline without
+            # donated thinned-scratch recycling, loudly
+            donation_on = False
+            ledger.disable()
+            meta["degraded_donation"] = True
+            collector.count("faults.degradations")
+            obs.flightrec.note("donation_disabled_cpu_cache")
+
+        def seg_dispatch_recover(seg_idx, state, scratch):
+            """One segment dispatch under the recovery policy: transient
+            failures retry with bounded backoff. The state carry is NOT
+            donated (see _get_programs), so a retry re-reads intact inputs
+            and the retried segment is bit-identical to the unfaulted run;
+            only the donated thinned scratch may need replacing."""
+            attempts, delay = 0, policy.backoff_s
+            while True:
+                try:
+                    act = faults_mod.check("sample.segment", idx=seg_idx)
+                    if scratch is not None and scratch.is_deleted():
+                        ledger.alloc_replacement()
+                        scratch = jax.device_put(
+                            np.zeros((n_out, k, d), dt), scratch_sharding)
+                    state2, thinned, snapshot = seg_fn(
+                        base, jnp.int32(seg_idx * segment), state, scratch)
+                    if act == "poison":
+                        # NaN the thinned buffer: the drain's finite guard
+                        # must abort loudly, never checkpoint it
+                        thinned = thinned * jnp.asarray(float("nan"), dt)
+                    return state2, thinned, snapshot
+                except Exception as exc:  # noqa: BLE001 — triaged below;
+                    # unrecognized failures re-raise unchanged
+                    if (faults_mod.classify(exc) != "transient"
+                            or attempts >= policy.max_retries):
+                        raise
+                    attempts += 1
+                    collector.count("faults.retries")
+                    obs.flightrec.note("segment_retry", idx=seg_idx,
+                                       attempt=attempts,
+                                       error=repr(exc)[:200])
+                    timeline.append({"name": "retry", "tid": "main",
+                                     "t0": obs.now() - t_run0,
+                                     "dur": delay, "chunk": seg_idx,
+                                     "attempt": attempts})
+                    faults_mod.sleep(delay)
+                    delay = policy.next_backoff(delay)
+
         try:
             with obs.collect(collector):
                 for seg_idx in range(done_segments, n_segments):
@@ -834,22 +961,40 @@ class SamplingRun:
                         if len(ring) >= ring_size:
                             prev_buf, ev = ring.popleft()
                             t_wait = obs.now()
-                            ev.wait()
+                            if policy.watchdog_s:
+                                # the per-segment watchdog deadline: a hung
+                                # drain aborts with a flight-recorder dump
+                                # instead of blocking the chain loop
+                                # forever (docs/RELIABILITY.md)
+                                if not ev.wait(policy.watchdog_s):
+                                    obs.flightrec.note(
+                                        "watchdog_abort",
+                                        idx=seg_idx - ring_size,
+                                        deadline_s=policy.watchdog_s)
+                                    raise faults_mod.WatchdogTimeout(
+                                        f"drain of segment "
+                                        f"{seg_idx - ring_size} exceeded "
+                                        f"the watchdog deadline "
+                                        f"({policy.watchdog_s}s); aborting "
+                                        f"— see the flight-recorder dump")
+                            else:
+                                ev.wait()
                             t_now = obs.now()
                             rec["stall_s"] += t_now - t_wait
                             timeline.append(
                                 {"name": "stall", "tid": "main",
                                  "t0": t_wait - t_run0,
                                  "dur": t_now - t_wait, "chunk": seg_idx})
-                            scratch = prev_buf
-                            recycled_from = seg_idx - ring_size
-                        else:
+                            scratch = prev_buf if donation_on else None
+                            recycled_from = (seg_idx - ring_size
+                                             if donation_on else None)
+                        elif donation_on:
                             scratch = jax.device_put(
                                 np.zeros((n_out, k, d), dt),
                                 scratch_sharding)
                             ledger.alloc()
-                    state, thinned, snapshot = seg_fn(
-                        base, jnp.int32(seg_idx * segment), state, scratch)
+                    state, thinned, snapshot = seg_dispatch_recover(
+                        seg_idx, state, scratch)
                     obs.flightrec.note("segment_dispatch", idx=seg_idx,
                                        step=seg_idx * segment)
                     if recycled_from is not None:
@@ -870,7 +1015,10 @@ class SamplingRun:
                         slot, ckpt, ident, seg_idx + 1,
                         seg_idx >= warm_segments,
                         "donatable" if pipelined else True, ev, t_run0,
-                        timeline, progress, done_steps, total_steps)
+                        timeline, progress, done_steps, total_steps,
+                        retries=policy.max_retries,
+                        backoff_s=policy.backoff_s,
+                        on_retry=lambda a: collector.count("faults.retries"))
                     if pipelined:
                         rec["stall_s"] += writer.submit(drain, ev.set)
                         ring.append((thinned, ev))
@@ -882,7 +1030,8 @@ class SamplingRun:
                                      "dur": rec["wall_s"],
                                      "chunk": seg_idx})
                     seg_records.append(rec)
-                writer.close()
+                writer.close(timeout=(policy.watchdog_s * (len(ring) + 2)
+                                      if policy.watchdog_s else None))
                 ledger.check()
                 t_f0 = obs.now()
                 state_h = {k2: np.asarray(to_host(v))

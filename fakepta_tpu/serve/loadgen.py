@@ -114,10 +114,14 @@ def run_loadgen(spec: Optional[ArraySpec] = None, *, mesh=None,
                 try:
                     futs.append(pool.submit(r))
                     break
-                except ServeBusy:
-                    # the backpressure contract in action: back off and
-                    # retry instead of growing an unbounded client buffer
-                    time.sleep(0.002)
+                except ServeBusy as busy:
+                    # the backpressure contract in action: honor the
+                    # scheduler's computed Retry-After hint (estimated
+                    # backlog drain time) instead of hammering a fixed
+                    # sleep — the client converges on the pool's actual
+                    # service rate
+                    time.sleep(max(getattr(busy, "retry_after_s", 0.0),
+                                   0.002))
             if rate_hz:
                 time.sleep(1.0 / rate_hz)
         results = [f.result(timeout=600.0) for f in futs]

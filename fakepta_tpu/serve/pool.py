@@ -24,6 +24,7 @@ import collections
 from typing import Optional, Tuple
 
 from .. import obs
+from ..obs import flightrec
 from ..parallel import pipeline as pipeline_mod
 from .spec import ArraySpec, ServeError
 
@@ -65,8 +66,11 @@ class PoolEntry:
         # RunReport assembly never pays an AOT lower mid-traffic
         try:
             self.sim.chunk_cost(bucket, **run_kwargs)
-        except Exception:
-            pass     # cost model missing on this backend: run() copes too
+        except Exception as exc:   # noqa: BLE001 — recorded, not swallowed
+            # cost model missing on this backend: run() copes too, but the
+            # flight recorder keeps the reason the cost fields are absent
+            flightrec.note("warm_cost_capture_failed",
+                           bucket=int(bucket), error=repr(exc)[:160])
         self.warmed.add(key)
         spent = obs.now() - t0
         self.warm_s += spent
@@ -129,6 +133,33 @@ class WarmPool:
             del self._entries[victim]
             self.evictions += 1
         return entry
+
+    def evict(self, spec_hash: str) -> bool:
+        """Evict one entry's *executables* (the poisoned-executable
+        recovery hook, docs/RELIABILITY.md).
+
+        Unpinned (ArraySpec-built) entries are dropped wholesale — the
+        next :meth:`get` rebuilds the simulator from the spec,
+        deterministically. Pinned (registered) entries own their
+        simulator's lifecycle, so only the compiled state is cleared
+        (:meth:`EnsembleSimulator.clear_executables`) and the
+        prewarmed-bucket bookkeeping reset — the next dispatch re-traces
+        and recompiles from clean state. Returns True when something was
+        evicted.
+        """
+        entry = self._entries.get(spec_hash)
+        if entry is None:
+            return False
+        if entry.pinned:
+            entry.sim.clear_executables()
+            entry.warmed.clear()
+            entry.os_ops.clear()
+        else:
+            del self._entries[spec_hash]
+        self.evictions += 1
+        flightrec.note("pool_evict", spec=spec_hash,
+                       pinned=bool(entry.pinned))
+        return True
 
     def prewarm(self, entry: PoolEntry, buckets: Tuple[int, ...],
                 lane_token=("sim",), run_kwargs: Optional[dict] = None

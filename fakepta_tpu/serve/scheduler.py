@@ -45,6 +45,7 @@ from typing import Optional, Tuple
 
 import numpy as np
 
+from .. import faults as faults_mod
 from .. import obs
 from ..obs import flightrec
 from .pool import WarmPool
@@ -52,6 +53,11 @@ from .spec import (DEFAULT_BUCKETS, ArraySpec, ServeBusy, ServeClosed,
                    ServeError, ServeTimeout, SimRequest, resolve_spec_hash)
 
 _STOP = object()
+
+
+class _PoisonedOutput(RuntimeError):
+    """A dispatch returned non-finite statistics: the executable (or its
+    cached state) is poisoned — recovery evicts and recompiles."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -76,6 +82,12 @@ class ServeConfig:
     prewarm_buckets: Tuple[int, ...] = ()
     pipeline_depth: int = 0          # single-chunk dispatches: serial loop
     result_window: int = 4096        # SLO ring capacity (requests)
+    # recovery (docs/RELIABILITY.md): transient dispatch failures retry
+    # with bounded backoff before the cohort is failed; a poisoned
+    # executable (non-finite output) is evicted from the warm pool and the
+    # cohort re-dispatched once against the recompiled entry
+    max_dispatch_retries: int = 2
+    retry_backoff_s: float = 0.05
 
 
 @dataclasses.dataclass
@@ -156,6 +168,8 @@ class _Stats:
         self.rejected = 0
         self.cancelled = 0
         self.failed = 0
+        self.retried = 0             # transient dispatch retries
+        self.evicted = 0             # poisoned-executable evictions
         self.dispatches = 0
         self.realizations = 0
         self.queue_depth_max = 0
@@ -251,11 +265,14 @@ class ServePool:
                 raise ServeClosed("pool is closed")
             if self._pending >= self.config.max_queue_depth:
                 self._stats.rejected += 1
+                hint = self._retry_after_locked()
                 flightrec.note("serve_busy", pending=self._pending,
-                               depth=self.config.max_queue_depth)
+                               depth=self.config.max_queue_depth,
+                               retry_after_s=round(hint, 4))
                 raise ServeBusy(
                     f"{self._pending} requests pending >= max_queue_depth="
-                    f"{self.config.max_queue_depth}; retry with backoff")
+                    f"{self.config.max_queue_depth}; retry in ~{hint:.3f}s",
+                    retry_after_s=hint)
             q = self._queues.get(cohort_key)
             if q is None:
                 # per-cohort FIFO; maxlen mirrors the global admission bound
@@ -270,6 +287,20 @@ class ServePool:
                                               self._pending)
             self._cond.notify_all()
         return fut
+
+    def _retry_after_locked(self) -> float:
+        """The ServeBusy backoff hint: estimated backlog drain time —
+        dispatches needed to clear the queued realizations times the
+        recent mean service time, floored at one coalesce window and
+        capped at 5 s (a hint, not a promise). Caller holds the lock."""
+        st = self._stats
+        mean_service_s = (float(np.mean(st.service_ms)) / 1e3
+                          if st.service_ms else
+                          self.config.coalesce_window_s)
+        backlog = sum(q.total for q in self._queues.values())
+        dispatches = max(1, -(-int(backlog) // self._max_bucket))
+        return float(min(max(dispatches * mean_service_s,
+                             self.config.coalesce_window_s), 5.0))
 
     def serve(self, req: SimRequest, timeout: Optional[float] = None
               ) -> ServeResult:
@@ -297,6 +328,29 @@ class ServePool:
         return best[0] if best else None
 
     def _dispatch_loop(self):
+        # a dead dispatcher used to strand every queued request in a
+        # silent hang; now the death is flight-recorded and every pending
+        # future fails LOUDLY with the cause (docs/RELIABILITY.md)
+        try:
+            self._dispatch_loop_inner()
+        except BaseException as exc:   # noqa: BLE001 — recorded + failed
+            flightrec.note("serve_dispatcher_died", error=repr(exc)[:300])
+            err = ServeError(f"serve dispatcher thread died: {exc!r}; "
+                             f"queued requests failed, pool is closed")
+            err.__cause__ = exc
+            with self._cond:
+                self._closed = True
+                n = 0
+                for q in self._queues.values():
+                    while q:
+                        q.popleft().fut.set_exception(err)
+                        n += 1
+                self._pending -= n
+                self._stats.failed += n
+                self._cond.notify_all()
+            raise
+
+    def _dispatch_loop_inner(self):
         while True:
             with self._cond:
                 while self._pending == 0 and not self._closed:
@@ -346,27 +400,74 @@ class ServePool:
         p0 = cohort[0]
         run_kwargs = p0.req.run_kwargs()
         bucket = self.bucket_for(total)
+        lanes = [(p.req.seed, p.req.n) for p in cohort]
         t_d0 = obs.now()
-        try:
-            entry = self._pool.get(p0.spec_hash, p0.req.spec)
-            warm_s = entry.ensure_warm(
-                bucket, p0.req.lane_token(), run_kwargs,
-                cache_active=bool(self._pool.cache_dir))
-            lanes = [(p.req.seed, p.req.n) for p in cohort]
-            out = entry.sim.run(bucket, chunk=bucket, lanes=lanes,
-                                pipeline_depth=self.config.pipeline_depth,
-                                **run_kwargs)
-        except BaseException as exc:   # noqa: BLE001 — forwarded to callers
-            flightrec.note("serve_request_failed", kind=p0.req.kind,
-                           cohort=len(cohort), bucket=int(bucket),
-                           error=repr(exc)[:300])
-            err = ServeError(f"dispatch failed: {exc!r}")
-            err.__cause__ = exc
-            with self._lock:
-                self._stats.failed += len(cohort)
-            for p in cohort:
-                p.fut.set_exception(err)
-            return
+        attempts, evicted = 0, False
+        delay = self.config.retry_backoff_s
+        while True:
+            try:
+                # chaos site: the serve dispatcher (docs/RELIABILITY.md)
+                act = faults_mod.check("serve.dispatch",
+                                       cohort=len(cohort),
+                                       bucket=int(bucket))
+                entry = self._pool.get(p0.spec_hash, p0.req.spec)
+                warm_s = entry.ensure_warm(
+                    bucket, p0.req.lane_token(), run_kwargs,
+                    cache_active=bool(self._pool.cache_dir))
+                out = entry.sim.run(
+                    bucket, chunk=bucket, lanes=lanes,
+                    pipeline_depth=self.config.pipeline_depth,
+                    **run_kwargs)
+                if act == "poison":
+                    out["curves"] = np.asarray(out["curves"]) * np.nan
+                if not np.isfinite(np.asarray(out["curves"])).all():
+                    raise _PoisonedOutput(
+                        f"dispatch returned non-finite curves at bucket "
+                        f"{bucket} (poisoned executable)")
+                break
+            except BaseException as exc:   # noqa: BLE001 — triaged below,
+                # forwarded to callers when recovery is exhausted
+                if (isinstance(exc, _PoisonedOutput) and not evicted):
+                    # degradation ladder: evict the poisoned executable
+                    # from the warm pool, recompile, re-dispatch ONCE —
+                    # the rebuilt entry serves the same lanes
+                    # bit-identically (docs/RELIABILITY.md)
+                    flightrec.note("serve_poisoned_executable",
+                                   spec=p0.spec_hash, bucket=int(bucket))
+                    self._pool.evict(p0.spec_hash)
+                    evicted = True
+                    with self._lock:
+                        self._stats.evicted += 1
+                    continue
+                if (not isinstance(exc, _PoisonedOutput)
+                        and faults_mod.classify(exc) == "transient"
+                        and attempts < self.config.max_dispatch_retries):
+                    attempts += 1
+                    flightrec.note("serve_dispatch_retry",
+                                   attempt=attempts,
+                                   error=repr(exc)[:200])
+                    with self._lock:
+                        self._stats.retried += 1
+                    faults_mod.sleep(delay)
+                    delay = min(delay * 2.0, 2.0)
+                    continue
+                flightrec.note("serve_request_failed", kind=p0.req.kind,
+                               cohort=len(cohort), bucket=int(bucket),
+                               error=repr(exc)[:300])
+                err = ServeError(f"dispatch failed: {exc!r}")
+                err.__cause__ = exc
+                with self._lock:
+                    self._stats.failed += len(cohort)
+                for p in cohort:
+                    p.fut.set_exception(err)
+                if not isinstance(exc, Exception):
+                    # BaseException (simulated process kill, interpreter
+                    # shutdown): the cohort is failed loudly above, then
+                    # the dispatcher itself dies — _dispatch_loop fails
+                    # every still-queued request and flight-records the
+                    # death, so nothing ever hangs silently
+                    raise
+                return
         t_d1 = obs.now()
         rep = out["report"]
         with self._lock:
@@ -522,6 +623,12 @@ class ServePool:
                 "serve_retraces": st.retraces,
                 "serve_steady_compiles": st.steady_compiles,
                 "serve_warm_s": round(st.warm_s, 3),
+                # recovery health (docs/RELIABILITY.md): transient
+                # dispatch retries and poisoned-executable evictions both
+                # keep the lower-is-better default — growth past the zero
+                # history IS the serving path degrading
+                "serve_dispatch_retries": st.retried,
+                "serve_evictions": st.evicted,
             }
         return out
 

@@ -38,7 +38,18 @@ class ServeError(RuntimeError):
 
 class ServeBusy(ServeError):
     """Admission rejected: the pending-request queue is at its configured
-    depth (the 429 of the serving layer — back off and retry)."""
+    depth (the 429 of the serving layer — back off and retry).
+
+    ``retry_after_s`` is the scheduler's computed backoff hint — the
+    estimated time to drain the current backlog (pending realizations /
+    recent dispatch service rate, floored at the coalesce window) — the
+    serving analog of a 429's ``Retry-After`` header. Clients honoring it
+    (the built-in loadgen does) converge on the pool's actual service rate
+    instead of hammering a fixed sleep."""
+
+    def __init__(self, msg: str = "", retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = float(retry_after_s)
 
 
 class ServeTimeout(ServeError):

@@ -9,12 +9,49 @@ reference has is re-derivability of a realization from ``signal_model``).
 
 from __future__ import annotations
 
+import io
 import json
+import os
 import pickle
+import zipfile
+import zlib
 from pathlib import Path
 from typing import Optional
 
 import numpy as np
+
+
+def write_atomic(path, data: bytes) -> int:
+    """Crash-safe file write: tmp + fsync + rename + directory fsync.
+
+    The rename is atomic on POSIX, so a reader never sees a half-written
+    file under the final name; the two fsyncs (file data before the
+    rename, the directory entry after) close the crash window where the
+    rename survives a power loss but the data pages do not — the classic
+    torn-write. Returns the CRC32 of ``data`` (the checksum the checkpoint
+    manifests record, so resume can *detect* the torn writes that fsync
+    cannot prevent on failing storage). See docs/RELIABILITY.md.
+    """
+    path = Path(path)
+    tmp = path.with_name(path.name + ".tmp")
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dirfd = os.open(path.parent, os.O_RDONLY)
+    try:
+        os.fsync(dirfd)
+    finally:
+        os.close(dirfd)
+    return zlib.crc32(data)
+
+
+def npz_bytes(**arrays) -> bytes:
+    """Serialize arrays to npz *bytes* (for :func:`write_atomic`)."""
+    buf = io.BytesIO()
+    np.savez(buf, **arrays)
+    return buf.getvalue()
 
 
 def save_array(psrs, path):
@@ -63,13 +100,50 @@ class EnsembleCheckpoint:
     from ``fold_in(base_key, absolute_index)``, a resumed run continues the
     *identical* realization stream — the result equals the uninterrupted run,
     which the tests assert.
+
+    **Hardened** (docs/RELIABILITY.md): every file lands via
+    :func:`write_atomic` (tmp + fsync + rename + dir fsync), the manifest
+    records a CRC32 per chunk file, and :meth:`load` verifies them — a torn
+    or corrupt chunk file **rolls the checkpoint back to the last good
+    chunk** (bad files dropped, manifest rewritten, the rollback
+    flight-recorded) instead of resuming from garbage or crashing. The
+    resumed stream is still bit-identical to the uninterrupted run: rolled-
+    back chunks simply recompute from their absolute-index keys.
     """
 
     def __init__(self, path):
         self.path = Path(path)
+        self._sums: dict = {}      # chunk index -> CRC32 (manifest-backed)
 
     def _chunk_path(self, k: int) -> Path:
         return self.path.with_name(self.path.name + f".c{k:06d}.npz")
+
+    def _write_manifest(self, seed, nreal: int, chunk: int, done: int,
+                        n_extra: int) -> None:
+        n_chunks = done // chunk
+        manifest = dict(seed=np.int64(seed), nreal=np.int64(nreal),
+                        chunk=np.int64(chunk), done=np.int64(done),
+                        n_extra=np.int64(n_extra),
+                        sums=np.asarray([self._sums.get(k, 0)
+                                         for k in range(n_chunks)],
+                                        dtype=np.int64))
+        write_atomic(self.path, npz_bytes(**manifest))
+
+    def _rollback(self, seed, nreal: int, chunk: int, good: int,
+                  total: int, n_extra: int) -> None:
+        """Drop chunks ``good..total-1`` and rewrite the manifest — the
+        torn-write recovery path (resume recomputes the dropped chunks
+        from their absolute-index keys, bit-identically)."""
+        from ..obs import flightrec
+        for k in range(good, total):
+            self._chunk_path(k).unlink(missing_ok=True)
+            self._sums.pop(k, None)
+        flightrec.note("ckpt_rollback", path=str(self.path), good=good,
+                       dropped=total - good)
+        if good == 0:
+            self.delete()
+        else:
+            self._write_manifest(seed, nreal, chunk, good * chunk, n_extra)
 
     def load(self, seed, nreal: int, chunk: int, keep_corr: bool = True,
              n_extra: int = 0) -> Optional[dict]:
@@ -80,11 +154,25 @@ class EnsembleCheckpoint:
         ``n_extra`` is the expected extra packed-lane count (the OS lanes of
         a ``run(os=...)``); a mismatch means the checkpoint was written by a
         run with a different detection configuration and must not resume.
+
+        Torn-write detection: each chunk file's bytes are checked against
+        the manifest's CRC32 before use; the first bad chunk triggers a
+        rollback to the last good one (``state["rolled_back"]`` counts the
+        dropped chunks — the engine's ``faults.rollbacks`` counter). An
+        unreadable manifest is flight-recorded and treated as no
+        checkpoint: the restarted run reproduces the stream from scratch.
         """
         if not self.path.exists():
             return None
-        with np.load(self.path, allow_pickle=False) as z:
-            manifest = {k: z[k] for k in z.files}
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                manifest = {k: z[k] for k in z.files}
+        except (OSError, ValueError, zipfile.BadZipFile) as exc:
+            from ..obs import flightrec
+            flightrec.note("ckpt_manifest_corrupt", path=str(self.path),
+                           error=repr(exc)[:200])
+            self.delete()
+            return None
         if (int(manifest["seed"]) != int(seed) or int(manifest["nreal"]) != nreal
                 or int(manifest["chunk"]) != chunk):
             raise ValueError(
@@ -105,13 +193,39 @@ class EnsembleCheckpoint:
                 f"checkpoint {self.path} has no chunk files (written by an "
                 f"older single-file format, or the .c*.npz files were removed); "
                 f"delete it and restart the run")
+        sums = manifest.get("sums")   # absent on pre-hardening checkpoints
+        total = done // chunk
         parts = []
-        for k in range(done // chunk):
-            with np.load(self._chunk_path(k), allow_pickle=False) as z:
-                keys = [key for key in z.files if keep_corr or key != "corr"]
-                parts.append({key: z[key] for key in keys})
+        good = total
+        self._sums = {}
+        for k in range(total):
+            try:
+                data = self._chunk_path(k).read_bytes()
+                crc = zlib.crc32(data)
+                if sums is not None and k < len(sums) and crc != int(sums[k]):
+                    raise ValueError(
+                        f"chunk {k} checksum mismatch (torn write)")
+                with np.load(io.BytesIO(data), allow_pickle=False) as z:
+                    keys = [key for key in z.files
+                            if keep_corr or key != "corr"]
+                    parts.append({key: z[key] for key in keys})
+                self._sums[k] = crc
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile) as exc:
+                from ..obs import flightrec
+                flightrec.note("ckpt_chunk_corrupt", chunk=k,
+                               error=repr(exc)[:200])
+                good = k
+                parts = parts[:good]
+                break
+        if good < total:
+            self._rollback(seed, nreal, chunk, good, total, saved_extra)
+            done = good * chunk
+            if good == 0:
+                return None
         state = {
             "done": done,
+            "rolled_back": total - good,
             "curves": np.concatenate([p["curves"] for p in parts]),
             "autos": np.concatenate([p["autos"] for p in parts]),
         }
@@ -127,28 +241,35 @@ class EnsembleCheckpoint:
 
         ``extra`` holds any additional packed statistic lanes (the OS lanes
         of a ``run(os=...)``) so a resumed detection run keeps them too.
+        Both writes are atomic (:func:`write_atomic`) and the manifest —
+        written last, so a crash between the two leaves an unreferenced
+        chunk file the next save overwrites — carries the chunk CRCs.
         """
+        from .. import faults
         self.path.parent.mkdir(parents=True, exist_ok=True)
+        act = faults.check("ckpt.append", done=int(done))
         payload = dict(curves=curves, autos=autos)
         if corr is not None:
             payload["corr"] = corr
         if extra is not None:
             payload["extra"] = extra
-        cpath = self._chunk_path(done // chunk - 1)
-        tmp = cpath.with_suffix(".tmp.npz")
-        np.savez(tmp, **payload)
-        tmp.replace(cpath)
-        # manifest last: a crash between the two writes leaves an unreferenced
-        # chunk file that the next save simply overwrites
-        manifest = dict(seed=np.int64(seed), nreal=np.int64(nreal),
-                        chunk=np.int64(chunk), done=np.int64(done),
-                        n_extra=np.int64(0 if extra is None
-                                         else np.shape(extra)[1]))
-        tmp = self.path.with_suffix(".tmp.npz")
-        np.savez(tmp, **manifest)
-        tmp.replace(self.path)
+        k = done // chunk - 1
+        cpath = self._chunk_path(k)
+        self._sums[k] = write_atomic(cpath, npz_bytes(**payload))
+        self._write_manifest(seed, nreal, chunk, done,
+                             0 if extra is None else np.shape(extra)[1])
+        if act == "torn":
+            # chaos harness: simulate the torn write fsync cannot prevent
+            # (failing storage drops the data pages AFTER the rename became
+            # durable) and the process dying with it — resume must detect
+            # the bad CRC and roll back to the last good chunk
+            data = cpath.read_bytes()
+            cpath.write_bytes(data[:max(len(data) // 2, 1)])
+            raise faults.KillFault(
+                f"injected torn checkpoint write at chunk {k}")
 
     def delete(self):
         for p in self.path.parent.glob(self.path.name + ".c*.npz"):
             p.unlink(missing_ok=True)
         self.path.unlink(missing_ok=True)
+        self._sums = {}

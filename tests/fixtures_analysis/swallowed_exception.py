@@ -1,0 +1,66 @@
+"""Corpus: swallowed-exception true positives + clean near-misses."""
+import logging
+import warnings
+
+
+def bad_silent_pass(fn):
+    try:
+        fn()
+    except Exception:
+        pass
+
+
+def bad_bare_except(fn):
+    try:
+        fn()
+    except:  # noqa: E722
+        return None
+
+
+def bad_tuple_with_broad(fn):
+    try:
+        fn()
+    except (ValueError, Exception):
+        return -1
+
+
+def bad_bound_but_unused(fn):
+    try:
+        fn()
+    except BaseException as exc:  # noqa: F841
+        return None
+
+
+def ok_narrow(fn):
+    try:
+        fn()
+    except FileNotFoundError:
+        pass
+
+
+def ok_reraise(fn):
+    try:
+        fn()
+    except Exception:
+        raise
+
+
+def ok_forwards(fn, sink):
+    try:
+        fn()
+    except Exception as exc:
+        sink.exc = exc
+
+
+def ok_records_warn(fn):
+    try:
+        fn()
+    except Exception:
+        warnings.warn("fn failed; continuing without it")
+
+
+def ok_records_log(fn):
+    try:
+        fn()
+    except Exception:
+        logging.getLogger(__name__).error("fn failed")

@@ -1,0 +1,474 @@
+"""Chaos matrix: deterministic fault injection x engine-wide recovery.
+
+The acceptance contract of ``fakepta_tpu.faults`` (docs/RELIABILITY.md):
+with a seeded :class:`FaultPlan` arming each site, every injected fault
+either
+
+- **recovers** — the run's packed streams bit-identical to the unfaulted
+  run at the same executable shape (tolerance-certified when a degradation
+  changes the executable shape: XLA's statistic-reduction order is
+  shape-dependent, docs/INVARIANTS.md), or
+- **fails loudly** — the run aborts with the failure type intact and a
+  flight-recorder dump beside it.
+
+Zero silent-corruption outcomes. Sites covered: ``mc.dispatch`` /
+``mc.recycle`` (chunk dispatch + donated-ring recycle), ``pipeline.writer``
+(drain thread), ``ckpt.append`` (torn-write + kill-resume),
+``cache.load`` (compile-cache wiring), ``serve.dispatch`` (the scheduler),
+``sample.segment`` (the MCMC segment loop).
+"""
+
+import json
+import pathlib
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+import fakepta_tpu.faults as faults
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.parallel import pipeline as pipeline_mod
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+from fakepta_tpu.utils.io import EnsembleCheckpoint
+
+FAST = faults.RecoveryPolicy(backoff_s=0.001, max_backoff_s=0.01)
+
+
+def _gwb(batch, ncomp=5):
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-14.5, gamma=13 / 3))
+    return GWBConfig(psd=psd, orf="hd")
+
+
+@pytest.fixture(scope="module")
+def batch():
+    return PulsarBatch.synthetic(npsr=4, ntoa=32, tspan_years=5.0, seed=1)
+
+
+@pytest.fixture(scope="module")
+def sim(batch):
+    return EnsembleSimulator(batch, gwb=_gwb(batch), nbins=5)
+
+
+@pytest.fixture(scope="module")
+def baseline(sim):
+    out = sim.run(32, seed=3, chunk=8)
+    return {"curves": out["curves"], "autos": out["autos"]}
+
+
+def _run(sim, **kw):
+    kw.setdefault("recovery", FAST)
+    return sim.run(32, seed=3, chunk=8, **kw)
+
+
+# ---------------------------------------------------------------------------
+# mc.dispatch: transient retry, exhaustion, poison
+# ---------------------------------------------------------------------------
+
+def test_dispatch_transient_retry_bit_identical(sim, baseline):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "transient", at=(1,))])
+    with faults.inject(plan):
+        out = _run(sim)
+    assert plan.fired == [("mc.dispatch", "transient", 1)]
+    assert np.array_equal(out["curves"], baseline["curves"])
+    assert np.array_equal(out["autos"], baseline["autos"])
+    rep = out["report"]
+    assert rep.counters.get("faults.injected") == 1
+    assert rep.counters.get("faults.retries") == 1
+    assert any(ev["name"] == "retry" for ev in rep.timeline)
+
+
+def test_dispatch_transient_exhausted_fails_loud_with_dump(
+        sim, tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKEPTA_TPU_FLIGHTREC_DIR", str(tmp_path))
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "transient", at=(0, 1, 2, 3),
+                          times=4)])
+    with faults.inject(plan):
+        with pytest.raises(faults.TransientFault):
+            _run(sim, recovery=faults.RecoveryPolicy(max_retries=2,
+                                                     backoff_s=0.001))
+    dumps = list(tmp_path.glob("flightrec-*.json"))
+    assert dumps, "a fail-loud abort must leave a flight-recorder dump"
+    text = dumps[0].read_text()
+    assert "fault_fired" in text and "chunk_retry" in text
+
+
+def test_dispatch_poison_fails_loud_pipelined(sim, tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKEPTA_TPU_FLIGHTREC_DIR", str(tmp_path))
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "poison", at=(1,))])
+    with faults.inject(plan):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            _run(sim)
+    assert list(tmp_path.glob("flightrec-*.json"))
+
+
+def test_dispatch_poison_fails_loud_serial(sim):
+    # depth 0 + no checkpoint/progress: nothing materializes until the
+    # final fetch — the end-of-run guard still catches the poison
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "poison", at=(0,))])
+    with faults.inject(plan):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            _run(sim, pipeline_depth=0)
+
+
+def test_recovery_disabled_propagates_immediately(sim):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "transient", at=(0,))])
+    with faults.inject(plan):
+        with pytest.raises(faults.TransientFault):
+            _run(sim, recovery=False)
+    assert plan.fired == [("mc.dispatch", "transient", 0)]
+
+
+def test_fault_plan_is_deterministic(sim):
+    seqs = []
+    for _ in range(2):
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("mc.dispatch", "transient", at=(1,)),
+             faults.FaultSpec("pipeline.writer", "transient", at=(2,))])
+        with faults.inject(plan):
+            _run(sim)
+        seqs.append(tuple(plan.fired))
+    assert seqs[0] == seqs[1] != ()
+
+
+# ---------------------------------------------------------------------------
+# pipeline.writer: drain retry + watchdog on a hung drain
+# ---------------------------------------------------------------------------
+
+def test_writer_transient_retry_recovers(sim, baseline):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("pipeline.writer", "transient", at=(1,))])
+    with faults.inject(plan):
+        out = _run(sim)
+    assert plan.fired == [("pipeline.writer", "transient", 1)]
+    assert np.array_equal(out["curves"], baseline["curves"])
+    assert out["report"].counters.get("faults.retries") == 1
+
+
+def test_writer_hang_watchdog_aborts_with_dump(sim, tmp_path, monkeypatch):
+    monkeypatch.setenv("FAKEPTA_TPU_FLIGHTREC_DIR", str(tmp_path))
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("pipeline.writer", "hang", at=(0,), hang_s=3.0)])
+    with faults.inject(plan):
+        with pytest.raises(faults.WatchdogTimeout):
+            _run(sim, recovery=faults.RecoveryPolicy(watchdog_s=0.25))
+    dumps = list(tmp_path.glob("flightrec-*.json"))
+    assert dumps and "watchdog" in dumps[0].read_text()
+
+
+# ---------------------------------------------------------------------------
+# degradation ladders
+# ---------------------------------------------------------------------------
+
+def test_path_degradation_fused_to_xla(batch, sim, baseline):
+    # fused (interpret-mode pallas) at f32 so the degraded executable is
+    # the same precision as the xla baseline; the shapes differ, so the
+    # certification is the engine's reduction tolerance, not bit-identity
+    simf = EnsembleSimulator(batch, gwb=_gwb(batch), nbins=5,
+                             use_pallas=True, pallas_precision="f32")
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "degrade", at=(0,))])
+    with faults.inject(plan):
+        out = _run(simf)
+    rep = out["report"]
+    assert rep.meta.get("degraded_path") == "xla"
+    assert rep.counters.get("faults.degradations") == 1
+    assert any(ev["name"] == "degrade" for ev in rep.timeline)
+    scale = float(np.abs(baseline["curves"]).max()) or 1.0
+    np.testing.assert_allclose(out["curves"], baseline["curves"],
+                               rtol=1e-5, atol=1e-5 * scale)
+
+
+def test_precision_degradation_bf16_to_f32(sim, baseline):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.dispatch", "precision", at=(0,))])
+    with faults.inject(plan):
+        out = _run(sim, precision="bf16")
+    rep = out["report"]
+    assert rep.meta.get("degraded_precision") == "f32"
+    assert rep.counters.get("faults.degradations") == 1
+    # every chunk re-dispatched at f32 (the fault hit chunk 0): the whole
+    # run is the f32 program, bit-identical to the f32 baseline
+    assert np.array_equal(out["curves"], baseline["curves"])
+
+
+def test_recycle_donation_miss_degrades_not_aborts(sim, baseline):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("mc.recycle", "donation", at=(0,))])
+    with faults.inject(plan):
+        out = _run(sim)    # ledger would raise at check() without recovery
+    rep = out["report"]
+    assert rep.meta.get("degraded_donation") is True
+    assert rep.counters.get("faults.degradations") == 1
+    assert rep.memory.get("packed_ring_degraded") == 1
+    assert np.array_equal(out["curves"], baseline["curves"])
+
+
+# ---------------------------------------------------------------------------
+# ckpt.append: torn writes, rollback, kill-resume
+# ---------------------------------------------------------------------------
+
+def test_ckpt_torn_write_kill_resume_bit_identical(sim, baseline, tmp_path):
+    ck = str(tmp_path / "ck.npz")
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("ckpt.append", "torn", at=(2,))])
+    with faults.inject(plan):
+        with pytest.raises(faults.KillFault):
+            _run(sim, checkpoint=ck)
+    # the torn chunk file is on disk and referenced by the manifest;
+    # resume must detect the bad CRC, roll back to the last good chunk
+    # and reproduce the uninterrupted stream bit-for-bit
+    out = _run(sim, checkpoint=ck)
+    assert np.array_equal(out["curves"], baseline["curves"])
+    assert np.array_equal(out["autos"], baseline["autos"])
+    assert out["report"].counters.get("faults.rollbacks") == 1
+    assert not list(tmp_path.glob("ck.npz*")), "completed run cleans up"
+
+
+def test_ckpt_rollback_unit(tmp_path):
+    ck = EnsembleCheckpoint(tmp_path / "u.npz")
+    cur = lambda k: np.full((4, 3), float(k))        # noqa: E731
+    au = lambda k: np.full((4,), float(k))           # noqa: E731
+    for k in range(3):
+        ck.save(0, 12, 4, 4 * (k + 1), cur(k), au(k))
+    # tear the middle chunk: rollback must drop chunks 1 AND 2
+    p = ck._chunk_path(1)
+    p.write_bytes(p.read_bytes()[: p.stat().st_size // 2])
+    st = EnsembleCheckpoint(tmp_path / "u.npz").load(0, 12, 4)
+    assert st["done"] == 4 and st["rolled_back"] == 2
+    assert np.array_equal(st["curves"], cur(0))
+    # an unreadable manifest is a loud restart, never a crash
+    (tmp_path / "u.npz").write_bytes(b"garbage")
+    assert EnsembleCheckpoint(tmp_path / "u.npz").load(0, 12, 4) is None
+
+
+def test_cpu_cache_disables_donation_loudly(sim, baseline, tmp_path):
+    """XLA:CPU + persistent compile cache: executables loaded from the
+    on-disk cache carry aliasing metadata that can disagree with jax's
+    runtime donation bookkeeping — the observed failure is a whole-chunk
+    stream swap inside an already-drained host copy (use-after-free by
+    the async execution). The engine degrades donation OFF for such runs,
+    loudly, and the stream stays bit-identical (donation is a memory
+    optimization, never a values change). See docs/RELIABILITY.md."""
+    try:
+        assert pipeline_mod.configure_compile_cache(
+            str(tmp_path / "cache")) is not None
+        out = _run(sim)
+        rep = out["report"]
+        assert rep.meta.get("degraded_donation") is True
+        assert rep.counters.get("faults.degradations") == 1
+        assert rep.memory.get("packed_ring_degraded") == 1
+        assert np.array_equal(out["curves"], baseline["curves"])
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+def test_cache_load_failure_degrades_to_no_cache(tmp_path):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("cache.load", "transient", at=(0,))])
+    try:
+        with faults.inject(plan):
+            assert pipeline_mod.configure_compile_cache(
+                str(tmp_path / "cache")) is None
+        # and without a fault the same call wires the cache
+        assert pipeline_mod.configure_compile_cache(
+            str(tmp_path / "cache")) is not None
+    finally:
+        # un-wire: a process-wide persistent cache pointed at a dying
+        # tmp dir must not leak into every later test's compiles
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# sample.segment
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def sampler(batch):
+    from fakepta_tpu.infer import ComponentSpec, FreeParam, LikelihoodSpec
+    from fakepta_tpu.sample import SampleSpec, SamplingRun
+    model = LikelihoodSpec(components=(
+        ComponentSpec(target="curn", nbin=3, free=(
+            FreeParam("log10_A", (-15.5, -13.5)),
+            FreeParam("gamma", (2.5, 5.5)))),))
+    spec = SampleSpec(model=model, n_chains=8, n_temps=2, warmup=8,
+                      thin=2, n_leapfrog=3)
+    return SamplingRun(batch, spec)
+
+
+@pytest.fixture(scope="module")
+def sample_baseline(sampler):
+    return sampler.run(16, seed=5, segment=8)["theta"]
+
+
+def test_sample_segment_transient_retry_bit_identical(sampler,
+                                                      sample_baseline):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("sample.segment", "transient", at=(1,))])
+    with faults.inject(plan):
+        out = sampler.run(16, seed=5, segment=8, recovery=FAST)
+    assert plan.fired == [("sample.segment", "transient", 1)]
+    assert np.array_equal(out["theta"], sample_baseline)
+    assert out["report"].counters.get("faults.retries") == 1
+
+
+def test_sample_poison_fails_loud(sampler):
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("sample.segment", "poison", at=(1,))])
+    with faults.inject(plan):
+        with pytest.raises(FloatingPointError, match="non-finite"):
+            sampler.run(16, seed=5, segment=8, recovery=FAST)
+
+
+def test_sample_torn_ckpt_kill_restart_bit_identical(sampler,
+                                                     sample_baseline,
+                                                     tmp_path):
+    ck = str(tmp_path / "sck.json")
+    plan = faults.FaultPlan([faults.FaultSpec("ckpt.append", "torn",
+                                              at=(2,))])
+    with faults.inject(plan):
+        with pytest.raises(faults.KillFault):
+            sampler.run(16, seed=5, segment=8, checkpoint=ck,
+                        recovery=FAST)
+    out = sampler.run(16, seed=5, segment=8, checkpoint=ck, recovery=FAST)
+    assert np.array_equal(out["theta"], sample_baseline)
+
+
+# ---------------------------------------------------------------------------
+# serve.dispatch
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def serve_spec():
+    from fakepta_tpu.serve import ArraySpec
+    return ArraySpec(npsr=4, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                     nbins=5)
+
+
+def _make_pool(**kw):
+    from fakepta_tpu.serve import ServeConfig, ServePool
+    kw.setdefault("buckets", (8,))
+    kw.setdefault("retry_backoff_s", 0.001)
+    return ServePool(config=ServeConfig(**kw))
+
+
+def test_serve_transient_retry_and_poison_eviction(serve_spec):
+    from fakepta_tpu.serve import SimRequest
+    pool = _make_pool()
+    try:
+        req = SimRequest(spec=serve_spec, n=4, seed=7)
+        base = np.array(pool.serve(req, timeout=600).curves)
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("serve.dispatch", "transient", at=(0,))])
+        with faults.inject(plan):
+            res = pool.serve(req, timeout=600)
+        assert np.array_equal(res.curves, base)
+        # poisoned executable: evicted from the warm pool, recompiled,
+        # re-dispatched once — the response is served correctly
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("serve.dispatch", "poison", at=(0,))])
+        with faults.inject(plan):
+            res = pool.serve(req, timeout=600)
+        assert np.array_equal(res.curves, base)
+        slo = pool.slo_summary()
+        assert slo["serve_dispatch_retries"] == 1
+        assert slo["serve_evictions"] == 1
+        assert slo["serve_failed"] == 0
+    finally:
+        pool.close()
+
+
+def test_serve_busy_carries_retry_after_hint(serve_spec):
+    from fakepta_tpu.serve import ServeBusy, SimRequest
+    pool = _make_pool(max_queue_depth=1, coalesce_window_s=0.5)
+    try:
+        pool.submit(SimRequest(spec=serve_spec, n=4, seed=1))
+        with pytest.raises(ServeBusy) as ei:
+            # window holds the first request queued; depth 1 is full
+            pool.submit(SimRequest(spec=serve_spec, n=4, seed=2))
+        assert ei.value.retry_after_s >= 0.001
+        assert "retry in ~" in str(ei.value)
+    finally:
+        pool.close()
+
+
+def test_serve_dispatcher_death_fails_queued_loudly(serve_spec):
+    from fakepta_tpu.serve import SimRequest
+    from fakepta_tpu.serve.spec import ServeClosed, ServeError
+    pool = _make_pool()
+    # silence the dying dispatcher thread's traceback in the test log
+    quiet = threading.excepthook
+    threading.excepthook = lambda args: None
+    try:
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("serve.dispatch", "kill", at=(0,))])
+        with faults.inject(plan):
+            fut = pool.submit(SimRequest(spec=serve_spec, n=4, seed=7))
+            with pytest.raises(ServeError):
+                fut.result(timeout=60)
+        # the pool is closed by the death handler: nothing can hang on it
+        with pytest.raises(ServeClosed):
+            pool.serve(SimRequest(spec=serve_spec, n=4, seed=8))
+    finally:
+        threading.excepthook = quiet
+        pool.close()
+
+
+# ---------------------------------------------------------------------------
+# obs robustness satellites: gate corrupt rows, compare non-numeric
+# ---------------------------------------------------------------------------
+
+def test_gate_tolerates_corrupt_history_rows(tmp_path, capsys):
+    from fakepta_tpu.obs import cli as obs_cli
+    (tmp_path / "BENCH_r01.json").write_text("not json {{{")
+    (tmp_path / "BENCH_r02.json").write_text('{"parsed": null, "rc": 1}')
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        {"parsed": {"value": 100.0, "platform": "cpu",
+                    "partial": ["list", "value"]}, "rc": 0}))
+    (tmp_path / "BENCH_r04.json").write_text(json.dumps(
+        {"parsed": {"value": 102.0, "platform": "cpu"}, "rc": 0}))
+    new = tmp_path / "new.json"
+    new.write_text(json.dumps({"value": 101.0, "platform": "cpu",
+                               "weird": {"nested": 1}}))
+    rc = obs_cli.main(["gate", str(new), "--history",
+                       str(tmp_path / "BENCH_r0*.json"),
+                       "--fail-on-regression"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "skipping malformed history row" in captured.err
+    assert "crashed round" in captured.err
+    assert "value" in captured.out
+
+
+def test_compare_tolerates_non_numeric_summary_values():
+    from fakepta_tpu.obs.report import RunReport, format_delta
+    a = RunReport(meta={"nreal": 8, "extra_metrics": {"mode": "fast",
+                                                      "qps": 10.0}},
+                  total_s=1.0)
+    b = RunReport(meta={"nreal": 8, "extra_metrics": {"mode": "slow",
+                                                      "qps": 11.0}},
+                  total_s=1.0)
+    text, regressions = format_delta(a, b)   # must not TypeError
+    assert "mode" in text and "qps" in text
+    assert "fast" in text
+
+
+def test_load_history_warns_not_silently(tmp_path):
+    from fakepta_tpu.obs import gate as gate_mod
+    (tmp_path / "bad.json").write_text("{{{")
+    warnings_seen = []
+    rows = gate_mod.load_history([str(tmp_path / "bad.json")],
+                                 warn=warnings_seen.append)
+    assert rows == [] and len(warnings_seen) == 1

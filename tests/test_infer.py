@@ -179,6 +179,10 @@ def test_lnlike_lane_matches_host_oracle(batch64):
         np.testing.assert_allclose(lnl[0, k], want, rtol=1e-10)
 
 
+@pytest.mark.slow   # ~29 s: tier-1 budget reclaim for the chaos matrix
+# (tests/test_faults.py); the ECORR variant below keeps the lnlike-lane
+# mesh-invariance surface in tier-1 (it shards 'toa' through the ECORR
+# epoch sums too, the harder case)
 def test_lnlike_lane_mesh_invariance(batch64):
     """Acceptance: the lnlike lane is mesh-invariant across (real, psr, toa)
     shardings — 1x1x1 vs 2x2x2 and the single-axis extremes — for value AND

@@ -203,6 +203,10 @@ def test_mega_os_lanes_and_null(batch, mega_sim):
                                    g["amp2"], rtol=1e-5)
 
 
+@pytest.mark.slow   # ~20 s: tier-1 budget reclaim for the chaos matrix
+# (tests/test_faults.py); mega-path lnlike parity is also exercised by the
+# xla-projected-residual identity inside test_mega_with_det_and_sampling's
+# lane sweep and the fused acceptance lanes that stay tier-1
 def test_mega_lnlike_lane():
     """The likelihood lane under the megakernel: Woodbury moments read the
     XLA-projected residual from the SAME split draws, so lnL matches the

@@ -247,20 +247,26 @@ def test_warm_pool_and_manual_warm_start_share_cache_entry(tmp_path):
                      data_seed=7)
     mesh = make_mesh(jax.devices()[:1])
 
-    wp = WarmPool(mesh, compile_cache_dir=str(cache))
-    entry = wp.get(spec.spec_hash(), spec)
-    wp.prewarm(entry, (8,))
-    files_after_pool = sorted(f.name for f in cache.glob("*"))
-    assert files_after_pool, "prewarm wrote nothing to the compile cache"
+    try:
+        wp = WarmPool(mesh, compile_cache_dir=str(cache))
+        entry = wp.get(spec.spec_hash(), spec)
+        wp.prewarm(entry, (8,))
+        files_after_pool = sorted(f.name for f in cache.glob("*"))
+        assert files_after_pool, "prewarm wrote nothing to the compile cache"
 
-    # a FRESH simulator of the same spec, manually warm-started: the
-    # shared executable-key path must land on the existing cache entries
-    sim = spec.build(mesh=mesh, compile_cache_dir=str(cache))
-    sim.warm_start(8, lane_keys=True)
-    files_after_manual = sorted(f.name for f in cache.glob("*"))
-    assert files_after_manual == files_after_pool, (
-        "manual warm_start of the same spec/bucket compiled a NEW "
-        "executable — the warm pool and warm_start diverged")
+        # a FRESH simulator of the same spec, manually warm-started: the
+        # shared executable-key path must land on the existing cache entries
+        sim = spec.build(mesh=mesh, compile_cache_dir=str(cache))
+        sim.warm_start(8, lane_keys=True)
+        files_after_manual = sorted(f.name for f in cache.glob("*"))
+        assert files_after_manual == files_after_pool, (
+            "manual warm_start of the same spec/bucket compiled a NEW "
+            "executable — the warm pool and warm_start diverged")
+    finally:
+        # un-wire: the cache dir must not leak into later tests' compiles
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
 
 
 def test_lane_arrays_validation():

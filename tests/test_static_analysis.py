@@ -66,6 +66,9 @@ CASES = [
      {("unbounded-queue", 7), ("unbounded-queue", 8),
       ("unbounded-queue", 9), ("unbounded-queue", 10),
       ("unbounded-queue", 11), ("unbounded-queue", 12)}),
+    ("swallowed_exception.py", LIB,
+     {("swallowed-exception", 9), ("swallowed-exception", 16),
+      ("swallowed-exception", 23), ("swallowed-exception", 30)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -114,6 +117,9 @@ def test_dtype_policy_paths_exist():
     for rel in policy.UNBOUNDED_QUEUE_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale UNBOUNDED_QUEUE_MODULES entry: {rel}"
+    for rel in policy.SWALLOWED_EXCEPT_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale SWALLOWED_EXCEPT_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
