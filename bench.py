@@ -102,6 +102,18 @@ RunReport each ``sim.run()`` attaches):
   ``benchmarks/suite.py`` config 12 additionally measures the recovery
   overhead itself (``fault_recovery_overhead_frac``: wall-clock cost of
   one injected-and-retried transient per run, bit-identity asserted);
+- ``tuned`` / ``tune_probe_s`` / ``tuned_real_per_s_per_chip`` /
+  ``tuned_speedup_x``: the autotuner lane (``fakepta_tpu.tune``,
+  docs/TUNING.md). ``tuned`` flags that autotuned knobs rode the A/B run
+  (exempt under ``obs compare``/``gate`` — a run-shape fact);
+  ``tune_probe_s`` is the wall-clock the search spent probing this round
+  (0 on a warm store — the persisted TunedConfig made the search one
+  file read; lower-better, growth means the store stopped warming);
+  ``tuned_real_per_s_per_chip`` the tuned run's steady throughput and
+  ``tuned_speedup_x`` its multiple of the hand-set measurement above on
+  the same simulator (both higher-better; the search always probes the
+  hand-set default candidate first, so the tuner can select but never
+  silently lose to it);
 - ``peak_hbm_bytes``: the measured run's HBM watermark from the RunReport's
   memwatch lane (allocator ``peak_bytes_in_use`` max-aggregated over local
   devices and over the low-rate in-run sampler where the backend exposes
@@ -166,7 +178,11 @@ def main():
     # longer run measures steady-state throughput instead of the ~80 ms
     # flat-latency host round-trip of the remote-TPU tunnel). The CPU fallback
     # runs a reduced count so a dead tunnel still yields a labeled number.
-    platform = jax.devices()[0].platform
+    # Platform identity is single-sourced through the tuner's fingerprint
+    # (fakepta_tpu.tune) — the same probe `obs gate`'s same-platform row
+    # matching and suite.py's platform column read.
+    from fakepta_tpu import tune as tune_mod
+    platform = tune_mod.fingerprint().platform
     nreal, chunk = (100_000, 10_000) if platform != "cpu" else (2_000, 1_000)
     warm = sim.run(chunk, seed=99, chunk=chunk)  # compile + warm up
     t0 = time.perf_counter()
@@ -215,6 +231,42 @@ def main():
                          ("faults_degradations", "faults.degradations"),
                          ("faults_rollbacks", "faults.rollbacks")):
         row[key] = int(rep.counters.get(counter, 0))
+
+    # the autotuner lane (fakepta_tpu.tune, docs/TUNING.md): search the
+    # dispatch-knob space for THIS platform fingerprint (warm store =>
+    # zero probes, zero compiles — tune_probe_s records either way), then
+    # A/B a tuned run() against the hand-set measurement above on the
+    # same simulator. tuned_speedup_x >= ~1 is the acceptance: the tuner
+    # may never lose to the hand-set knobs it was seeded with (the
+    # default candidate is always probed first), and `obs gate` bands the
+    # ratio across rounds.
+    tuned_cfg, tune_info = tune_mod.search(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"), nreal_hint=nreal,
+        max_candidates=8)
+    row["tuned"] = 1
+    row["tune_probe_s"] = round(float(tune_info["probe_s"]), 2)
+    chunk_t = int(tuned_cfg.knobs.get("chunk", chunk))
+    nreal_ab = min(nreal, 4 * max(chunk_t, chunk))
+    # warm the tuned-shape executable first (mirrors the probe protocol
+    # and the hand-set side's own warm-up above): the pipelined steady
+    # split credits the compile-bearing dispatch's realizations but not
+    # its device time, so an unwarmed A/B would under-report the tuned
+    # side by ~chunk/nreal. The A/B itself interleaves hand-set and
+    # tuned measurements best-of-2 — comparing a fresh tuned number
+    # against the minutes-old headline would fold host drift into the
+    # speedup
+    sim.run(chunk_t, seed=96, tuned=tuned_cfg)
+    hand_rate = tuned_rate = 0.0
+    for _ in range(2):
+        out_h = sim.run(nreal_ab, seed=1, chunk=chunk)
+        hand_rate = max(hand_rate,
+                        out_h["report"].steady_real_per_s_per_chip())
+        out_t = sim.run(nreal_ab, seed=1, tuned=tuned_cfg)
+        tuned_rate = max(tuned_rate,
+                         out_t["report"].steady_real_per_s_per_chip())
+    row["tuned_real_per_s_per_chip"] = round(tuned_rate, 2)
+    if hand_rate > 0:
+        row["tuned_speedup_x"] = round(tuned_rate / hand_rate, 3)
 
     # the detection lane (fakepta_tpu.detect): same flagship program with the
     # on-device optimal statistic packed beside curves/autos — measured

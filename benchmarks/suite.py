@@ -23,7 +23,12 @@ device likelihood, ``fakepta_tpu.infer``) and the sampling-lane figures
 ``serve_qps_per_chip`` / ``serve_p50_ms`` / ``serve_p99_ms`` /
 ``coalesce_factor`` / ``serve_speedup_x`` from the built-in synthetic load
 generator over the warm-pool scheduler (``fakepta_tpu.serve``,
-docs/SERVING.md — see the bench.py docstring for the full schema).
+docs/SERVING.md) and the autotuner lane's ``tuned`` / ``tune_probe_s`` /
+``tuned_real_per_s_per_chip`` / ``tuned_speedup_x`` A/B
+(``fakepta_tpu.tune``, docs/TUNING.md — see the bench.py docstring for
+the full schema). Every row's ``platform`` column reads
+``tune.fingerprint()``, the same single source ``obs gate`` bands rows
+with.
 
     python benchmarks/suite.py                 # all configs, default sizes
     python benchmarks/suite.py --configs 1 2   # subset
@@ -532,6 +537,36 @@ def config5():
                 "rhat_max", "accept_rate"):
         row[key] = s_sum[key]
 
+    # the autotuner lane (fakepta_tpu.tune, docs/TUNING.md): search this
+    # platform fingerprint's dispatch knobs (warm store => zero probes)
+    # and A/B a tuned run against the hand-set measurement above — the
+    # bench.py docstring documents the row schema, `obs gate` bands
+    # tuned_speedup_x (higher-better) and tune_probe_s (lower-better)
+    from fakepta_tpu import tune as tune_mod
+    tuned_cfg, tune_info = tune_mod.search(
+        batch, gwb=GWBConfig(psd=psd, orf="hd"), nreal_hint=nreal,
+        max_candidates=8)
+    row["tuned"] = 1
+    row["tune_probe_s"] = round(float(tune_info["probe_s"]), 2)
+    chunk_t = int(tuned_cfg.knobs.get("chunk", chunk))
+    # warm the tuned-shape executable, then interleave hand-set and
+    # tuned measurements best-of-2 (the bench.py A/B protocol: the
+    # pipelined steady split would otherwise charge the tuned side its
+    # compile, and a non-interleaved comparison folds host drift in)
+    sim.run(chunk_t, seed=96, tuned=tuned_cfg)
+    nreal_ab = min(nreal, 4 * max(chunk_t, chunk))
+    hand_rate = tuned_rate = 0.0
+    for _ in range(2):
+        out_h = sim.run(nreal_ab, seed=1, chunk=chunk)
+        hand_rate = max(hand_rate,
+                        out_h["report"].steady_real_per_s_per_chip())
+        out_t = sim.run(nreal_ab, seed=1, tuned=tuned_cfg)
+        tuned_rate = max(tuned_rate,
+                         out_t["report"].steady_real_per_s_per_chip())
+    row["tuned_real_per_s_per_chip"] = round(tuned_rate, 2)
+    if hand_rate > 0:
+        row["tuned_speedup_x"] = round(tuned_rate / hand_rate, 3)
+
     # the serving lane (fakepta_tpu.serve, docs/SERVING.md): the built-in
     # load generator over a warm pool + microbatch coalescing scheduler —
     # request throughput, latency SLOs, coalescing stats and the speedup
@@ -638,9 +673,15 @@ def main():
            11: config11, 12: config12}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
+    # platform identity single-sourced through the tuner's fingerprint
+    # (fakepta_tpu.tune) — the same probe `obs gate` uses for same-platform
+    # row matching, so a suite row and the gate can never disagree about
+    # which platform group a round belongs to
+    from fakepta_tpu import tune as tune_mod
+    platform = tune_mod.fingerprint().platform
     for c in args.configs:
         row = fns[c]()
-        row["platform"] = jax.devices()[0].platform
+        row["platform"] = platform
         if fallback:
             row["fallback"] = "accelerator backend unavailable; CPU stand-in"
         if _NREAL_SCALE != 1.0 and c in ensemble_configs:

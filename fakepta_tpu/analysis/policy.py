@@ -144,6 +144,17 @@ SWALLOWED_EXCEPT_MODULES = (
     "fakepta_tpu/obs/memwatch.py",
 )
 
+# hardcoded-dispatch-knob allowlist: the ONE library module where literal
+# dispatch-knob values (megakernel rt, pipeline_depth, bucket ladders) may
+# live — the hand-set defaults the autotuner A/Bs against
+# (fakepta_tpu.tune, docs/TUNING.md). Every other library call site must
+# plumb knobs from a caller, a TunedConfig, or tune/defaults.py; tests,
+# examples and benchmarks are exempt (their pinned knobs are the
+# experimental conditions being measured).
+DISPATCH_KNOB_MODULES = (
+    "fakepta_tpu/tune/defaults.py",
+)
+
 # Library code prefix: rules with a library-only clause (literal re-seeding,
 # dtype policy) fire only under it.
 LIBRARY_PREFIXES = ("fakepta_tpu/",)

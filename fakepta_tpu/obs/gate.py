@@ -76,11 +76,39 @@ def load_row(path) -> dict:
         except (ValueError, json.JSONDecodeError):
             row = None
         if row is not None and "kind" not in row:
-            return row
+            return _ensure_platform(row)
     rep = RunReport.load(path)
     row = dict(rep.summary())
     if rep.meta.get("platform") is not None:
         row["platform"] = rep.meta["platform"]
+    return _ensure_platform(row)
+
+
+def _ensure_platform(row: dict) -> dict:
+    """Fill a missing ``platform`` from the tuner's platform fingerprint
+    (:func:`fakepta_tpu.tune.fingerprint` — the repo's single source of
+    platform identity, shared with ``benchmarks/suite.py``'s column).
+
+    A row with no platform used to band against NOTHING (``None`` matches
+    no history group) — silently informational forever. Filling it from
+    the fingerprint keeps the invariant that matters: stand-in rows can
+    still never gate accelerator rows, because the fingerprint of the
+    machine running the gate IS the stand-in's platform. Rows that carry
+    their platform (every bench row since r06) are returned untouched, so
+    gating someone else's row never consults the local runtime.
+    """
+    if row.get("platform") is not None:
+        return row
+    try:
+        from ..tune import fingerprint
+        row = dict(row)
+        row["platform"] = fingerprint().platform
+    except Exception as exc:   # noqa: BLE001 — recorded, not swallowed
+        # no jax runtime here (bare gate CLI on a build box): the row
+        # stays platform-less and informational, with the reason kept
+        warnings.warn(f"could not fingerprint the platform for a "
+                      f"platform-less row: {exc!r}", RuntimeWarning,
+                      stacklevel=2)
     return row
 
 

@@ -42,7 +42,14 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # amortizing dispatches. serve_p50_ms/serve_p99_ms and
                     # pad_waste_frac keep the lower-is-better default.
                     "serve_qps_per_chip", "serve_serial_qps_per_chip",
-                    "serve_speedup_x", "coalesce_factor"}
+                    "serve_speedup_x", "coalesce_factor",
+                    # the autotuner (fakepta_tpu.tune, docs/TUNING.md):
+                    # tuned-vs-hand-set throughput multiple — dropping
+                    # below its band means the tuner stopped finding (or
+                    # keeping) wins; tune_probe_s keeps the lower-is-
+                    # better default (probe time is pure overhead) and
+                    # the `tuned` flag itself is exempt (a run-shape fact)
+                    "tuned_speedup_x", "tuned_real_per_s_per_chip"}
 
 # suffix rules cover the detect lane's per-ORF metric names
 # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
@@ -90,7 +97,14 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # themselves (faults_retries / faults_degradations /
                   # faults_rollbacks, lower-better defaults) and
                   # fault_recovery_overhead_frac (lower-better default)
-                  "faults_recovered", "packed_ring_degraded"}
+                  "faults_recovered", "packed_ring_degraded",
+                  # autotuner run-shape facts: whether tuned knobs rode
+                  # the run / how many probes the search issued are
+                  # configuration description, not performance (the
+                  # regression-bearing tune metrics are tuned_speedup_x,
+                  # tuned_real_per_s_per_chip — higher-better above — and
+                  # tune_probe_s, lower-better default)
+                  "tuned", "tune_probes"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")
@@ -222,6 +236,12 @@ class RunReport:
                 self.steady_real_per_s_per_chip(), 3)
             if self.cost.get("bytes_per_chunk"):
                 m["os_bytes_per_chunk"] = self.cost["bytes_per_chunk"]
+        if self.meta.get("tuned"):
+            # autotuned knobs rode this run (fakepta_tpu.tune): exempt
+            # flag so `compare` shows the attribution without treating a
+            # tuned/hand-set switch as a regression; the knob detail
+            # stays in meta["tuned"]["knobs"]
+            m["tuned"] = 1
         if self.meta.get("lnlike"):
             # a likelihood-lane run (fakepta_tpu.infer): the steady rate
             # times the grid size is the evaluation throughput bench.py /

@@ -42,6 +42,7 @@ from .. import faults as faults_mod
 from .. import obs
 from ..batch import PulsarBatch, fourier_basis_norm
 from ..ops import gwb as gwb_ops
+from ..tune import defaults as tune_defaults
 from ..utils import rng as rng_utils
 from ..utils.compat import enable_x64, shard_map
 from . import pipeline as pipeline_mod
@@ -2960,6 +2961,50 @@ class EnsembleSimulator:
             if ev is not None:
                 ev.set()
 
+    def dispatch_surface(self) -> dict:
+        """The problem-shaped identity and model inputs of this
+        simulator's chunk programs — what the autotuner keys on and feeds
+        its analytic models (:mod:`fakepta_tpu.tune`, docs/TUNING.md).
+
+        Deliberately knob-free: pulsar/TOA/bin counts, the concatenated GP
+        coefficient width (``k_coef`` — the megakernel stage table's
+        ``stage_k``, the same width :func:`~fakepta_tpu.ops.megakernel
+        .chunk_bytes_model` prices), and the batch dtype. Two simulators
+        with equal surfaces share one ``TunedConfig`` family regardless of
+        mesh, path or precision.
+        """
+        from ..ops.megakernel import stage_k
+
+        if self._mega_tables is None:
+            self._mega_tables = self._build_mega_tables()
+        dt = np.dtype(self.batch.t_own.dtype)
+        return {"npsr": int(self.batch.npsr),
+                "max_toa": int(self.batch.max_toa),
+                "nbins": int(self.nbins),
+                "k_coef": int(stage_k(self._mega_tables[0])),
+                "dtype": dt.name,
+                "dtype_bytes": int(dt.itemsize)}
+
+    def model_bytes_per_chunk(self, chunk: int, path=None,
+                              precision=None) -> int:
+        """Analytic HBM bytes of one chunk program, WITHOUT any lowering
+        or compile — the model-first half of :meth:`chunk_cost` (whose AOT
+        capture also measures; the autotuner prunes candidates with this
+        before paying any compile). Single-sourced with the cost capture
+        through :func:`~fakepta_tpu.ops.megakernel.chunk_bytes_model`."""
+        from ..ops.megakernel import chunk_bytes_model
+
+        surf = self.dispatch_surface()
+        path = path or self._stat_path
+        prec = self._resolve_precision(path, precision)
+        mode = {"xla": "xla", "fused": "fused"}.get(
+            path, "mega_bf16" if prec == "bf16" else "mega")
+        return chunk_bytes_model(
+            self._normalize_chunk(chunk, chunk), surf["npsr"],
+            surf["max_toa"], surf["k_coef"], mode=mode,
+            psr_shards=int(self.mesh.shape[PSR_AXIS]),
+            dtype_bytes=surf["dtype_bytes"])
+
     def chunk_cost(self, chunk: int, *, os=None, lnlike=None,
                    keep_corr: bool = False, precision=None) -> dict:
         """XLA cost analysis of ONE chunk program, without executing it.
@@ -3067,11 +3112,30 @@ class EnsembleSimulator:
                            if self._stat_path == "mega" else None)
         obs.flightrec.note("executables_cleared")
 
-    def run(self, nreal: int, seed=0, chunk: int = 1024, keep_corr: bool = False,
+    def run(self, nreal: int, seed=0, chunk=None, keep_corr: bool = False,
             checkpoint=None, progress=None, os=None, lnlike=None,
-            pipeline_depth: int = 2, precision=None, eventlog=None,
-            lanes=None, recovery=None):
+            pipeline_depth=None, precision=None, eventlog=None,
+            lanes=None, recovery=None, tuned=None):
         """Run the ensemble in device-memory-bounded chunks.
+
+        ``chunk`` and ``pipeline_depth`` default to the hand-set knob
+        values in :mod:`fakepta_tpu.tune.defaults` (1024 / 2); ``None``
+        means "not set by the caller", which is what lets a tuned run
+        distinguish an explicit override from a default to replace.
+
+        ``tuned``: consume the platform-aware autotuner
+        (:mod:`fakepta_tpu.tune`, docs/TUNING.md). ``True`` resolves the
+        persisted :class:`~fakepta_tpu.tune.TunedConfig` for this
+        platform fingerprint x spec family (one store read — zero probes,
+        zero extra compiles); a :class:`~fakepta_tpu.tune.TunedConfig` or
+        a plain knob dict applies directly (the tuner's own probes run
+        through exactly this path). Tuned knobs fill only the knobs the
+        caller left unset (``chunk`` / ``pipeline_depth`` /
+        ``precision``) plus the statistic path where legal (never under
+        ``keep_corr`` or TOA sharding; a mesh-split knob cannot apply to
+        an already-built simulator and is noted, not forced). The applied
+        knobs are recorded in ``RunReport.meta["tuned"]`` so ``obs
+        compare``/``gate`` can attribute wins to the tuner.
 
         ``lanes``: per-request RNG lanes (the :mod:`fakepta_tpu.serve`
         coalescing contract) — a sequence of ``(seed, n)`` pairs laid out in
@@ -3222,6 +3286,64 @@ class EnsembleSimulator:
         retraces_before = self._obs_retraces
         chunk_records = []
         base = rng_utils.as_key(seed)
+
+        # tuned-knob resolution (fakepta_tpu.tune, docs/TUNING.md): fill
+        # the knobs the caller left unset from the store / given config,
+        # then fall back to the hand-set defaults — all before anything
+        # reads them
+        tuned_applied = None
+        tuned_path = None
+        if tuned:
+            knobs = None
+            if isinstance(tuned, dict):
+                knobs = dict(tuned)
+            elif hasattr(tuned, "knobs"):
+                knobs = dict(tuned.knobs)
+            else:
+                from .. import tune as tune_mod
+                cfg_t = tune_mod.resolve_for_sim(self)
+                if cfg_t is not None:
+                    knobs = dict(cfg_t.knobs)
+                else:
+                    # a miss is information, not an error: the run
+                    # proceeds on hand-set defaults, diagnosably
+                    obs.flightrec.note("tune_miss",
+                                       npsr=int(self.batch.npsr))
+            if knobs:
+                tuned_applied = {}
+                if chunk is None and knobs.get("chunk"):
+                    chunk = int(knobs["chunk"])
+                    tuned_applied["chunk"] = chunk
+                if pipeline_depth is None \
+                        and knobs.get("pipeline_depth") is not None:
+                    pipeline_depth = int(knobs["pipeline_depth"])
+                    tuned_applied["pipeline_depth"] = pipeline_depth
+                if precision is None and knobs.get("precision"):
+                    precision = knobs["precision"]
+                    tuned_applied["precision"] = precision
+                p_t = knobs.get("path")
+                if p_t in ("xla", "fused", "mega") and not keep_corr:
+                    if p_t != "xla" and self._n_toa_shards > 1:
+                        # mega/fused assume each shard holds the full TOA
+                        # axis; a tuned path from another mesh regime is
+                        # ignored loudly rather than crashing the run
+                        obs.flightrec.note("tune_path_illegal", path=p_t)
+                    else:
+                        tuned_path = p_t
+                        tuned_applied["path"] = p_t
+                shards_t = knobs.get("psr_shards")
+                if shards_t and int(shards_t) != \
+                        int(self.mesh.shape[PSR_AXIS]):
+                    # the mesh split is a construction-time knob; consume
+                    # it where simulators are built (search/suite), note
+                    # it here
+                    obs.flightrec.note(
+                        "tune_mesh_mismatch", want=int(shards_t),
+                        have=int(self.mesh.shape[PSR_AXIS]))
+        if chunk is None:
+            chunk = tune_defaults.DEFAULT_CHUNK
+        if pipeline_depth is None:
+            pipeline_depth = tune_defaults.DEFAULT_PIPELINE_DEPTH
         chunk = self._normalize_chunk(chunk, nreal)
         packed_out, corr_out = [], []
         nb = self.nbins
@@ -3279,7 +3401,7 @@ class EnsembleSimulator:
                                          "keep_corr; cannot resume with it")
                     corr_out.append(state["corr"])
 
-        path = "xla" if keep_corr else self._stat_path
+        path = "xla" if keep_corr else (tuned_path or self._stat_path)
         prec = self._resolve_precision(path, precision)
         stats_bf16 = prec == "bf16"
         fused = path != "xla"
@@ -3331,6 +3453,11 @@ class EnsembleSimulator:
         }
         if isinstance(seed, (int, np.integer)):
             meta["seed"] = int(seed)
+        if tuned_applied is not None:
+            # which knobs the autotuner actually set (fakepta_tpu.tune):
+            # `obs compare` attributes wins to the tuner through this, and
+            # the bench rows' `tuned` flag sources from it
+            meta["tuned"] = {"knobs": dict(tuned_applied)}
         if lanes is not None:
             # a serve-coalesced dispatch: how many request lanes rode this
             # run (slots beyond their sum are bucket padding)

@@ -56,6 +56,7 @@ from ..ops import mcmc, woodbury
 from ..parallel import pipeline as pipeline_mod
 from ..parallel.mesh import PSR_AXIS, REAL_AXIS, TOA_AXIS, to_host
 from ..parallel.montecarlo import _batch_specs
+from ..tune import defaults as tune_defaults
 from ..utils import rng as rng_utils
 from ..utils.compat import enable_x64, shard_map
 from .model import SAMPLE_SCHEMA, SAMPLE_TAG, SWAP_TAG, as_spec, diagnostics
@@ -787,8 +788,8 @@ class SamplingRun:
             ev.set()
 
     def run(self, n_steps: int, seed=0, segment=None, checkpoint=None,
-            pipeline_depth: int = 2, progress=None, eventlog=None,
-            recovery=None) -> dict:
+            pipeline_depth=None, progress=None, eventlog=None,
+            recovery=None, tuned: bool = False) -> dict:
         """Run ``n_steps`` post-warmup MCMC steps (plus the spec's warmup).
 
         The chain loop dispatches one jitted SEGMENT program at a time —
@@ -820,6 +821,20 @@ class SamplingRun:
         collector = obs.Collector()
         retraces_before = self.retraces
         policy = faults_mod.as_policy(recovery)
+        # tuned pipeline depth (fakepta_tpu.tune, docs/TUNING.md): the
+        # depth is a platform-shaped knob — it tunes how much host drain
+        # work overlaps device compute, not anything about the spec — so
+        # the sampler consumes the newest store entry for this platform
+        # fingerprint; an explicit pipeline_depth always wins
+        tuned_applied = None
+        if tuned and pipeline_depth is None:
+            from .. import tune as tune_mod
+            depth_t = tune_mod.resolve_platform_knob("pipeline_depth")
+            if depth_t is not None:
+                pipeline_depth = int(depth_t)
+                tuned_applied = {"pipeline_depth": pipeline_depth}
+        if pipeline_depth is None:
+            pipeline_depth = tune_defaults.DEFAULT_PIPELINE_DEPTH
         spec, compiled = self.spec, self.compiled
         k, t_count, d = spec.n_chains, spec.n_temps, compiled.D
         segment, warmup_n, post_n = self._normalize(n_steps, segment)
@@ -880,6 +895,8 @@ class SamplingRun:
         }
         if isinstance(seed, (int, np.integer)):
             meta["seed"] = int(seed)
+        if tuned_applied is not None:
+            meta["tuned"] = {"knobs": dict(tuned_applied)}
 
         timeline: list = []
         seg_records: list = []

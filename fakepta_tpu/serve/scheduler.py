@@ -194,7 +194,8 @@ class ServePool:
     """
 
     def __init__(self, mesh=None, config: Optional[ServeConfig] = None,
-                 compile_cache_dir: Optional[str] = None):
+                 compile_cache_dir: Optional[str] = None,
+                 tuned: bool = False):
         import jax
 
         self.config = config or ServeConfig()
@@ -204,6 +205,25 @@ class ServePool:
         self.mesh = mesh
         self.n_devices = int(mesh.devices.size)
         n_real = int(mesh.shape.get("real", 1))
+        if tuned:
+            # platform-tuned bucket ladder (fakepta_tpu.tune, docs/TUNING
+            # .md): replaces the hand-set ladder — and becomes the prewarm
+            # set when none was configured, so a tuned pool warms exactly
+            # the executables it will dispatch. A store miss keeps the
+            # hand-set ladder, diagnosably.
+            from .. import tune as tune_mod
+            ladder = tune_mod.resolve_buckets()
+            if ladder:
+                legal = tuple(b for b in ladder if b % max(n_real, 1) == 0)
+                if legal:
+                    self.config = dataclasses.replace(
+                        self.config, buckets=legal,
+                        prewarm_buckets=(self.config.prewarm_buckets
+                                         or legal))
+                    flightrec.note("serve_tuned_buckets",
+                                   buckets=list(legal))
+            else:
+                flightrec.note("serve_tuned_miss")
         buckets = sorted({int(b) for b in self.config.buckets})
         bad = [b for b in buckets if b % n_real]
         if bad or not buckets:

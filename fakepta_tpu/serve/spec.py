@@ -25,11 +25,16 @@ from typing import Optional, Sequence, Tuple, Union
 import numpy as np
 
 from ..obs import flightrec
+from ..tune import defaults as tune_defaults
 
-#: default microbatch bucket ladder: geometric with ratio 2, so padding a
-#: cohort up to the next bucket wastes < 50% of slots in the worst case and
-#: the warm pool compiles O(log(max/min)) executables per lane config
-DEFAULT_BUCKETS: Tuple[int, ...] = (16, 32, 64, 128, 256, 512, 1024)
+#: default microbatch bucket ladder — single-sourced from
+#: :mod:`fakepta_tpu.tune.defaults` (the one place dispatch-knob literals
+#: may live; the ``hardcoded-dispatch-knob`` analysis rule enforces it).
+#: Geometric with ratio 2: padding a cohort up to the next bucket wastes
+#: < 50% of slots worst-case and the warm pool compiles O(log(max/min))
+#: executables per lane config. A platform-tuned ladder replaces it via
+#: ``ServePool(tuned=True)`` (docs/TUNING.md).
+DEFAULT_BUCKETS: Tuple[int, ...] = tune_defaults.DEFAULT_BUCKETS
 
 
 class ServeError(RuntimeError):
@@ -98,11 +103,14 @@ class ArraySpec:
         single-sourced with the flight recorder's run identity hash."""
         return flightrec.spec_hash(self.spec_dict())
 
-    def build(self, mesh=None, compile_cache_dir=None):
-        """Construct the :class:`EnsembleSimulator` this spec describes."""
+    def parts(self):
+        """``(batch, gwb)`` — the constructor ingredients this spec
+        describes (shared by :meth:`build` and the autotuner's
+        :func:`fakepta_tpu.tune.search`, so the two stage the identical
+        array)."""
         from .. import spectrum as spectrum_lib
         from ..batch import PulsarBatch
-        from ..parallel.montecarlo import EnsembleSimulator, GWBConfig
+        from ..parallel.montecarlo import GWBConfig
 
         batch = PulsarBatch.synthetic(
             npsr=self.npsr, ntoa=self.ntoa, tspan_years=self.tspan_years,
@@ -114,6 +122,13 @@ class ArraySpec:
             psd = np.asarray(spectrum_lib.powerlaw(
                 f, log10_A=self.gwb_log10_A, gamma=self.gwb_gamma))
             gwb = GWBConfig(psd=psd, orf=self.gwb_orf)
+        return batch, gwb
+
+    def build(self, mesh=None, compile_cache_dir=None):
+        """Construct the :class:`EnsembleSimulator` this spec describes."""
+        from ..parallel.montecarlo import EnsembleSimulator
+
+        batch, gwb = self.parts()
         return EnsembleSimulator(batch, gwb=gwb, mesh=mesh,
                                  nbins=self.nbins,
                                  compile_cache_dir=compile_cache_dir)

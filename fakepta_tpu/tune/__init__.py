@@ -1,0 +1,44 @@
+"""fakepta_tpu.tune — platform-aware autotuner for the dispatch surface.
+
+The engine exposes ~6 coupled dispatch knobs (chunk size, pipeline depth,
+statistic path, precision mode, mesh split, serve bucket ladder), all
+hand-set until now, and the bench trajectory proves the optimum is
+platform-specific (ROADMAP item 4: 48,105 real/s/chip on the accelerator
+vs ~230 on the CPU stand-in, with different best knobs). This package
+turns that into infrastructure:
+
+- :func:`fingerprint` — the platform identity every tuned knob is keyed
+  on, and the repo's single source of the ``platform`` column
+  (``obs gate`` / ``benchmarks/suite.py`` read it too);
+- :func:`search` — model-first pruning over the knob space (the analytic
+  HBM/VMEM/pad-waste models) followed by short measured probes through
+  the obs machinery, wall-clock-budgeted, degradation-ladder-protected;
+- :class:`TuneStore` / :class:`TunedConfig` — the persisted result,
+  JSON beside the persistent compile cache, schema-versioned and keyed
+  fingerprint x spec family, consumed by ``EnsembleSimulator.run(
+  tuned=True)``, :class:`~fakepta_tpu.sample.SamplingRun`, the serve
+  prewarm and the benchmarks;
+- ``python -m fakepta_tpu.tune search|show|apply`` — the CLI, emitting
+  obs-diffable ``fakepta_tpu.tune/1`` artifacts.
+
+See docs/TUNING.md for the search strategy, store format and the
+measured A/B protocol.
+"""
+
+from . import defaults  # noqa: F401
+from .fingerprint import Fingerprint, family_hash, fingerprint  # noqa: F401
+from .model import (Candidate, bucket_ladder,  # noqa: F401
+                    candidate_frontier, default_candidate,
+                    overshoot_factor)
+from .search import (family_for_surface, resolve_buckets,  # noqa: F401
+                     resolve_for_sim, resolve_platform_knob, search)
+from .store import (TunedConfig, TuneStore,  # noqa: F401
+                    default_store_path)
+
+__all__ = [
+    "Fingerprint", "fingerprint", "family_hash", "family_for_surface",
+    "Candidate", "candidate_frontier", "default_candidate",
+    "bucket_ladder", "TunedConfig", "TuneStore", "default_store_path",
+    "search", "resolve_for_sim", "resolve_platform_knob",
+    "resolve_buckets", "defaults",
+]

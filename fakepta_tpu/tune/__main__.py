@@ -1,0 +1,7 @@
+"""``python -m fakepta_tpu.tune`` entry point."""
+
+import sys
+
+from .cli import main
+
+sys.exit(main())

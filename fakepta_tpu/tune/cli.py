@@ -1,0 +1,165 @@
+"""CLI: ``python -m fakepta_tpu.tune search|show|apply ...``.
+
+``search`` tunes the dispatch knobs for a synthetic-array spec (the same
+declarative surface the serve layer's :class:`~fakepta_tpu.serve
+.ArraySpec` speaks), persists the :class:`~fakepta_tpu.tune.TunedConfig`
+and optionally writes the obs-diffable ``fakepta_tpu.tune/1`` artifact
+(``--out``; gate it with ``python -m fakepta_tpu.obs gate``). ``show``
+prints the store. ``apply`` resolves the knobs a tuned run would pick for
+the current platform and prints them as one JSON line — the scriptable
+form of ``run(tuned=True)``.
+
+Exit 0 on success, 1 when ``apply``/``show`` find nothing resolved, 2 on
+usage/configuration errors (mirroring the other subsystem CLIs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="python -m fakepta_tpu.tune",
+        description="platform-aware autotuner for the engine dispatch "
+                    "surface (docs/TUNING.md)")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    def add_spec_args(p):
+        p.add_argument("--npsr", type=int, default=20)
+        p.add_argument("--ntoa", type=int, default=156)
+        p.add_argument("--n-red", type=int, default=10)
+        p.add_argument("--n-dm", type=int, default=10)
+        p.add_argument("--gwb-ncomp", type=int, default=10)
+        p.add_argument("--data-seed", type=int, default=0)
+
+    search = sub.add_parser(
+        "search", help="model-first search + measured probes; persists "
+                       "the winning knobs per platform fingerprint")
+    add_spec_args(search)
+    search.add_argument("--nreal-hint", type=int, default=4096,
+                        help="workload scale the knobs will serve (caps "
+                             "the chunk ladder)")
+    search.add_argument("--budget-s", type=float, default=None,
+                        help="probe wall-clock budget (default: "
+                             "tune.defaults.PROBE_BUDGET_S)")
+    search.add_argument("--probe-chunks", type=int, default=None,
+                        help="measured chunks per probe (default: "
+                             "tune.defaults.PROBE_CHUNKS)")
+    search.add_argument("--max-candidates", type=int, default=12,
+                        help="frontier size cap (model-ranked; the "
+                             "hand-set default candidate always rides)")
+    search.add_argument("--force", action="store_true",
+                        help="re-probe even with a warm store entry")
+    search.add_argument("--store", default=None,
+                        help="store file path (default: "
+                             "$FAKEPTA_TPU_TUNE_DIR, else beside the "
+                             "persistent compile cache)")
+    search.add_argument("--platform", default=None,
+                        help="force a jax platform (e.g. cpu)")
+    search.add_argument("--out", default=None,
+                        help="write the fakepta_tpu.tune/1 artifact here")
+
+    show = sub.add_parser("show", help="print the TunedConfig store")
+    show.add_argument("--store", default=None)
+
+    apply_p = sub.add_parser(
+        "apply", help="resolve + print the knobs a tuned run would pick "
+                      "for the current platform (one JSON line)")
+    add_spec_args(apply_p)
+    apply_p.add_argument("--store", default=None)
+    apply_p.add_argument("--platform", default=None)
+    return parser
+
+
+def _cmd_search(args) -> int:
+    from ..serve.spec import ArraySpec
+    from .defaults import PROBE_CHUNKS
+    from .search import search
+
+    spec = ArraySpec(npsr=args.npsr, ntoa=args.ntoa, n_red=args.n_red,
+                     n_dm=args.n_dm, gwb_ncomp=args.gwb_ncomp,
+                     data_seed=args.data_seed)
+    cfg, info = search(
+        spec=spec, nreal_hint=args.nreal_hint, budget_s=args.budget_s,
+        probe_chunks=(PROBE_CHUNKS if args.probe_chunks is None
+                      else args.probe_chunks),
+        max_candidates=args.max_candidates,
+        store=args.store, force=args.force, artifact=args.out)
+    line = {"tuned": 1, "warm": bool(info["warm"]),
+            "tune_probes": int(info["probes"]),
+            "tune_probe_s": round(float(info["probe_s"]), 3),
+            "family": cfg.family, "knobs": cfg.knobs,
+            "metrics": cfg.metrics}
+    if info.get("store_path"):
+        line["store"] = info["store_path"]
+    print(json.dumps(line))
+    return 0
+
+
+def _cmd_show(args) -> int:
+    from .store import TuneStore
+
+    store = TuneStore(args.store)
+    entries = store.load_entries()
+    if store.path is None:
+        print("no store configured (set FAKEPTA_TPU_TUNE_DIR, the "
+              "persistent compile cache, or pass --store)",
+              file=sys.stderr)
+        return 1
+    print(f"store: {store.path} ({len(entries)} entr"
+          f"{'y' if len(entries) == 1 else 'ies'})")
+    for key, raw in sorted(entries.items()):
+        knobs = raw.get("knobs", {})
+        metrics = raw.get("metrics", {})
+        fp = raw.get("fingerprint", {})
+        print(f"  {key}  platform={fp.get('platform')} "
+              f"devices={fp.get('n_devices')} "
+              f"knobs={json.dumps(knobs, sort_keys=True)} "
+              f"rate={metrics.get('real_per_s_per_chip')}")
+    return 0 if entries else 1
+
+
+def _cmd_apply(args) -> int:
+    import jax  # noqa: F401 — fingerprint needs the runtime up
+
+    from ..parallel.mesh import make_mesh
+    from ..serve.spec import ArraySpec
+    from .search import resolve_for_sim
+
+    spec = ArraySpec(npsr=args.npsr, ntoa=args.ntoa, n_red=args.n_red,
+                     n_dm=args.n_dm, gwb_ncomp=args.gwb_ncomp,
+                     data_seed=args.data_seed)
+    sim = spec.build(mesh=make_mesh())
+    cfg = resolve_for_sim(sim, store=args.store)
+    if cfg is None:
+        print("no tuned entry for this platform x spec family; run "
+              "`python -m fakepta_tpu.tune search` first", file=sys.stderr)
+        return 1
+    print(json.dumps({"family": cfg.family, "knobs": cfg.knobs,
+                      "metrics": cfg.metrics, "created": cfg.created}))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if getattr(args, "platform", None):
+        import jax
+        jax.config.update("jax_platforms", args.platform)
+    try:
+        if args.command == "search":
+            return _cmd_search(args)
+        if args.command == "show":
+            return _cmd_show(args)
+        if args.command == "apply":
+            return _cmd_apply(args)
+    except (OSError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 2
+    return 2
+
+
+if __name__ == "__main__":
+    sys.exit(main())

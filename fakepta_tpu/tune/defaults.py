@@ -1,0 +1,84 @@
+"""Hand-set dispatch-knob defaults and tuner constants — the ONE place
+literal dispatch-knob values may live in library code.
+
+Every other library module takes these knobs as arguments (plumbed from a
+caller, a :class:`~fakepta_tpu.tune.store.TunedConfig`, or this module);
+the ``hardcoded-dispatch-knob`` analysis rule enforces it
+(docs/INVARIANTS.md). Keep this file boring: plain ints and tuples, no
+imports beyond the stdlib, so the analyzer, the serve layer and the engine
+can all read it without dragging jax in.
+
+The values themselves are the pre-tuner hand-set defaults the repo has
+benchmarked since PR 5/9 — they are the "hand-tuned" side of every
+``tuned_speedup_x`` A/B (docs/TUNING.md), which is why they must stay
+stable rather than chase any one platform.
+"""
+
+from __future__ import annotations
+
+# --- engine dispatch knobs (EnsembleSimulator.run) -------------------------
+
+#: default realizations per chunk dispatch (run(chunk=...)'s hand-set value)
+DEFAULT_CHUNK = 1024
+
+#: default in-flight chunk depth for the async pipeline (0 = serial loop)
+DEFAULT_PIPELINE_DEPTH = 2
+
+#: default statistic path when the constructor picked none ('xla' |
+#: 'fused' | 'mega'); the per-path precision default stays with the path
+DEFAULT_PATH = "xla"
+
+# --- serve dispatch knobs (fakepta_tpu.serve) ------------------------------
+
+#: default microbatch bucket ladder: geometric with ratio 2, so padding a
+#: cohort up to the next bucket wastes < 50% of slots in the worst case and
+#: the warm pool compiles O(log(max/min)) executables per lane config
+DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
+
+#: the ladder ratio the bucket model assumes (docs/SERVING.md pad-waste /
+#: compile-count tradeoff; mean waste ~ (ratio-1)/(2*ratio) under uniform
+#: cohort sizes)
+BUCKET_RATIO = 2
+
+# --- tuner constants (fakepta_tpu.tune) ------------------------------------
+
+#: store schema tag + version; entries written by a different version are
+#: ignored (never silently reinterpreted) and the tuner re-searches
+STORE_SCHEMA = "fakepta_tpu.tune/1"
+STORE_VERSION = 1
+
+#: environment variable naming the TunedConfig store directory; when unset
+#: the store lands beside the persistent compile cache
+#: (``FAKEPTA_TPU_COMPILE_CACHE``), and with neither configured it falls
+#: back to ``~/.cache/fakepta_tpu/`` so warm starts survive process
+#: boundaries by default
+TUNE_DIR_ENV = "FAKEPTA_TPU_TUNE_DIR"
+
+#: store file name (inside the tune/compile-cache directory)
+STORE_FILENAME = "tuned.json"
+
+#: measured-refinement budget: the search stops issuing probes past this
+#: wall-clock spend and keeps the best candidate probed so far (the
+#: hand-set default candidate is always probed first, so a budget-expired
+#: search still returns a well-defined "no worse than hand-set" choice)
+PROBE_BUDGET_S = 120.0
+
+#: per-probe watchdog deadline (a probe that hangs in a drain is aborted
+#: and scored as failed instead of killing the search)
+PROBE_TIMEOUT_S = 30.0
+
+#: measured chunks per probe (beyond the compile-bearing warm chunk);
+#: single digits by design — probes are throughput estimates, not runs
+PROBE_CHUNKS = 2
+
+#: pipeline depths the model-first frontier offers the prober (same
+#: executable per chunk size, so extra depths cost no recompiles)
+DEPTH_CANDIDATES = (0, 2, 4)
+
+#: fraction of per-device HBM the residency model may plan into (headroom
+#: for the allocator, collectives and the host's own staging)
+HBM_FRACTION = 0.6
+
+#: per-device working-set budget when the backend exposes no memory limit
+#: (the CPU stand-in): coarse, deliberately conservative
+DEFAULT_BYTES_BUDGET = 2 << 30

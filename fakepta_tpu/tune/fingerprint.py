@@ -1,0 +1,100 @@
+"""Platform fingerprint: the identity every tuned knob is keyed on.
+
+The bench history is the motivating evidence (ROADMAP item 4): r02's
+accelerator round ran 48,105 real/s/chip while the CPU stand-in rounds sit
+near ~230 with *different* best knobs — so a tuned configuration is
+meaningless without the platform it was measured on. The fingerprint
+captures what changes the optimum: backend platform and device kind,
+device/host counts, per-device memory, and the jax/jaxlib versions (whose
+compiler changes can move the optimum as surely as hardware can).
+
+This is also the repo's single source of platform identity: ``obs gate``'s
+same-platform row matching and ``benchmarks/suite.py``'s ``platform``
+column both read :func:`fingerprint` instead of probing
+``jax.devices()[0].platform`` ad hoc (the regression that matters — a CPU
+stand-in round must never band an accelerator round — is pinned in
+tests/test_tune.py).
+
+jax is imported lazily inside :func:`fingerprint` so importing
+:mod:`fakepta_tpu.tune` (e.g. from the gate CLI) stays cheap.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import Optional, Sequence
+
+from ..obs import flightrec
+
+
+@dataclasses.dataclass(frozen=True)
+class Fingerprint:
+    """What the tuner knows about the platform it measured on."""
+
+    platform: str          # 'cpu' | 'tpu' | 'gpu' ...
+    device_kind: str       # e.g. 'TPU v5e' / 'cpu'
+    n_devices: int         # global device count (jax.devices())
+    n_processes: int       # host count (jax.process_count())
+    hbm_bytes: int         # per-device memory limit; 0 when not exposed
+    jax_version: str
+    jaxlib_version: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @property
+    def hash(self) -> str:
+        """Stable short identity (the store key ingredient)."""
+        blob = json.dumps(self.as_dict(), sort_keys=True)
+        return hashlib.sha1(blob.encode()).hexdigest()[:12]
+
+
+def fingerprint(devices: Optional[Sequence] = None) -> Fingerprint:
+    """Fingerprint the current jax runtime (global devices by default).
+
+    Deliberately *global* — ``jax.devices()`` / ``jax.process_count()`` —
+    rather than mesh-shaped: a simulator on a sub-mesh still runs on the
+    same platform, and the mesh layout is itself a tuned knob, not an
+    identity field.
+    """
+    import jax
+
+    devices = list(devices if devices is not None else jax.devices())
+    d0 = devices[0]
+    hbm = 0
+    try:
+        stats = d0.memory_stats()
+        hbm = int((stats or {}).get("bytes_limit", 0))
+    except Exception as exc:   # noqa: BLE001 — recorded, not swallowed
+        # backends without allocator stats (XLA:CPU) land here; the
+        # fingerprint records hbm_bytes=0 and the residency model falls
+        # back to its conservative budget (tune.defaults)
+        flightrec.note("fingerprint_no_memory_stats", error=repr(exc)[:120])
+    try:
+        import jaxlib
+        jaxlib_version = getattr(jaxlib, "__version__", "")
+    except ImportError:
+        jaxlib_version = ""
+    return Fingerprint(
+        platform=str(d0.platform),
+        device_kind=str(getattr(d0, "device_kind", d0.platform)),
+        n_devices=len(devices),
+        n_processes=int(jax.process_count()),
+        hbm_bytes=hbm,
+        jax_version=str(jax.__version__),
+        jaxlib_version=str(jaxlib_version),
+    )
+
+
+def family_hash(**fields) -> str:
+    """Stable short hash of a spec *family* — the problem-shaped identity
+    (pulsar/TOA/bin counts, coefficient width, dtype) a TunedConfig applies
+    to, deliberately EXCLUDING the knobs themselves (chunk, depth, path,
+    precision, mesh split are what the tuner chooses, not what it keys on)
+    and the volatile fields (nreal, seed) the flight recorder's
+    :func:`~fakepta_tpu.obs.flightrec.spec_hash` also drops."""
+    blob = json.dumps(dict(sorted(fields.items())), sort_keys=True,
+                      default=str)
+    return hashlib.sha1(blob.encode()).hexdigest()[:12]

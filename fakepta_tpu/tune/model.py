@@ -1,0 +1,281 @@
+"""Model-first candidate generation over the engine dispatch surface.
+
+The search is *model-first, measure-second* (docs/TUNING.md): the analytic
+models the repo already trusts — :func:`~fakepta_tpu.ops.megakernel
+.chunk_bytes_model` (per-mode HBM traffic, the roofline source of truth
+off-TPU), the megakernel's VMEM tile model (:func:`~fakepta_tpu.ops
+.megakernel.pick_rt_mega`, which the kernel consults per shape so the
+tuner never has to), and the serve pad-waste/coalesce tradeoff
+(docs/SERVING.md) — prune the combinatorial knob space down to a small
+frontier, and only that frontier pays measured probes.
+
+What the models decide without a single probe:
+
+- **path**: Pallas paths run in *interpret mode* off-TPU (a Python/XLA
+  while-loop, orders of magnitude slower than the einsum path), so the
+  frontier offers ``fused``/``mega`` only on TPU;
+- **precision**: the bf16-storage mode exists to halve HBM reads the CPU
+  backend does not have, so it is TPU-only too;
+- **psr_shards**: sharding pulsars strictly *adds* traffic (the base and
+  coefficient all_gathers in ``chunk_bytes_model``) — it enters the
+  frontier only when the residency model says a realization-only split
+  cannot fit the chunk in per-device memory;
+- **chunk**: power-of-two ladder, capped where the residency model exceeds
+  the per-device budget (``HBM_FRACTION`` x ``hbm_bytes`` when the backend
+  exposes a limit, the conservative ``DEFAULT_BYTES_BUDGET`` otherwise);
+- **bucket ladder**: chosen purely from the pad-waste/compile-count
+  tradeoff — geometric ratio ``BUCKET_RATIO`` anchored at the mesh's real
+  axis, capped at the largest residency-feasible bucket. No probes: serve
+  probes would need live traffic shapes the tuner does not have.
+
+Candidates are ranked by modeled HBM bytes **per realization** (the engine
+is memory-bound — BASELINE round 5 measured 7.1 FLOP/B against a v5e ridge
+of 240 — so modeled traffic is the principled throughput proxy), and only
+the top of the ranking is probed.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Tuple
+
+from . import defaults
+from .fingerprint import Fingerprint
+
+
+@dataclasses.dataclass(frozen=True)
+class Candidate:
+    """One point of the dispatch-knob space (mesh split included)."""
+
+    chunk: int
+    pipeline_depth: int
+    path: str                      # 'xla' | 'fused' | 'mega'
+    precision: Optional[str]       # None (path default) | 'f32' | 'bf16'
+    psr_shards: int = 1
+
+    def knobs(self) -> dict:
+        """The ``run(tuned=...)`` / TunedConfig knob dict."""
+        return {"chunk": int(self.chunk),
+                "pipeline_depth": int(self.pipeline_depth),
+                "path": self.path,
+                "precision": self.precision,
+                "psr_shards": int(self.psr_shards)}
+
+    def compile_key(self) -> tuple:
+        """Candidates sharing this key share one compiled executable (the
+        pipeline depth is a host-loop knob, not a program shape), so the
+        prober pays one compile per key, not per candidate."""
+        return (self.path, self.precision, self.psr_shards, self.chunk)
+
+
+def traffic_bytes_per_real(cand: Candidate, npsr: int, ntoa: int,
+                           k_coef: int, dtype_bytes: int = 4) -> float:
+    """Modeled HBM bytes per realization for one candidate — the ranking
+    proxy (lower is better on a memory-bound program)."""
+    from ..ops.megakernel import chunk_bytes_model
+
+    mode = {"xla": "xla", "fused": "fused"}.get(
+        cand.path, "mega_bf16" if cand.precision == "bf16" else "mega")
+    total = chunk_bytes_model(cand.chunk, npsr, ntoa, k_coef, mode=mode,
+                              psr_shards=cand.psr_shards,
+                              dtype_bytes=dtype_bytes)
+    return total / max(cand.chunk, 1)
+
+
+def resident_bytes_per_device(chunk: int, npsr: int, ntoa: int, k_coef: int,
+                              n_devices: int, psr_shards: int = 1,
+                              path: str = "xla",
+                              dtype_bytes: int = 4) -> int:
+    """Coarse per-device residency bound for one chunk in flight.
+
+    Not the watermark — the measured probe's ``peak_hbm_bytes`` refines
+    this — just a feasibility filter: the (R, P, T) residual block (plus
+    its gathered copy when pulsars shard, plus the projection coefficient
+    block), split over the realization shards. The mega path never
+    materializes the projected residual (bases recomputed in VMEM), so
+    only base + coefficients count there.
+    """
+    real_shards = max(n_devices // psr_shards, 1)
+    r_local = max(chunk // real_shards, 1)
+    p_local = max(npsr // psr_shards, 1)
+    base = r_local * p_local * ntoa * dtype_bytes
+    coef = r_local * p_local * k_coef * dtype_bytes
+    gathered = (r_local * npsr * (ntoa + k_coef) * dtype_bytes
+                if psr_shards > 1 else 0)
+    if path == "mega":
+        return base + coef + gathered
+    # xla/fused: residual base + projected residual + coefficients
+    return 2 * base + coef + gathered
+
+
+def bytes_budget_per_device(fp: Fingerprint) -> int:
+    """The residency budget the frontier plans into."""
+    if fp.hbm_bytes > 0:
+        return int(fp.hbm_bytes * defaults.HBM_FRACTION)
+    return int(defaults.DEFAULT_BYTES_BUDGET)
+
+
+def _pow2_ladder(lo: int, hi: int) -> List[int]:
+    out, c = [], 1
+    while c < lo:
+        c *= 2
+    while c <= hi:
+        out.append(c)
+        c *= 2
+    return out
+
+
+def _chunk_candidates(nreal_hint: int, real_shards: int,
+                      lo: int, hi: int) -> List[int]:
+    """Chunk ladder: powers of two PLUS the divisor chain of the workload
+    size. Chunks are jitted at a static size, so a chunk that does not
+    divide ``nreal_hint`` computes a truncated tail's worth of wasted
+    realizations (2000 reals at chunk 1024 executes 2048) — the divisor
+    chain offers zero-overshoot candidates at the scale the knobs will
+    actually serve."""
+    cands = set(_pow2_ladder(lo, hi))
+    c = int(nreal_hint)
+    while c >= lo:
+        if c <= hi and c % real_shards == 0:
+            cands.add(c)
+        if c % 2:
+            break
+        c //= 2
+    return sorted(cands)
+
+
+def overshoot_factor(chunk: int, nreal_hint: int) -> float:
+    """Computed/delivered realizations at the workload scale (>= 1): the
+    final jitted chunk overshoots and is truncated, so a non-dividing
+    chunk pays for realizations the caller never sees."""
+    n = max(int(nreal_hint), 1)
+    return (-(-n // max(chunk, 1)) * chunk) / n
+
+
+def candidate_frontier(fp: Fingerprint, npsr: int, ntoa: int, k_coef: int,
+                       *, nreal_hint: int, n_devices: Optional[int] = None,
+                       dtype_bytes: int = 4,
+                       max_candidates: int = 12) -> List[Candidate]:
+    """The pruned, ranked candidate list the prober measures.
+
+    ``nreal_hint`` is the workload scale the knobs will serve (the chunk
+    ladder never exceeds it — a chunk larger than the run is just the
+    run). The hand-set default candidate is always first, so a
+    budget-expired search still has the baseline measured and "tuned >=
+    hand-set" stays well-defined.
+    """
+    n_devices = int(n_devices if n_devices is not None else fp.n_devices)
+    budget = bytes_budget_per_device(fp)
+    on_tpu = fp.platform == "tpu"
+    paths = ("mega", "fused", "xla") if on_tpu else ("xla",)
+
+    def precisions(path: str) -> Tuple[Optional[str], ...]:
+        # bf16 storage halves HBM reads — the resource only the real
+        # accelerator meters; off-TPU it only adds rounding
+        return (None, "bf16") if on_tpu else (None,)
+
+    def shard_options(chunk_lo: int) -> List[int]:
+        opts = [1]
+        if resident_bytes_per_device(chunk_lo, npsr, ntoa, k_coef,
+                                     n_devices, 1, "xla",
+                                     dtype_bytes) > budget:
+            # realization-only split cannot fit even the smallest chunk:
+            # pulsar sharding (which *costs* gather traffic) earns its slot
+            opts += [s for s in (2, 4, 8)
+                     if npsr % s == 0 and n_devices % s == 0
+                     and s <= n_devices]
+        return opts
+
+    chunk_cap = max(int(nreal_hint), n_devices)
+    chunk_lo = n_devices
+    depth_opts = [d for d in defaults.DEPTH_CANDIDATES
+                  if d == 0 or nreal_hint // max(chunk_lo, 1) >= d]
+
+    seen = set()
+    cands: List[Candidate] = []
+    for psr_shards in shard_options(chunk_lo):
+        real_shards = max(n_devices // psr_shards, 1)
+        for path in paths:
+            for prec in precisions(path):
+                for chunk in _chunk_candidates(
+                        nreal_hint, real_shards,
+                        max(chunk_lo, real_shards), chunk_cap):
+                    if chunk % real_shards:
+                        continue
+                    if resident_bytes_per_device(
+                            chunk, npsr, ntoa, k_coef, n_devices,
+                            psr_shards, path, dtype_bytes) > budget:
+                        break        # the ladder only grows from here
+                    for depth in depth_opts:
+                        c = Candidate(chunk, depth, path, prec, psr_shards)
+                        if c not in seen:
+                            seen.add(c)
+                            cands.append(c)
+
+    default = default_candidate(nreal_hint, n_devices)
+    cands = [c for c in cands if c != default]
+    # ranking: modeled HBM bytes per DELIVERED realization — the traffic
+    # model times the tail-overshoot factor at the workload scale, so a
+    # chunk that divides the workload outranks an equal-traffic one that
+    # computes a truncated tail for nothing
+    cands.sort(key=lambda c: (
+        traffic_bytes_per_real(c, npsr, ntoa, k_coef, dtype_bytes)
+        * overshoot_factor(c.chunk, nreal_hint), -c.chunk,
+        c.pipeline_depth))
+    # diversity before depth: the byte model ranks whole path families
+    # above one another (mega dominates by construction), but the model
+    # is a proxy — guarantee every (path, precision) family its best
+    # representative before spending remaining probe slots down the
+    # global ranking, so a model error can cost rank, never coverage
+    picked: List[Candidate] = []
+    seen_groups = set()
+    for c in cands:
+        g = (c.path, c.precision)
+        if g not in seen_groups:
+            seen_groups.add(g)
+            picked.append(c)
+    for c in cands:
+        if len(picked) >= max_candidates - 1:
+            break
+        if c not in picked:
+            picked.append(c)
+    return [default] + picked[:max(max_candidates - 1, 0)]
+
+
+def default_candidate(nreal_hint: int, n_devices: int) -> Candidate:
+    """The hand-set baseline: run()'s documented defaults, normalized the
+    way the engine would normalize them for this workload."""
+    chunk = min(defaults.DEFAULT_CHUNK, max(int(nreal_hint), 1))
+    chunk -= chunk % max(n_devices, 1)
+    return Candidate(chunk=max(chunk, n_devices),
+                     pipeline_depth=defaults.DEFAULT_PIPELINE_DEPTH,
+                     path=defaults.DEFAULT_PATH, precision=None,
+                     psr_shards=1)
+
+
+def bucket_ladder(fp: Fingerprint, npsr: int, ntoa: int, k_coef: int,
+                  *, n_real_shards: Optional[int] = None,
+                  dtype_bytes: int = 4) -> Tuple[int, ...]:
+    """Model-chosen serve bucket ladder (no probes; docs/SERVING.md).
+
+    Geometric with ratio ``BUCKET_RATIO`` — expected pad waste
+    ``(g-1)/(2g)`` (~25% at g=2) against ``O(log(max/min))`` warm
+    executables — anchored at the smallest legal bucket (every bucket must
+    be a multiple of the mesh's real axis) and capped at the largest
+    residency-feasible dispatch.
+    """
+    n_real = int(n_real_shards if n_real_shards is not None
+                 else fp.n_devices)
+    budget = bytes_budget_per_device(fp)
+    lo = 1
+    while lo < n_real or lo < defaults.DEFAULT_BUCKETS[0]:
+        lo *= defaults.BUCKET_RATIO
+    ladder = []
+    b = lo
+    while len(ladder) < len(defaults.DEFAULT_BUCKETS):
+        if resident_bytes_per_device(b, npsr, ntoa, k_coef, n_real,
+                                     1, "xla", dtype_bytes) > budget:
+            break
+        ladder.append(b)
+        b *= defaults.BUCKET_RATIO
+    return tuple(ladder) if ladder else (lo,)

@@ -1,0 +1,76 @@
+"""Measured refinement: short probes through the existing obs machinery.
+
+A probe is two ordinary :meth:`EnsembleSimulator.run` calls — one
+compile-bearing warm chunk, then ``PROBE_CHUNKS`` measured chunks — driven
+through the SAME ``run(tuned=...)`` knob override the production warm
+start uses, so what the tuner measures is exactly what a tuned run
+executes. Everything read back comes from the RunReport the engine already
+attaches: the steady-state throughput split, the ``peak_hbm_bytes``
+watermark (candidates that blow the residency budget are rejected on
+*evidence*, not just the model), and the retrace guard (a candidate that
+recompiles in steady state is broken by definition).
+
+Probes degrade instead of killing the search: each runs under a
+:class:`~fakepta_tpu.faults.RecoveryPolicy` with a watchdog deadline, and
+any exception — OOM, Pallas failure past the degradation ladder, watchdog
+abort — scores the candidate as failed with a flight-recorder note
+(``tune_probe_failed``) and moves on.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .. import obs
+from ..obs import flightrec
+from . import defaults
+from .model import Candidate
+
+
+def run_probe(sim, cand: Candidate, *, seed: int = 2024,
+              probe_chunks: int = defaults.PROBE_CHUNKS,
+              timeout_s: float = defaults.PROBE_TIMEOUT_S,
+              nreal_cap: Optional[int] = None) -> Optional[dict]:
+    """Measure one candidate on a prepared simulator; None on failure.
+
+    ``sim`` must already live on the candidate's mesh split (the search
+    builds one simulator per ``psr_shards``); path/precision/chunk/depth
+    ride the ``tuned=`` knob override. ``nreal_cap`` (the search passes
+    ``nreal_hint``) bounds the measured run at the workload scale: a
+    chunk equal to the workload runs as ONE chunk there, and measuring
+    it as a multi-chunk pipeline would be measuring a shape the workload
+    never executes.
+    """
+    from .. import faults
+
+    knobs = cand.knobs()
+    policy = faults.RecoveryPolicy(watchdog_s=timeout_s, backoff_s=0.0,
+                                   max_retries=1)
+    nreal = max(probe_chunks, 1) * cand.chunk
+    if nreal_cap is not None:
+        nreal = max(min(nreal, int(nreal_cap)), cand.chunk)
+    t0 = obs.now()
+    try:
+        # warm chunk: bears the trace+compile for this executable shape
+        sim.run(cand.chunk, seed=seed, chunk=cand.chunk, tuned=knobs,
+                recovery=policy)
+        out = sim.run(nreal, seed=seed + 1,
+                      chunk=cand.chunk, tuned=knobs, recovery=policy)
+    except Exception as exc:   # noqa: BLE001 — a failed candidate is a
+        # scored outcome, not a search abort (OOM/hang/ladder-exhausted)
+        flightrec.note("tune_probe_failed", knobs=str(knobs),
+                       error=repr(exc)[:200])
+        return None
+    rep = out["report"]
+    rep_sum = rep.summary()
+    rec = {
+        "knobs": knobs,
+        "real_per_s_per_chip": float(rep.steady_real_per_s_per_chip()),
+        "probe_s": float(obs.now() - t0),
+        "retraces": int(rep.retraces),
+        "peak_hbm_bytes": int(rep_sum.get("peak_hbm_bytes", 0)),
+    }
+    flightrec.note("tune_probe", knobs=str(knobs),
+                   rate=round(rec["real_per_s_per_chip"], 2),
+                   probe_s=round(rec["probe_s"], 3))
+    return rec

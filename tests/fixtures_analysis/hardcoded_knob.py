@@ -1,0 +1,14 @@
+"""Seeded true positives + near-misses for hardcoded-dispatch-knob."""
+CONFIG_DEPTH = 2
+
+
+def dispatch(sim, engine, rt, ladder):
+    engine.chunk_stats(rt=8)                       # VIOLATION: literal tile
+    sim.run(64, pipeline_depth=4)                  # VIOLATION: literal depth
+    pool = engine.ServeConfig(buckets=(16, 64))    # VIOLATION: literal ladder
+    engine.prewarm(prewarm_buckets=[32, 128])      # VIOLATION: literal ladder
+    sim.run(64, pipeline_depth=0)                  # clean: serial off switch
+    engine.chunk_stats(rt=rt)                      # clean: plumbed value
+    sim.run(64, pipeline_depth=CONFIG_DEPTH)       # clean: named source
+    engine.ServeConfig(buckets=ladder)             # clean: plumbed ladder
+    return pool

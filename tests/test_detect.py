@@ -163,6 +163,9 @@ def test_os_null_calibration_deterministic(batch):
     assert sa["sigma"] == sa["sigma_empirical"] > 0.0
 
 
+@pytest.mark.slow   # ~25 s: fused-OS engine parity also rides the
+# slow mega OS sweep; tier-1 budget reclaim for tests/test_tune.py
+# (ISSUE 11)
 def test_os_fused_pallas_matches_xla(batch):
     """The fused Pallas statistic path (interpret mode on CPU) carries the
     OS lanes as extra kernel weight slots — values must match the XLA path
@@ -189,6 +192,8 @@ def test_os_fused_pallas_matches_xla(batch):
     np.testing.assert_allclose(got["autos"], ref["autos"], rtol=1e-5)
 
 
+@pytest.mark.slow   # ~15 s: resume-with-lanes is also pinned by the
+# lnlike checkpoint lane; tier-1 budget reclaim (ISSUE 11)
 def test_os_checkpoint_resume_keeps_lanes(batch, tmp_path):
     """A checkpointed os run resumes with its OS lanes intact and equals the
     uninterrupted run; a mismatched os config refuses to resume."""

@@ -211,6 +211,9 @@ def test_lnlike_lane_mesh_invariance(batch64):
                 err_msg=f"{key}/{shard_kw}")
 
 
+@pytest.mark.slow   # ~15 s: the ECORR x toa-sharding invariance
+# sweep; the fused/xla lnlike parity lanes stay in tier-1 (ISSUE 11
+# budget reclaim)
 def test_lnlike_lane_mesh_invariance_with_ecorr():
     """ECORR epoch blocks under time sharding: the per-epoch segment sums
     psum over 'toa' before the nonlinear correction, so epochs straddling a

@@ -280,6 +280,9 @@ def test_mega_bf16_mesh_invariance(batch):
     np.testing.assert_allclose(b["autos"], a["autos"], rtol=5e-3)
 
 
+@pytest.mark.slow   # ~20 s: per-run precision drive of the xla/fused
+# paths (validation errors stay fast elsewhere); tier-1 budget
+# reclaim (ISSUE 11)
 def test_precision_validation_and_other_paths(batch, mega_sim):
     """precision= is validated; it also drives the XLA and fused paths
     per run; inert constructor combinations are rejected."""

@@ -69,6 +69,9 @@ CASES = [
     ("swallowed_exception.py", LIB,
      {("swallowed-exception", 9), ("swallowed-exception", 16),
       ("swallowed-exception", 23), ("swallowed-exception", 30)}),
+    ("hardcoded_knob.py", LIB,
+     {("hardcoded-dispatch-knob", 6), ("hardcoded-dispatch-knob", 7),
+      ("hardcoded-dispatch-knob", 8), ("hardcoded-dispatch-knob", 9)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -120,6 +123,9 @@ def test_dtype_policy_paths_exist():
     for rel in policy.SWALLOWED_EXCEPT_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale SWALLOWED_EXCEPT_MODULES entry: {rel}"
+    for rel in policy.DISPATCH_KNOB_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale DISPATCH_KNOB_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():

@@ -39,6 +39,9 @@ def _run(batch, mesh, **kw):
     return EnsembleSimulator(batch, mesh=mesh, **kw).run(16, seed=3, chunk=8)
 
 
+@pytest.mark.slow   # ~18 s: the {2,4}-shard full-program sweep;
+# test_toa_and_psr_sharding_compose keeps the surface in tier-1
+# (ISSUE 11 budget reclaim)
 def test_toa_sharded_streams_match_unsharded(batch):
     """The full program (white + red + DM + GWB + sampling) on toa shards
     {2, 4} must reproduce the single-device run: per-TOA draws slice the same

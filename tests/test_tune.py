@@ -1,0 +1,329 @@
+"""fakepta_tpu.tune: fingerprint, model frontier, search, store lifecycle,
+engine/serve consumption, gate single-sourcing, CLI (docs/TUNING.md).
+
+Budget discipline (ROADMAP): everything here runs on a deliberately tiny
+array (6 psr x 48 TOAs, 3+3+3 basis bins) with single-digit probe chunks;
+the one real search is session-scoped and every other test consumes its
+warm store.
+"""
+
+import dataclasses
+import json
+import os
+import warnings
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax
+
+from fakepta_tpu import spectrum as spectrum_lib
+from fakepta_tpu import tune
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.obs import flightrec
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+from fakepta_tpu.tune import defaults as tune_defaults
+from fakepta_tpu.tune.model import (Candidate, candidate_frontier,
+                                    default_candidate)
+from fakepta_tpu.tune.store import TunedConfig, TuneStore
+
+NPSR, NTOA, NCOMP = 6, 48, 3
+
+
+def _batch():
+    return PulsarBatch.synthetic(npsr=NPSR, ntoa=NTOA, tspan_years=8.0,
+                                 toaerr=1e-7, n_red=NCOMP, n_dm=NCOMP,
+                                 seed=0)
+
+
+def _gwb(batch):
+    f = np.arange(1, NCOMP + 1) / float(batch.tspan_common)
+    psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=-14.6, gamma=13 / 3))
+    return GWBConfig(psd=psd, orf="hd")
+
+
+@pytest.fixture(scope="session")
+def searched(tmp_path_factory):
+    """ONE real search over the tiny deterministic space; its store warms
+    every other test (probes are the expensive part)."""
+    store = tmp_path_factory.mktemp("tune") / "tuned.json"
+    batch = _batch()
+    cfg, info = tune.search(batch, gwb=_gwb(batch), nreal_hint=64,
+                            budget_s=60.0, max_candidates=4,
+                            probe_chunks=2, store=store)
+    return {"store": store, "cfg": cfg, "info": info}
+
+
+# -- fingerprint / family ---------------------------------------------------
+
+def test_fingerprint_fields_and_stability():
+    fp = tune.fingerprint()
+    assert fp.platform == "cpu"              # the test harness pins it
+    assert fp.n_devices == len(jax.devices())
+    assert fp.n_processes == 1
+    assert fp.jax_version == jax.__version__
+    assert fp.hash == tune.fingerprint().hash
+    # family: knob-free, order-independent, knob changes don't move it
+    a = tune.family_hash(npsr=6, max_toa=48, nbins=15, k_coef=18,
+                         dtype="float32")
+    b = tune.family_hash(dtype="float32", k_coef=18, nbins=15, max_toa=48,
+                         npsr=6)
+    assert a == b
+    assert a != tune.family_hash(npsr=7, max_toa=48, nbins=15, k_coef=18,
+                                 dtype="float32")
+
+
+def test_dispatch_surface_is_knob_free():
+    batch = _batch()
+    s1 = EnsembleSimulator(batch, gwb=_gwb(batch),
+                           mesh=make_mesh(jax.devices()))
+    s2 = EnsembleSimulator(batch, gwb=_gwb(batch),
+                           mesh=make_mesh(jax.devices(), psr_shards=2))
+    assert s1.dispatch_surface() == s2.dispatch_surface()
+    assert tune.family_for_surface(s1.dispatch_surface()) == \
+        tune.family_for_surface(s2.dispatch_surface())
+    # k_coef = 2 * (red + dm + gwb) bins on this spec
+    assert s1.dispatch_surface()["k_coef"] == 2 * 3 * NCOMP
+
+
+# -- model-first frontier ---------------------------------------------------
+
+def test_frontier_prunes_pallas_and_bf16_off_tpu():
+    fp = tune.fingerprint()
+    cands = candidate_frontier(fp, NPSR, NTOA, 18, nreal_hint=64,
+                               n_devices=8, max_candidates=8)
+    assert cands[0] == default_candidate(64, 8)   # hand-set probed first
+    assert {c.path for c in cands} == {"xla"}     # interpret mode pruned
+    assert {c.precision for c in cands} == {None}
+    assert all(c.psr_shards == 1 for c in cands)  # gathers never modeled in
+    assert all(c.chunk <= 64 for c in cands)      # nreal_hint caps the ladder
+
+
+def test_frontier_offers_pallas_paths_and_bf16_on_tpu():
+    fp = dataclasses.replace(tune.fingerprint(), platform="tpu",
+                             device_kind="TPU v5e",
+                             hbm_bytes=16 << 30)
+    cands = candidate_frontier(fp, 100, 780, 320, nreal_hint=100_000,
+                               n_devices=8, max_candidates=16)
+    assert {"mega", "fused", "xla"} <= {c.path for c in cands}
+    assert "bf16" in {c.precision for c in cands}
+    # the memory-bound ranking puts the HBM-lean megakernel modes on top
+    assert cands[1].path == "mega"
+
+
+def test_frontier_respects_memory_budget():
+    tight = dataclasses.replace(tune.fingerprint(), hbm_bytes=64 << 20)
+    roomy = dataclasses.replace(tune.fingerprint(), hbm_bytes=64 << 30)
+    big = max(c.chunk for c in candidate_frontier(
+        roomy, 100, 780, 320, nreal_hint=1 << 20, n_devices=8,
+        max_candidates=32))
+    small = max(c.chunk for c in candidate_frontier(
+        tight, 100, 780, 320, nreal_hint=1 << 20, n_devices=8,
+        max_candidates=32))
+    assert small < big
+
+
+def test_bucket_ladder_is_mesh_legal_and_bounded():
+    fp = tune.fingerprint()
+    ladder = tune.bucket_ladder(fp, NPSR, NTOA, 18, n_real_shards=8)
+    assert ladder and all(b % 8 == 0 for b in ladder)
+    assert list(ladder) == sorted(ladder)
+    ratios = {ladder[i + 1] // ladder[i] for i in range(len(ladder) - 1)}
+    assert ratios <= {tune.defaults.BUCKET_RATIO}
+
+
+# -- search + store ---------------------------------------------------------
+
+def test_search_tuned_never_loses_to_hand_set_and_persists(searched):
+    cfg, info = searched["cfg"], searched["info"]
+    assert not info["warm"] and info["probes"] >= 2
+    # the acceptance inequality is structural: the hand-set default is
+    # always probed, and argmax can select but never lose to it
+    assert cfg.metrics["real_per_s_per_chip"] >= \
+        cfg.metrics["hand_set_real_per_s_per_chip"]
+    assert cfg.metrics.get("speedup_x", 1.0) >= 1.0
+    data = json.loads(Path(searched["store"]).read_text())
+    assert data["schema"] == tune_defaults.STORE_SCHEMA
+    assert data["version"] == tune_defaults.STORE_VERSION
+    assert cfg.key() in data["entries"]
+    assert cfg.knobs["buckets"]            # the serve ladder rides along
+
+
+def test_warm_store_zero_probes(searched):
+    batch = _batch()
+    cfg2, info2 = tune.search(batch, gwb=_gwb(batch), nreal_hint=64,
+                              budget_s=60.0, max_candidates=4,
+                              store=searched["store"])
+    assert info2["warm"] and info2["probes"] == 0
+    assert info2["probe_s"] < 5.0          # one store read, zero compiles
+    assert cfg2.knobs == searched["cfg"].knobs
+
+
+def test_run_tuned_true_applies_store_and_stays_warm(searched):
+    os.environ[tune_defaults.TUNE_DIR_ENV] = \
+        str(Path(searched["store"]).parent)
+    try:
+        batch = _batch()
+        sim = EnsembleSimulator(batch, gwb=_gwb(batch),
+                                mesh=make_mesh(jax.devices()))
+        out1 = sim.run(64, seed=3, tuned=True)
+        applied = out1["report"].meta["tuned"]["knobs"]
+        assert applied["chunk"] == searched["cfg"].knobs["chunk"]
+        assert out1["report"].summary()["tuned"] == 1
+        # second tuned run: the store resolve is one file read and the
+        # executable is already traced — zero probes, zero recompiles
+        out2 = sim.run(64, seed=3, tuned=True)
+        assert out2["report"].retraces == 0
+        assert out2["report"].compile_s == 0.0
+        assert np.array_equal(out1["curves"], out2["curves"])
+        # explicit caller knobs always beat tuned ones
+        out3 = sim.run(64, seed=3, chunk=16, tuned=True)
+        assert "chunk" not in out3["report"].meta["tuned"]["knobs"]
+        assert out3["report"].meta["chunk"] == 16
+    finally:
+        del os.environ[tune_defaults.TUNE_DIR_ENV]
+
+
+def test_store_fingerprint_mismatch_ignored_with_note(searched, tmp_path):
+    store = TuneStore(searched["store"])
+    fp = tune.fingerprint()
+    cfg = searched["cfg"]
+    foreign = dataclasses.replace(fp, platform="tpu",
+                                  device_kind="TPU v5e")
+    alien_store = TuneStore(tmp_path / "tuned.json")
+    alien_store.put(TunedConfig(fingerprint=foreign.as_dict(),
+                                family=cfg.family, knobs=dict(cfg.knobs)))
+    flightrec.clear()
+    assert alien_store.lookup(fp, cfg.family) is None
+    names = [e["name"] for e in flightrec.snapshot()]
+    assert "tune_fingerprint_mismatch" in names
+    # the real store still resolves (sanity: the note is a miss, not rot)
+    assert store.lookup(fp, cfg.family) is not None
+
+
+def test_store_schema_version_bump_ignored(searched, tmp_path):
+    fp, cfg = tune.fingerprint(), searched["cfg"]
+    # entry-level bump: parses, then refuses to apply
+    bumped = TuneStore(tmp_path / "tuned.json")
+    entry = TunedConfig(fingerprint=fp.as_dict(), family=cfg.family,
+                        knobs=dict(cfg.knobs))
+    bumped.put(entry)
+    raw = json.loads(bumped.path.read_text())
+    raw["entries"][entry.key()]["schema_version"] = \
+        tune_defaults.STORE_VERSION + 1
+    bumped.path.write_text(json.dumps(raw))
+    flightrec.clear()
+    assert bumped.lookup(fp, cfg.family) is None
+    assert "tune_entry_schema_mismatch" in \
+        [e["name"] for e in flightrec.snapshot()]
+    # file-level bump: the whole store is ignored, loudly
+    raw["version"] = tune_defaults.STORE_VERSION + 1
+    bumped.path.write_text(json.dumps(raw))
+    with pytest.warns(RuntimeWarning, match="schema"):
+        assert bumped.load_entries() == {}
+
+
+def test_store_corrupt_file_warns_then_retunes(searched, tmp_path):
+    fp, cfg = tune.fingerprint(), searched["cfg"]
+    store = TuneStore(tmp_path / "tuned.json")
+    store.path.write_text('{"schema": "fakepta_tpu.tune/1", "ent')  # torn
+    with pytest.warns(RuntimeWarning, match="corrupt"):
+        assert store.lookup(fp, cfg.family) is None
+    # "retune": the next put rewrites the file atomically and lookups work
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        store.put(TunedConfig(fingerprint=fp.as_dict(), family=cfg.family,
+                              knobs=dict(cfg.knobs)))
+    got = store.lookup(fp, cfg.family)
+    assert got is not None and got.knobs == cfg.knobs
+    assert not store.path.with_name(store.path.name + ".tmp").exists()
+
+
+# -- platform-identity single-sourcing (obs gate / suite) -------------------
+
+def test_gate_platformless_row_fills_from_fingerprint_and_never_bands_tpu(
+        tmp_path):
+    from fakepta_tpu.obs import gate as gate_mod
+
+    # accelerator history (r02-style): would flag ANY cpu number if the
+    # platform grouping ever broke
+    history = [{"platform": "tpu", "value": 48105.0,
+                "steady_real_per_s_per_chip": 48105.0}] * 3
+    row_path = tmp_path / "row.json"
+    row_path.write_text(json.dumps(
+        {"value": 230.0, "steady_real_per_s_per_chip": 230.0}))
+    row = gate_mod.load_row(row_path)
+    assert row["platform"] == tune.fingerprint().platform == "cpu"
+    results = gate_mod.gate_row(row, history)
+    assert all(r.verdict == "info" for r in results), (
+        "a CPU stand-in row gated against accelerator history")
+    # same-platform history DOES band it (the gate still gates)
+    same = [{"platform": "cpu", "value": 230.0,
+             "steady_real_per_s_per_chip": 230.0}] * 3
+    verdicts = {r.metric: r.verdict for r in gate_mod.gate_row(row, same)}
+    assert verdicts["value"] == "ok"
+
+
+# -- serve / sampler consumption --------------------------------------------
+
+def test_serve_pool_tuned_buckets_and_platform_knobs(searched):
+    os.environ[tune_defaults.TUNE_DIR_ENV] = \
+        str(Path(searched["store"]).parent)
+    try:
+        depth = tune.resolve_platform_knob("pipeline_depth")
+        assert depth == searched["cfg"].knobs["pipeline_depth"]
+        ladder = tune.resolve_buckets()
+        assert ladder == tuple(searched["cfg"].knobs["buckets"])
+
+        from fakepta_tpu.serve import ServePool
+        pool = ServePool(mesh=make_mesh(jax.devices()), tuned=True)
+        try:
+            n_real = 8
+            expect = tuple(b for b in ladder if b % n_real == 0)
+            assert pool.config.buckets == expect
+            assert pool.config.prewarm_buckets == expect
+        finally:
+            pool.close()
+    finally:
+        del os.environ[tune_defaults.TUNE_DIR_ENV]
+
+
+# -- CLI --------------------------------------------------------------------
+
+def test_cli_search_show_apply_roundtrip(tmp_path, capsys):
+    from fakepta_tpu.obs.report import RunReport
+    from fakepta_tpu.tune.cli import main
+
+    store = tmp_path / "store" / "tuned.json"
+    artifact = tmp_path / "tune_art.jsonl"
+    spec_args = ["--npsr", "6", "--ntoa", "48", "--n-red", "3",
+                 "--n-dm", "3", "--gwb-ncomp", "3"]
+    rc = main(["search", *spec_args, "--nreal-hint", "64",
+               "--max-candidates", "3", "--store", str(store),
+               "--out", str(artifact)])
+    assert rc == 0
+    line = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert line["tuned"] == 1 and line["tune_probes"] >= 1
+    assert line["knobs"]["chunk"] >= 1
+
+    # the artifact is obs-diffable: RunReport loads it and the summary
+    # carries the gate-facing tune metrics with their directions
+    rep = RunReport.load(artifact)
+    assert rep.meta["tune_schema"] == tune_defaults.STORE_SCHEMA
+    assert rep.summary()["tuned"] == 1
+    assert rep.summary()["tune_probe_s"] > 0
+
+    assert main(["show", "--store", str(store)]) == 0
+    assert f"{line['family']}" in capsys.readouterr().out
+
+    assert main(["apply", *spec_args, "--store", str(store)]) == 0
+    applied = json.loads(capsys.readouterr().out.strip())
+    assert applied["knobs"] == line["knobs"]
+
+    # a warm second search through the CLI: zero probes
+    assert main(["search", *spec_args, "--nreal-hint", "64",
+                 "--max-candidates", "3", "--store", str(store)]) == 0
+    warm = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert warm["warm"] is True and warm["tune_probes"] == 0
