@@ -91,6 +91,26 @@ RunReport each ``sim.run()`` attaches):
   steady-compile counters must stay 0 — a warm-pool request never pays a
   recompile after warmup. The accelerator lane serves the flagship-sized
   spec; the CPU stand-in a reduced one (``platform`` disambiguates);
+- ``fleet_qps`` / ``fleet_qps_per_chip`` / ``fleet_p50_ms`` /
+  ``fleet_p99_ms`` / ``fleet_speedup_x`` / ``fleet_warm_hit_rate`` /
+  ``fleet_failovers`` / ``fleet_lost_requests`` /
+  ``fleet_steady_compiles``: the multi-replica serve-fleet lane
+  (``fakepta_tpu.serve.fleet``, docs/SERVING.md "Fleet";
+  ``benchmarks/suite.py`` config 13): N subprocess ``ServePool`` replicas
+  behind the spec-hash consistent-hash router, measured by
+  ``run_loadgen(fleet=N)`` against ONE pool serving the same traffic.
+  ``fleet_speedup_x`` (higher-better) is the scale-out multiple — on the
+  single-core CPU stand-in it measures aggregate warm-capacity scaling
+  (the traffic's spec working set exceeds one pool's LRU ``max_specs``);
+  on multi-chip hosts replica dispatchers additionally run in parallel.
+  ``fleet_warm_hit_rate`` (higher-better) is the fraction of requests
+  served by their spec's ring owner; ``fleet_failovers`` counts mid-flight
+  re-dispatches after the lane's scripted replica kill, and
+  ``fleet_lost_requests`` MUST stay 0 — every accepted request completes,
+  failed-over responses bit-verified against solo runs (the per-request
+  RNG-lane contract). ``fleet_steady_compiles`` must stay 0: all replicas
+  share the persistent compile cache, so cold starts and failover shard
+  absorption are cache loads, not compiles;
 - ``faults_retries`` / ``faults_degradations`` / ``faults_rollbacks``: the
   measured run's recovery counters (``fakepta_tpu.faults``,
   docs/RELIABILITY.md) — transient dispatch/drain retries, degradation-
@@ -390,6 +410,37 @@ def main():
         row["fused_bytes_reduction_x"] = round(
             row["model_bytes_per_chunk"]
             / row["model_bytes_per_chunk_fused"], 2)
+
+    # the fleet lane (fakepta_tpu.serve.fleet, docs/SERVING.md "Fleet"):
+    # 3 subprocess replicas behind the spec-hash router vs ONE pool on
+    # the same multi-spec traffic, one replica SIGKILLed at half load —
+    # the scale-out multiple, failover health (zero lost requests,
+    # failed-over responses bit-verified inside the generator) and
+    # shared-compile-cache cold starts (module docstring schema;
+    # benchmarks/suite.py config 13 is the bigger form). Runs LAST: its
+    # shared cache dir rebinds the process-wide jax compilation cache.
+    import tempfile
+    if platform != "cpu":
+        fleet_spec = ArraySpec(npsr=40, ntoa=260, n_red=10, n_dm=10,
+                               gwb_ncomp=10)
+        fleet_requests = 96
+    else:
+        fleet_spec = ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4,
+                               gwb_ncomp=4)
+        fleet_requests = 48
+    fleet_row = run_loadgen(
+        spec=fleet_spec, fleet=3, fleet_transport="process",
+        n_requests=fleet_requests, sizes=(1, 2, 4), n_specs=6, seed=5,
+        baseline=True, verify=2, kill_one_at=0.5,
+        compile_cache_dir=tempfile.mkdtemp(prefix="fleet_cache_"))
+    for key in ("fleet_qps", "fleet_qps_per_chip", "fleet_p50_ms",
+                "fleet_p99_ms", "fleet_speedup_x", "fleet_warm_hit_rate",
+                "fleet_failovers", "fleet_lost_requests",
+                "fleet_steady_compiles", "fleet_retraces",
+                "fleet_solo_qps", "fleet_solo_p50_ms"):
+        if key in fleet_row:
+            row[key] = fleet_row[key]
+
     if fallback:
         row["fallback"] = "accelerator backend unavailable; CPU stand-in"
     print(json.dumps(row))

@@ -2,7 +2,8 @@
 6: config 4 as one device program, 7: the full-noise ECORR/system ensemble,
 8: the flagship with per-realization hyperparameter sampling, 9: the flagship
 with a per-realization sampled CW source, 10: the 256-pulsar scale-out,
-11: the flagship with per-realization white-noise sampling).
+11: the flagship with per-realization white-noise sampling, 12: the chaos
+lane, 13: the multi-replica serve fleet A/B with mid-load replica kill).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -443,6 +444,43 @@ def config12():
                 out["report"].counters.get("faults.retries", 0))}
 
 
+def config13():
+    """Fleet lane (docs/SERVING.md "Fleet"): 3 subprocess ServePool
+    replicas behind the spec-hash router, measured by the loadgen's
+    multi-replica mode against ONE pool serving the same traffic. The
+    workload cycles a spec working set LARGER than one pool's LRU warm
+    capacity (the sharding win a single chip can demonstrate; multi-chip
+    hosts add dispatcher parallelism on top), kills one replica at half
+    load (failover A/B: ``fleet_lost_requests`` must be 0 and every
+    failed-over response is bit-verified against its solo run), and all
+    replicas share one persistent compile cache so cold starts are cache
+    loads. The headline ``value`` is ``fleet_speedup_x``."""
+    import tempfile
+
+    import jax
+
+    from fakepta_tpu.serve import ArraySpec, run_loadgen
+
+    if jax.devices()[0].platform != "cpu":
+        fleet_spec = ArraySpec(npsr=40, ntoa=260, n_red=10, n_dm=10,
+                               gwb_ncomp=10)
+        fleet_requests = 96
+    else:
+        fleet_spec = ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4,
+                               gwb_ncomp=4)
+        fleet_requests = 72
+    cache = tempfile.mkdtemp(prefix="fleet_cache_")
+    row = run_loadgen(
+        spec=fleet_spec, fleet=3, fleet_transport="process",
+        n_requests=fleet_requests, sizes=(1, 2, 4), n_specs=6, seed=5,
+        baseline=True, verify=3, kill_one_at=0.5,
+        compile_cache_dir=cache)
+    return {"config": 13,
+            "metric": "fleet speedup vs one ServePool (3 replicas, "
+                      "6-spec working set, 1 replica killed mid-load)",
+            "value": row.get("fleet_speedup_x", 0.0), "unit": "x", **row}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -643,7 +681,7 @@ def config5():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
-                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12])
+                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--nreal-scale", type=float, default=1.0,
@@ -670,7 +708,7 @@ def main():
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12}
+           11: config11, 12: config12, 13: config13}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
     # platform identity single-sourced through the tuner's fingerprint

@@ -129,6 +129,15 @@ UNBOUNDED_QUEUE_MODULES = (
     "fakepta_tpu/parallel/pipeline.py",
 )
 
+# unbounded-socket-io allowlist: library modules whose blocking socket
+# reads are bounded by an EXTERNAL invariant rather than a settimeout in
+# scope (e.g. an intentionally-blocking accept loop whose lifetime the
+# process owner controls). Currently empty: the serve socket server sets a
+# per-connection idle timeout in its handler setup and the fleet's socket
+# client stamps timeouts at connect (serve/cli.py, serve/fleet.py), so
+# every blocking read in the repo carries a deadline in scope.
+SOCKET_IO_MODULES = ()
+
 # swallowed-exception allowlist: library modules whose broad silent
 # handlers are the DESIGN, not a leak. obs/flightrec.py is the crash
 # flight recorder itself: its dump path runs inside another exception's

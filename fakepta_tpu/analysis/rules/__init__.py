@@ -6,11 +6,11 @@ true positive, one clean file), and document it in docs/INVARIANTS.md.
 """
 
 from . import (donation, dtype, excepts, hostsync, knobs, meshaxis,
-               precision, queues, rng, timing, tracer)
+               precision, queues, rng, socketio, timing, tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
                               donation, precision, timing, queues, excepts,
-                              knobs))
+                              knobs, socketio))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)

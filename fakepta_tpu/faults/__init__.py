@@ -27,11 +27,12 @@ from .plan import (FaultError, FaultPlan, FaultSpec, DegradeFault,
                    FatalFault, KillFault, PrecisionFault, TransientFault,
                    WatchdogTimeout, active, check, inject)
 from .recovery import (DISABLED, PATH_LADDER, RecoveryPolicy, as_policy,
-                       classify, sleep)
+                       classify, classify_replica, sleep)
 
 __all__ = [
     "DISABLED", "DegradeFault", "FatalFault", "FaultError", "FaultPlan",
     "FaultSpec", "KillFault", "PATH_LADDER", "PrecisionFault",
     "RecoveryPolicy", "TransientFault", "WatchdogTimeout", "active",
-    "as_policy", "check", "classify", "inject", "sleep",
+    "as_policy", "check", "classify", "classify_replica", "inject",
+    "sleep",
 ]

@@ -28,6 +28,7 @@ site                      where it is checked
 ``cache.load``            pipeline.configure_compile_cache
 ``serve.dispatch``        ServePool's dispatcher thread, per cohort
 ``sample.segment``        SamplingRun.run, before each segment dispatch
+``fleet.replica``         ServeFleet's router, per dispatch to a replica
 ========================  ====================================================
 
 Fault kinds: ``transient`` / ``fatal`` raise (:class:`TransientFault` /

@@ -47,6 +47,20 @@ _TRANSIENT_PATTERNS = ("resource_exhausted", "resource exhausted",
                        "unavailable", "deadline_exceeded", "deadline "
                        "exceeded", "aborted", "connection reset",
                        "socket closed", "preempt")
+# fingerprints of a serve-fleet replica dying under a request (connection
+# loss, a closed pool, a killed subprocess) — the router's failover class:
+# re-dispatching to a SIBLING is the recovery, never retrying the corpse
+_REPLICA_DEATH_PATTERNS = ("connection refused", "connection reset",
+                           "broken pipe", "pipe closed", "socket closed",
+                           "bad file descriptor", "eof",
+                           "died mid-flight", "is dead", "pool is closed",
+                           "pool closed")
+# exception type NAMES (matched without importing the serving layer —
+# recovery sits below serve in the import graph) that mean the replica
+# itself is gone rather than the request having failed
+_REPLICA_DEATH_TYPES = ("ReplicaDead", "ServeClosed", "ConnectionError",
+                        "ConnectionResetError", "ConnectionRefusedError",
+                        "BrokenPipeError", "EOFError")
 # fingerprints of a failing Pallas/Mosaic lowering or kernel
 _PALLAS_PATTERNS = ("pallas", "mosaic")
 
@@ -104,6 +118,34 @@ def classify(exc: BaseException) -> str:
     if any(p in msg for p in _TRANSIENT_PATTERNS):
         return "transient"
     return "fatal"
+
+
+def classify_replica(exc: BaseException) -> str:
+    """Fleet-tier failure triage: ``'replica_death'`` when the replica
+    serving the request is gone (the router fails over to a ring sibling —
+    correctness-safe because per-request RNG lanes make the re-dispatch
+    bit-identical per executable shape), else :func:`classify`'s verdict.
+
+    A :class:`KillFault` counts as replica death here: at a fleet site it
+    IS the simulated process kill, and failover to a *different* replica
+    is exactly the recovery that must never be swallowed in-place (the
+    engine-site rule that no recovery catches KillFault still holds — the
+    victim replica's own ladder dies; only the router moves the work).
+    """
+    seen = 0
+    cur: Optional[BaseException] = exc
+    while cur is not None and seen < 8:     # cause chain, cycle-bounded
+        if isinstance(cur, KillFault):
+            return "replica_death"
+        if any(t.__name__ in _REPLICA_DEATH_TYPES
+               for t in type(cur).__mro__):
+            return "replica_death"
+        msg = f"{type(cur).__name__}: {cur}".lower()
+        if any(p in msg for p in _REPLICA_DEATH_PATTERNS):
+            return "replica_death"
+        cur = cur.__cause__
+        seen += 1
+    return classify(exc)
 
 
 def sleep(seconds: float) -> None:

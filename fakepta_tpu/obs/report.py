@@ -43,6 +43,20 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # pad_waste_frac keep the lower-is-better default.
                     "serve_qps_per_chip", "serve_serial_qps_per_chip",
                     "serve_speedup_x", "coalesce_factor",
+                    # the serve FLEET (serve/fleet.py, docs/SERVING.md
+                    # "Fleet"): aggregate request throughput and the
+                    # scale-out multiple over one ServePool are the tier's
+                    # whole point (fleet_solo_qps is the baseline side of
+                    # that A/B — it dropping means the comparison got
+                    # easier, which is itself a regression signal);
+                    # fleet_p50_ms/fleet_p99_ms, fleet_failovers,
+                    # fleet_spillovers, fleet_rejected, fleet_failed,
+                    # fleet_lost_requests, fleet_replica_deaths and
+                    # fleet_steady_compiles/fleet_retraces all keep the
+                    # lower-is-better default; fleet_warm_hit_rate rides
+                    # the _hit_rate suffix
+                    "fleet_qps", "fleet_qps_per_chip", "fleet_speedup_x",
+                    "fleet_solo_qps",
                     # the autotuner (fakepta_tpu.tune, docs/TUNING.md):
                     # tuned-vs-hand-set throughput multiple — dropping
                     # below its band means the tuner stopped finding (or
@@ -91,6 +105,15 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   "queue_depth", "serve_requests", "serve_dispatches",
                   "serve_realizations", "serve_kind", "serve_verified",
                   "serve_warm_s",
+                  # fleet load-shape facts (serve/fleet.py): replica
+                  # counts, traffic description, which replica the chaos
+                  # lane killed, verification tallies, and the baseline
+                  # pool's p50 (a reference condition, not a serve SLO —
+                  # the fleet's own p50/p99 stay regression-bearing)
+                  "fleet_replicas", "fleet_replicas_alive",
+                  "fleet_requests", "fleet_kind", "fleet_transport",
+                  "fleet_killed_replica", "fleet_verified",
+                  "fleet_verified_failover", "fleet_solo_p50_ms",
                   # chaos-lane shape fact (benchmarks/suite.py config 12):
                   # how many injected faults the run recovered — the
                   # regression-bearing metrics are the recovery counters

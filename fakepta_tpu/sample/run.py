@@ -61,11 +61,23 @@ from ..utils import rng as rng_utils
 from ..utils.compat import enable_x64, shard_map
 from .model import SAMPLE_SCHEMA, SAMPLE_TAG, SWAP_TAG, as_spec, diagnostics
 
-#: carry fields the checkpoint snapshot preserves (everything else —
-#: cached likelihood/prior values and gradients — is recomputed from ``z``
-#: by the refresh program, bit-identically, on resume)
-_SNAP_KEYS = ("z", "n", "npair", "prev_valid", "s1", "s2", "s11", "prev",
+#: carry fields the checkpoint snapshot preserves. The cached likelihood/
+#: prior values AND gradients are part of the snapshot: recomputing them
+#: from ``z`` with the standalone refresh program is only ULP-equal to the
+#: in-segment computation (a different executable may fuse the reduction
+#: differently — the shape-dependent-reduction rule, docs/INVARIANTS.md),
+#: and a 1-ULP cached-lnL difference flips Metropolis decisions, so a
+#: resume would drift off the uninterrupted chains. Carrying the exact
+#: values keeps segment-boundary resume/migration bit-exact for EVERY
+#: model/shape (the serve fleet's session-migration unit relies on it);
+#: the refresh program still serves fresh inits (both sides of any A/B
+#: start through it, so cold starts stay bit-comparable).
+_SNAP_KEYS = ("z", "lnl", "glnl", "lnpri", "glnpri",
+              "n", "npair", "prev_valid", "s1", "s2", "s11", "prev",
               "accept", "swap", "swap_att", "divergent", "nonfinite")
+#: the cached-parts subset: present in new snapshots; a pre-fleet
+#: checkpoint without them falls back to the refresh recompute
+_PART_KEYS = ("lnl", "glnl", "lnpri", "glnpri")
 
 
 def _host_ctx():
@@ -660,8 +672,9 @@ class SamplingRun:
     def _init_state(self, seed, refresh, snapshot=None):
         """Device state from the Laplace warm start (or a checkpoint
         snapshot): z is host-staged — identical on every mesh — and the
-        cached likelihood parts are recomputed on device by the refresh
-        program, so a resume reproduces the carry bit-for-bit."""
+        cached likelihood parts come FROM the snapshot when it carries
+        them (bit-exact resume/migration; see _SNAP_KEYS), with the
+        refresh recompute serving fresh inits and pre-fleet checkpoints."""
         spec, d = self.spec, self.compiled.D
         k, t = spec.n_chains, spec.n_temps
         if snapshot is None:
@@ -673,7 +686,8 @@ class SamplingRun:
         shardings = self._state_shardings()
         state = {k2: jax.device_put(v, shardings[k2])
                  for k2, v in host.items()}
-        state.update(refresh(state["z"]))
+        if any(k2 not in state for k2 in _PART_KEYS):
+            state.update(refresh(state["z"]))
         return state
 
     # ------------------------------------------------------------------
@@ -729,7 +743,8 @@ class SamplingRun:
     def _drain_segment(self, thinned, snapshot, rec, out, slot, ckpt,
                        ident, done_segments, is_post, materialize, ev,
                        t_run0, timeline, progress, done_steps, total_steps,
-                       retries=0, backoff_s=0.05, on_retry=None):
+                       retries=0, backoff_s=0.05, on_retry=None,
+                       on_segment=None):
         """Writer-thread completion work for ONE segment (the analog of
         montecarlo._drain_chunk): materialize the thinned buffer so its
         device storage stays donatable, guard against NaN chains (a
@@ -757,6 +772,13 @@ class SamplingRun:
                     f"sampling segment {idx} produced non-finite chain "
                     f"draws (nan-lnL); see the flight-recorder dump")
             out[slot] = arr if is_post else None
+            if on_segment is not None and is_post:
+                # streamed thinned-sample delivery (serve/fleet.py
+                # SamplingSession; runs on the writer thread, AFTER the
+                # finite guard and BEFORE the checkpoint append — a
+                # consumer never sees a segment the checkpoint could lose
+                # on resume without re-delivering it)
+                on_segment(idx, arr)
             if ckpt is not None and jax.process_index() == 0:
                 t_ck = obs.now()
                 snap_h = {k: np.asarray(to_host(v))
@@ -789,7 +811,7 @@ class SamplingRun:
 
     def run(self, n_steps: int, seed=0, segment=None, checkpoint=None,
             pipeline_depth=None, progress=None, eventlog=None,
-            recovery=None, tuned: bool = False) -> dict:
+            recovery=None, tuned: bool = False, on_segment=None) -> dict:
         """Run ``n_steps`` post-warmup MCMC steps (plus the spec's warmup).
 
         The chain loop dispatches one jitted SEGMENT program at a time —
@@ -815,6 +837,12 @@ class SamplingRun:
         per-segment deadline on the oldest in-flight drain (pipelined
         runs). Torn checkpoint files detected at resume restart loudly
         from step 0 (docs/RELIABILITY.md).
+
+        ``on_segment(idx, thinned)`` streams each post-warmup segment's
+        thinned draws as it drains (called on the writer thread, before
+        the checkpoint append — at-least-once delivery across a
+        kill/resume; the serve fleet's ``SamplingSession`` is the
+        consumer, docs/SERVING.md).
         """
         t_run0 = obs.now()
         obs.subscribe_jax_monitoring()
@@ -1035,7 +1063,8 @@ class SamplingRun:
                         timeline, progress, done_steps, total_steps,
                         retries=policy.max_retries,
                         backoff_s=policy.backoff_s,
-                        on_retry=lambda a: collector.count("faults.retries"))
+                        on_retry=lambda a: collector.count("faults.retries"),
+                        on_segment=on_segment)
                     if pipelined:
                         rec["stall_s"] += writer.submit(drain, ev.set)
                         ring.append((thinned, ev))

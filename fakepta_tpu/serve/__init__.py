@@ -10,26 +10,45 @@ telemetry (``serve_p50_ms``/``serve_p99_ms``/``serve_qps_per_chip``,
 ``coalesce_factor``, ``pad_waste_frac``) through ``fakepta_tpu.obs`` are
 part of the lane.
 
+Horizontal scale-out (docs/SERVING.md "Fleet"): :class:`ServeFleet` puts
+a spec-hash consistent-hash router (:class:`HashRing`) in front of N
+replicas — warm-pool affinity per spec shard, saturation spillover,
+fleet-wide 429 aggregation, mid-flight failover (bit-identical per RNG
+lane), a shared persistent compile cache (replica cold-start = cache
+load), and posterior-as-a-service :class:`SamplingSession`\\ s that
+migrate between replicas at segment-boundary checkpoints.
+
 Embeddable surface::
 
     from fakepta_tpu.serve import ArraySpec, ServePool, SimRequest
     pool = ServePool()
     res = pool.serve(SimRequest(spec=ArraySpec(npsr=20), n=32, seed=7))
 
-CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket`` (the load
-generator prints the benchmark row ``bench.py`` records).
+    from fakepta_tpu.serve import LocalReplica, ServeFleet
+    fleet = ServeFleet([LocalReplica("r0"), LocalReplica("r1")])
+    res = fleet.serve(SimRequest(spec=ArraySpec(npsr=20), n=32, seed=7))
+
+CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket|replica|fleet``
+(the load generator prints the benchmark row ``bench.py`` records; the
+fleet command prints the multi-replica row).
 """
 
-from .loadgen import run_loadgen
+from .fleet import (FleetConfig, LocalReplica, ReplicaDead,
+                    SampleSessionSpec, SamplingSession, ServeFleet,
+                    SocketReplica)
+from .loadgen import run_fleet_loadgen, run_loadgen
 from .pool import PoolEntry, WarmPool
+from .router import HashRing
 from .scheduler import ServeConfig, ServePool, ServeResult
 from .spec import (DEFAULT_BUCKETS, ArraySpec, InferRequest, OSRequest,
                    ServeBusy, ServeClosed, ServeError, ServeTimeout,
                    SimRequest, curn_grid_spec)
 
 __all__ = [
-    "DEFAULT_BUCKETS", "ArraySpec", "InferRequest", "OSRequest",
-    "PoolEntry", "ServeBusy", "ServeClosed", "ServeConfig", "ServeError",
-    "ServePool", "ServeResult", "ServeTimeout", "SimRequest", "WarmPool",
-    "curn_grid_spec", "run_loadgen",
+    "DEFAULT_BUCKETS", "ArraySpec", "FleetConfig", "HashRing",
+    "InferRequest", "LocalReplica", "OSRequest", "PoolEntry",
+    "ReplicaDead", "SampleSessionSpec", "SamplingSession", "ServeBusy",
+    "ServeClosed", "ServeConfig", "ServeError", "ServeFleet", "ServePool",
+    "ServeResult", "ServeTimeout", "SimRequest", "SocketReplica",
+    "WarmPool", "curn_grid_spec", "run_fleet_loadgen", "run_loadgen",
 ]

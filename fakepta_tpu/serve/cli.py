@@ -1,6 +1,6 @@
-"""CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket ...``.
+"""CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket|replica|fleet``.
 
-Three drivers over one :class:`ServePool`:
+Five drivers over the serving layer:
 
 - ``loadgen`` — the built-in synthetic load generator / benchmark
   (:mod:`.loadgen`): prints ONE JSON row with the SLO metrics (and, with
@@ -9,9 +9,19 @@ Three drivers over one :class:`ServePool`:
   line is a request object, each output line a response (responses stream
   in completion order; match them by ``id``);
 - ``socket`` — the same JSON-lines protocol over TCP (one connection per
-  client, threaded), for processes that are not children of the server.
+  client, threaded), for processes that are not children of the server;
+- ``replica`` — the fleet endpoint (docs/SERVING.md "Fleet"): the socket
+  server plus a one-line JSON ready banner on stdout (``{"event":
+  "ready", "port": ..., "n_devices": ...}`` — how the router learns the
+  bound port when spawned with ``--port 0``) and ``--index`` stamping the
+  report's ``process_index`` so ``obs trace`` merges replica artifacts
+  into per-replica pid lanes;
+- ``fleet`` — the multi-replica load benchmark (``run_loadgen(fleet=N)``,
+  :mod:`.fleet`): spawns N ``replica`` subprocesses behind the
+  consistent-hash router and prints one fleet row (``fleet_qps_per_chip``,
+  ``fleet_p50_ms``/``p99``, failover count, warm-pool hit rate).
 
-Request line schema (shared by stdin/socket)::
+Request line schema (shared by stdin/socket/replica)::
 
     {"id": 1, "kind": "sim"|"os"|"infer", "n": 16, "seed": 7,
      "spec": {"npsr": 20, ...} | "registered-name",   # optional: default spec
@@ -19,11 +29,29 @@ Request line schema (shared by stdin/socket)::
      "orf": "hd", "weighting": "noise", "null": false, # kind == "os"
      "grid": {"k": 4, "nbin": 10}}                     # kind == "infer"
 
+plus two fleet-protocol kinds: ``{"id", "kind": "stats"}`` answers with
+the pool's live SLO summary, and ``{"id", "kind": "sample", "steps": 64,
+"seed": 7, "spec": {...}, "session": {"n_chains": 4, ...},
+"checkpoint": "/shared/ck"}`` opens a posterior-as-a-service session that
+STREAMS one line per drained segment (``{"id", "ok": true, "seg": k,
+...thinned draws...}``) and a final ``{"id", "ok": true, "done": true,
+"summary": {...}}`` — with ``checkpoint`` on a shared filesystem, a
+sibling replica resumes the session bit-exactly after a failover
+(segment-boundary checkpoints are the migration unit).
+
 Responses: ``{"id", "ok": true, "n", "latency_ms", "queued_ms", "bucket",
 "cohort_requests", ...results}`` with ``--emit summary`` (per-request curve
 means) or ``--emit full`` (full per-realization arrays). Failures:
 ``{"id", "ok": false, "code": "busy"|"timeout"|"error", "error": msg}`` —
-``busy`` is the 429-style admission rejection (docs/SERVING.md).
+``busy`` is the 429-style admission rejection and carries the scheduler's
+``retry_after_s`` hint (docs/SERVING.md).
+
+Socket hardening (the fleet endpoint is exposed to non-child processes):
+per-connection idle ``settimeout`` (``--idle-timeout``), a bounded
+request-line length (:data:`MAX_REQUEST_LINE`), and loud flight-recorder
+notes on malformed frames — a stalled or hostile client can no longer pin
+a handler thread forever (the ``unbounded-socket-io`` analysis rule keeps
+library socket reads bounded repo-wide, docs/INVARIANTS.md).
 """
 
 from __future__ import annotations
@@ -36,9 +64,19 @@ import threading
 
 import numpy as np
 
+from ..obs import flightrec
 from .scheduler import ServeConfig, ServePool
 from .spec import (ArraySpec, InferRequest, OSRequest, ServeBusy,
                    ServeTimeout, SimRequest, curn_grid_spec)
+
+#: longest request line a server will read before declaring the frame
+#: malformed and closing the connection (a hostile client could otherwise
+#: grow one "line" without bound — host memory is the blast radius)
+MAX_REQUEST_LINE = 1 * 1024 * 1024
+
+#: default per-connection idle timeout: a stalled client's handler thread
+#: is reclaimed instead of pinned forever
+DEFAULT_IDLE_TIMEOUT_S = 300.0
 
 
 def _spec_from_args(args) -> ArraySpec:
@@ -124,10 +162,84 @@ def response_json(req_id, res, emit: str = "summary") -> dict:
     return out
 
 
+def request_to_json(req: SimRequest, req_id) -> dict:
+    """Request object -> protocol line (the client half of
+    :func:`request_from_json`; the fleet's socket transport uses it).
+    ``InferRequest`` carries an arbitrary :class:`InferSpec`, which has no
+    general JSON form — route those through an in-process replica."""
+    d = {"id": req_id, "kind": req.kind, "n": int(req.n),
+         "seed": int(req.seed)}
+    if req.deadline_s is not None:
+        d["deadline_ms"] = req.deadline_s * 1e3
+    if isinstance(req.spec, str):
+        d["spec"] = req.spec
+    elif isinstance(req.spec, ArraySpec):
+        d["spec"] = dataclasses.asdict(req.spec)
+    else:
+        raise ValueError("only named or ArraySpec requests cross the "
+                         "socket protocol")
+    if isinstance(req, InferRequest):
+        raise ValueError("InferRequest has no JSON form (arbitrary "
+                         "InferSpec); use the in-process fleet transport")
+    if isinstance(req, OSRequest):
+        d["orf"] = (req.orf if isinstance(req.orf, str) else list(req.orf))
+        d["weighting"] = req.weighting
+        d["null"] = bool(req.null)
+    return d
+
+
 def error_json(req_id, exc) -> dict:
     code = ("busy" if isinstance(exc, ServeBusy)
             else "timeout" if isinstance(exc, ServeTimeout) else "error")
-    return {"id": req_id, "ok": False, "code": code, "error": str(exc)}
+    out = {"id": req_id, "ok": False, "code": code, "error": str(exc)}
+    hint = getattr(exc, "retry_after_s", None)
+    if hint is not None:
+        # the 429 Retry-After hint crosses the wire, so a fleet router can
+        # aggregate per-replica backlog into its own 429s
+        out["retry_after_s"] = round(float(hint), 4)
+    return out
+
+
+def _serve_sample(pool, d: dict, req_id, emit_line, default_spec,
+                  emit: str) -> None:
+    """One posterior-as-a-service session (protocol kind ``sample``):
+    streams a line per drained segment, then the summary line. Runs
+    synchronously on the connection's handler thread — one connection is
+    one session (docs/SERVING.md "Fleet")."""
+    from .fleet import SampleSessionSpec
+
+    spec = d.get("spec")
+    spec = ArraySpec(**spec) if isinstance(spec, dict) else default_spec
+    knob_names = ("nbin", "n_chains", "n_temps", "warmup", "thin",
+                  "step_size", "n_leapfrog", "data_seed")
+    knobs = {k: v for k, v in (d.get("session") or {}).items()
+             if k in knob_names}
+    sess = SampleSessionSpec(spec=spec, n_steps=int(d.get("steps", 32)),
+                             seed=int(d.get("seed", 0)),
+                             segment=d.get("segment"), **knobs)
+    from ..sample import SamplingRun
+
+    batch, _gwb = sess.spec.parts()
+    run = SamplingRun(batch, sess.sample_spec(), mesh=pool.mesh,
+                      data_seed=sess.data_seed,
+                      compile_cache_dir=pool._pool.cache_dir)
+
+    def on_segment(idx, arr):
+        msg = {"id": req_id, "ok": True, "seg": int(idx),
+               "n": int(arr.shape[0])}
+        if emit == "full":
+            msg["theta"] = np.asarray(arr).tolist()
+        else:
+            msg["theta_mean"] = np.asarray(arr).mean(axis=(0, 1)).tolist()
+        emit_line(msg)
+
+    out = run.run(sess.n_steps, seed=sess.seed, segment=sess.segment,
+                  checkpoint=d.get("checkpoint"), pipeline_depth=0,
+                  on_segment=on_segment)
+    emit_line({"id": req_id, "ok": True, "done": True,
+               "summary": out["summary"],
+               "n_kept": int(out["theta"].shape[0]),
+               "param_names": list(out["param_names"])})
 
 
 def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
@@ -144,12 +256,26 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
         raw = raw.strip()
         if not raw:
             continue
+        d = None
         try:
             d = json.loads(raw)
-            req = request_from_json(d, default_spec)
             req_id = d.get("id")
-        except (ValueError, KeyError, TypeError) as exc:
-            emit_line({"id": None, "ok": False, "code": "bad_request",
+            kind = d.get("kind", "sim")
+            if kind == "stats":
+                # fleet-protocol introspection: the router audits each
+                # replica's warm-pool health (steady compiles, retraces)
+                emit_line({"id": req_id, "ok": True,
+                           "stats": pool.slo_summary()})
+                continue
+            if kind == "sample":
+                _serve_sample(pool, d, req_id, emit_line, default_spec,
+                              emit)
+                continue
+            req = request_from_json(d, default_spec)
+        except (ValueError, KeyError, TypeError, AttributeError) as exc:
+            flightrec.note("serve_bad_request", error=repr(exc)[:200])
+            emit_line({"id": d.get("id") if isinstance(d, dict) else None,
+                       "ok": False, "code": "bad_request",
                        "error": str(exc)})
             continue
         try:
@@ -203,36 +329,121 @@ def _cmd_stdin(args) -> int:
     return 0
 
 
-def _cmd_socket(args) -> int:
+def _bounded_lines(rfile, connection, idle_timeout_s: float):
+    """Request lines from a socket file, hardened: a per-connection idle
+    ``settimeout`` bounds every blocking read, the line length is bounded
+    by :data:`MAX_REQUEST_LINE`, and both failure modes leave a loud
+    flight-recorder note instead of a pinned handler thread."""
+    import socket as socket_mod
+
+    if idle_timeout_s:
+        connection.settimeout(idle_timeout_s)
+    while True:
+        try:
+            raw = rfile.readline(MAX_REQUEST_LINE + 1)
+        except socket_mod.timeout:
+            flightrec.note("serve_socket_idle_timeout")
+            return
+        except OSError as exc:
+            flightrec.note("serve_socket_read_error",
+                           error=repr(exc)[:160])
+            return
+        if not raw:
+            return
+        if len(raw) > MAX_REQUEST_LINE:
+            flightrec.note("serve_socket_oversized_frame", bytes=len(raw))
+            return
+        yield raw.decode("utf-8", "replace")
+
+
+def _socket_server(pool, args, idle_timeout_s: float):
+    """The hardened threaded JSON-lines TCP server (shared by the
+    ``socket`` and ``replica`` commands)."""
     import socketserver
 
-    pool = ServePool(config=_config_from_args(args),
-                     compile_cache_dir=args.compile_cache)
     default_spec = _spec_from_args(args)
     emit = args.emit
 
     class Handler(socketserver.StreamRequestHandler):
         def handle(self):
-            lines = (raw.decode("utf-8", "replace") for raw in self.rfile)
-            _serve_stream(pool, lines,
-                          lambda s: (self.wfile.write(s.encode()),
-                                     self.wfile.flush()),
-                          default_spec, emit)
+            try:
+                _serve_stream(pool,
+                              _bounded_lines(self.rfile, self.connection,
+                                             idle_timeout_s),
+                              lambda s: (self.wfile.write(s.encode()),
+                                         self.wfile.flush()),
+                              default_spec, emit)
+            except OSError as exc:
+                # client went away mid-response: connection-scoped, the
+                # pool and every other connection are unaffected
+                flightrec.note("serve_socket_write_error",
+                               error=repr(exc)[:160])
 
     class Server(socketserver.ThreadingTCPServer):
         allow_reuse_address = True
         daemon_threads = True
 
-    with Server((args.host, args.port), Handler) as server:
-        print(f"serving on {args.host}:{server.server_address[1]} "
-              f"(JSON-lines; ^C to stop)", file=sys.stderr)
+    return Server((args.host, args.port), Handler)
+
+
+def _cmd_socket(args, banner: bool = False) -> int:
+    if getattr(args, "jax_platform", None):
+        # the replica endpoint must pin its backend BEFORE the pool's
+        # first device use (env JAX_PLATFORMS alone is not honored when a
+        # TPU plugin self-registers; cf. tests/conftest.py)
+        import jax
+        jax.config.update("jax_platforms", args.jax_platform)
+    if getattr(args, "x64", False):
+        import jax
+        # fakepta: allow[dtype-policy] a replica subprocess must mirror
+        # its router's x64 mode or scalar promotion desyncs the response
+        # bit-identity contract; set at process entry before any device
+        # use — CLI plumbing, not library math
+        jax.config.update("jax_enable_x64", True)
+    mesh = None
+    if getattr(args, "devices", None):
+        import jax
+        from ..parallel.mesh import make_mesh
+        mesh = make_mesh(jax.devices()[:args.devices])
+    pool = ServePool(mesh=mesh, config=_config_from_args(args),
+                     compile_cache_dir=args.compile_cache)
+    with _socket_server(pool, args, args.idle_timeout) as server:
+        if banner:
+            # the fleet router spawns replicas with --port 0 and learns
+            # the bound port from this one-line JSON banner
+            print(json.dumps({"event": "ready",
+                              "port": server.server_address[1],
+                              "n_devices": pool.n_devices,
+                              "index": getattr(args, "index", 0)}),
+                  flush=True)
+        else:
+            print(f"serving on {args.host}:{server.server_address[1]} "
+                  f"(JSON-lines; ^C to stop)", file=sys.stderr)
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
     if args.report:
-        pool.save_report(args.report)
+        rep = pool.report()
+        rep.meta["process_index"] = int(getattr(args, "index", 0))
+        rep.save(args.report)
     pool.close()
+    return 0
+
+
+def _cmd_fleet(args) -> int:
+    from .loadgen import run_loadgen
+
+    row = run_loadgen(
+        spec=_spec_from_args(args), n_requests=args.requests,
+        sizes=tuple(args.sizes), kind=args.kind, seed=args.seed,
+        baseline=args.baseline, verify=args.verify,
+        config=_config_from_args(args),
+        compile_cache_dir=args.compile_cache, report_path=args.report,
+        fleet=args.replicas, fleet_transport=args.transport,
+        n_specs=args.specs,
+        kill_one_at=args.kill_one_at)
+    print(json.dumps(row))
     return 0
 
 
@@ -287,11 +498,67 @@ def build_parser() -> argparse.ArgumentParser:
     _add_common(st)
     st.add_argument("--emit", choices=("summary", "full"), default="summary")
 
+    def _add_socket_common(p):
+        p.add_argument("--host", default="127.0.0.1")
+        p.add_argument("--port", type=int, default=8791,
+                       help="TCP port (0 = bind any free port)")
+        p.add_argument("--emit", choices=("summary", "full"),
+                       default="summary")
+        p.add_argument("--idle-timeout", type=float,
+                       default=DEFAULT_IDLE_TIMEOUT_S,
+                       help="per-connection idle timeout in seconds "
+                            "(0 disables; default 300)")
+        p.add_argument("--devices", type=int, default=None,
+                       help="serve on the first N local devices (default: "
+                            "all; fleet replicas on the CPU stand-in pin "
+                            "1 so parent-side bit-verification shares the "
+                            "executable shape)")
+
     so = sub.add_parser("socket", help="JSON-lines over TCP")
     _add_common(so)
-    so.add_argument("--host", default="127.0.0.1")
-    so.add_argument("--port", type=int, default=8791)
-    so.add_argument("--emit", choices=("summary", "full"), default="summary")
+    _add_socket_common(so)
+
+    rp = sub.add_parser("replica", help="fleet endpoint: the socket "
+                                        "server + a JSON ready banner "
+                                        "(docs/SERVING.md Fleet)")
+    _add_common(rp)
+    _add_socket_common(rp)
+    rp.set_defaults(emit="full")     # failover bit-verification needs
+    #                                  full per-realization arrays
+    rp.add_argument("--index", type=int, default=0,
+                    help="replica index (the report's process_index — "
+                         "one pid lane per replica under `obs trace`)")
+    rp.add_argument("--jax-platform", default=None,
+                    help="pin the jax backend before the pool starts "
+                         "(subprocess replicas on the CPU stand-in)")
+    rp.add_argument("--x64", action="store_true",
+                    help="enable jax x64 mode (a replica must match its "
+                         "router's mode or scalar promotion desyncs the "
+                         "bit-identity contract)")
+
+    fl = sub.add_parser("fleet", help="multi-replica load benchmark: one "
+                                      "JSON row of fleet SLO metrics")
+    _add_common(fl)
+    fl.add_argument("--replicas", type=int, default=3)
+    fl.add_argument("--transport", choices=("process", "inproc"),
+                    default="process",
+                    help="replica transport: subprocess sockets (the "
+                         "production shape) or in-process pools")
+    fl.add_argument("--requests", type=int, default=96)
+    fl.add_argument("--sizes", type=int, nargs="*", default=[1, 2, 4])
+    fl.add_argument("--specs", type=int, default=6,
+                    help="distinct specs in the traffic (the spec-space "
+                         "working set the ring shards)")
+    fl.add_argument("--kind", choices=("sim", "os"), default="sim")
+    fl.add_argument("--seed", type=int, default=0)
+    fl.add_argument("--baseline", action="store_true",
+                    help="also serve the same traffic through ONE pool "
+                         "and report fleet_speedup_x")
+    fl.add_argument("--verify", type=int, default=3)
+    fl.add_argument("--kill-one-at", type=float, default=None,
+                    help="kill one replica after this fraction of "
+                         "requests is submitted (the failover A/B; "
+                         "responses stay bit-verified)")
     return parser
 
 
@@ -301,6 +568,10 @@ def main(argv=None) -> int:
         return _cmd_loadgen(args)
     if args.command == "stdin":
         return _cmd_stdin(args)
+    if args.command == "replica":
+        return _cmd_socket(args, banner=True)
+    if args.command == "fleet":
+        return _cmd_fleet(args)
     return _cmd_socket(args)
 
 

@@ -1,0 +1,895 @@
+"""Horizontal scale-out: a spec-hash-routed fleet of ServePool replicas.
+
+One :class:`~fakepta_tpu.serve.ServePool` is one dispatcher on one
+process: aggregate throughput is capped at a single chip's coalescing win
+and warm capacity at one LRU pool (``max_specs`` resident specs). The
+fleet tier puts a router in front of N replicas (docs/SERVING.md "Fleet"):
+
+- **spec-hash routing** (:mod:`.router`): requests consistent-hash by
+  ``spec_hash`` so each replica's warm pool stays hot on its shard of the
+  spec space — aggregate warm capacity scales N×, and on multi-chip hosts
+  the N dispatchers run in parallel on disjoint devices;
+- **spillover**: a saturated owner (its fleet in-flight bound, or a
+  ``ServeBusy`` from its own admission control) spills to the ring's next
+  replica — deterministic per spec, so degraded traffic converges on one
+  sibling's warm pool instead of churning the whole fleet;
+- **fleet-wide backpressure**: when every live replica is saturated the
+  router raises its own :class:`~fakepta_tpu.serve.ServeBusy` whose
+  ``retry_after_s`` aggregates the per-replica backlog hints (the
+  smallest — the first replica expected to free up);
+- **failover**: a dead or wedged replica (connection loss, closed pool,
+  an injected ``fleet.replica`` kill) triggers mid-flight re-dispatch of
+  its in-flight requests to the next live sibling. This is
+  correctness-safe because of the per-request RNG-lane contract: a
+  re-dispatched request draws the same streams on any replica, so the
+  failed-over response is bit-identical to a solo run at the same
+  executable shape (tests/test_fleet.py pins it);
+- **shared compile cache**: every replica points at the same persistent
+  compile cache (``FAKEPTA_TPU_COMPILE_CACHE``), so a replica cold-start
+  — or a sibling absorbing a failed replica's shard — is a cache *load*,
+  not a compile;
+- **posterior-as-a-service** (:class:`SamplingSession`): long-running
+  sampling runs with replica affinity, segment-boundary checkpoints as
+  the migration unit on failover (cross-mesh resume is bit-exact, PR 8),
+  and per-segment streamed thinned-sample delivery.
+
+Two replica transports share one interface: :class:`LocalReplica` wraps an
+in-process pool (embedding + the lean tier-1 tests), :class:`SocketReplica`
+spawns ``python -m fakepta_tpu.serve replica`` and speaks the JSON-lines
+socket protocol (the production shape; ``serve/cli.py``). The fleet itself
+is transport-agnostic.
+
+Observability: :meth:`ServeFleet.slo_summary` rolls the router's counters
+(``fleet_qps_per_chip``, ``fleet_p50_ms``/``fleet_p99_ms``,
+``fleet_failovers``, ``fleet_warm_hit_rate``, ...) into the obs direction
+tables; per-replica RunReports carry a ``process_index`` so ``obs trace``
+merges them into one timeline with a pid lane per replica.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import socket
+import subprocess
+import sys
+import threading
+from concurrent.futures import Future
+from pathlib import Path
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import faults as faults_mod
+from .. import obs
+from ..obs import flightrec
+from .router import HashRing
+from .scheduler import ServeConfig, ServePool, ServeResult
+from .spec import (ArraySpec, ServeBusy, ServeClosed, ServeError,
+                   resolve_spec_hash)
+
+#: maximum protocol line a replica client will read before declaring the
+#: frame malformed (mirrors the server-side bound in serve/cli.py)
+MAX_LINE_BYTES = 8 * 1024 * 1024
+
+
+class ReplicaDead(ServeError):
+    """The target replica is gone (process death, connection loss, closed
+    pool): the router fails over instead of retrying in place."""
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetConfig:
+    """Router-tier knobs (per-replica scheduler knobs stay in
+    :class:`~fakepta_tpu.serve.ServeConfig`).
+
+    ``max_inflight_per_replica`` is the router's own admission bound — the
+    fleet-side analog of ``ServeConfig.max_queue_depth`` (both exist: the
+    router bounds what it hands a replica, the replica bounds what it
+    accepts from everyone). ``max_failovers`` caps per-request
+    re-dispatches so a poisoned request cannot tour the fleet forever.
+    """
+
+    max_inflight_per_replica: int = 64
+    max_failovers: int = 2
+    vnodes: int = 64
+    result_window: int = 4096        # fleet SLO ring capacity (requests)
+
+
+class _Inflight:
+    __slots__ = ("req", "spec_hash", "outer", "t_enq", "failovers",
+                 "replica_id", "owner_id")
+
+    def __init__(self, req, spec_hash, outer, t_enq, owner_id):
+        self.req = req
+        self.spec_hash = spec_hash
+        self.outer = outer
+        self.t_enq = t_enq
+        self.failovers = 0
+        self.replica_id = None
+        self.owner_id = owner_id
+
+
+# ---------------------------------------------------------------------------
+# replica transports
+# ---------------------------------------------------------------------------
+
+class LocalReplica:
+    """An in-process replica: one :class:`ServePool` behind the fleet
+    interface (embedding, and the transport the lean tier-1 fleet tests
+    run — no subprocess startup, same routing/failover semantics)."""
+
+    def __init__(self, replica_id: str, mesh=None,
+                 config: Optional[ServeConfig] = None,
+                 compile_cache_dir: Optional[str] = None, index: int = 0):
+        self.id = str(replica_id)
+        self.index = int(index)
+        self.pool = ServePool(mesh=mesh, config=config,
+                              compile_cache_dir=compile_cache_dir)
+        self.alive = True
+        self._compile_cache_dir = self.pool._pool.cache_dir
+
+    @property
+    def n_devices(self) -> int:
+        return self.pool.n_devices
+
+    def device_ids(self) -> Tuple[int, ...]:
+        return tuple(int(d.id) for d in self.pool.mesh.devices.flat)
+
+    def submit(self, req) -> Future:
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        try:
+            return self.pool.submit(req)
+        except ServeClosed as exc:
+            self.alive = False
+            raise ReplicaDead(f"replica {self.id} pool is closed") from exc
+
+    def retry_hint(self) -> float:
+        with self.pool._lock:
+            return self.pool._retry_after_locked()
+
+    def slo_summary(self) -> dict:
+        return self.pool.slo_summary()
+
+    def report(self):
+        rep = self.pool.report()
+        rep.meta["process_index"] = self.index
+        rep.meta["replica_id"] = self.id
+        return rep
+
+    def sampling_run(self, sess: "SampleSessionSpec"):
+        """Build the session's :class:`~fakepta_tpu.sample.SamplingRun` on
+        THIS replica's mesh (the affinity contract: the staged moments and
+        warm start live with the replica that owns the session)."""
+        from ..sample import SamplingRun
+
+        batch, _gwb = sess.spec.parts()
+        return SamplingRun(batch, sess.sample_spec(), mesh=self.pool.mesh,
+                           data_seed=sess.data_seed,
+                           compile_cache_dir=self._compile_cache_dir)
+
+    def kill(self) -> None:
+        """Simulated replica death: pending work fails like a crashed
+        process (the in-process analog of SIGKILL for the chaos tests)."""
+        self.alive = False
+        self.pool.close(drain=False)
+
+    def close(self) -> None:
+        self.alive = False
+        self.pool.close()
+
+
+class SocketReplica:
+    """A subprocess replica speaking the JSON-lines socket protocol.
+
+    Spawns ``python -m fakepta_tpu.serve replica --port 0`` (the hardened
+    socket server), reads its one-line JSON ready banner for the bound
+    port, and multiplexes requests over a single connection: a writer
+    lock serializes request lines, one reader thread resolves futures by
+    ``id``. Reader EOF or a socket error marks the replica dead and fails
+    every in-flight future with :class:`ReplicaDead` — which is what
+    triggers the router's mid-flight failover.
+    """
+
+    def __init__(self, replica_id: str, spec_defaults: ArraySpec,
+                 compile_cache_dir: Optional[str] = None,
+                 buckets: Optional[Sequence[int]] = None, index: int = 0,
+                 devices: Optional[int] = 1, jax_platform: str = "cpu",
+                 startup_timeout_s: float = 120.0,
+                 io_timeout_s: float = 600.0, report_path=None):
+        self.id = str(replica_id)
+        self.index = int(index)
+        self.alive = False
+        self._lock = threading.Lock()
+        self._pending: dict = {}          # req id -> Future
+        self._next_id = 0
+        cmd = [sys.executable, "-m", "fakepta_tpu.serve", "replica",
+               "--port", "0", "--emit", "full",
+               "--index", str(self.index),
+               "--npsr", str(spec_defaults.npsr),
+               "--ntoa", str(spec_defaults.ntoa)]
+        if jax_platform:
+            cmd += ["--jax-platform", jax_platform]
+        if devices:
+            cmd += ["--devices", str(devices)]
+        import jax
+        if jax.config.jax_enable_x64:
+            # the replica must share the router's x64 mode: scalar
+            # promotion differences would break response bit-identity
+            cmd += ["--x64"]
+        if compile_cache_dir:
+            cmd += ["--compile-cache", str(compile_cache_dir)]
+        if buckets:
+            cmd += ["--buckets"] + [str(b) for b in buckets]
+        if report_path is not None:
+            cmd += ["--report", str(report_path)]
+        # the package root on the child's import path regardless of the
+        # caller's cwd (python -m resolves from cwd)
+        pkg_root = str(Path(__file__).resolve().parents[2])
+        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                     stderr=subprocess.DEVNULL, text=True,
+                                     cwd=pkg_root)
+        banner = self._read_banner(startup_timeout_s)
+        self.port = int(banner["port"])
+        self.n_devices = int(banner.get("n_devices", 1))
+        self.sock = socket.create_connection(("127.0.0.1", self.port),
+                                             timeout=io_timeout_s)
+        # the connect timeout persists as the I/O deadline: a wedged (not
+        # just dead) replica surfaces as a timed-out read -> ReplicaDead
+        # -> failover, never a pinned reader thread (the
+        # unbounded-socket-io invariant, docs/INVARIANTS.md)
+        self.sock.settimeout(io_timeout_s)
+        self._rfile = self.sock.makefile("rb")
+        self.alive = True
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name=f"fleet-reader-{self.id}",
+                                        daemon=True)
+        self._reader.start()
+
+    def _read_banner(self, timeout_s: float) -> dict:
+        """The replica's ready line; a subprocess that dies before binding
+        surfaces as a loud startup error, never a hang."""
+        done = {}
+
+        def wait_line():
+            done["line"] = self.proc.stdout.readline()
+
+        t = threading.Thread(target=wait_line, daemon=True)
+        t.start()
+        t.join(timeout_s)
+        line = done.get("line")
+        if not line:
+            self.proc.kill()
+            raise ReplicaDead(
+                f"replica {self.id} printed no ready banner within "
+                f"{timeout_s}s (startup failure)")
+        banner = json.loads(line)
+        if banner.get("event") != "ready":
+            raise ReplicaDead(f"replica {self.id} bad banner: {banner!r}")
+        return banner
+
+    def device_ids(self) -> Tuple[int, ...]:
+        return ()
+
+    def submit(self, req) -> Future:
+        from .cli import request_to_json
+
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        fut: Future = Future()
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+            line = json.dumps(request_to_json(req, req_id)) + "\n"
+            try:
+                self.sock.sendall(line.encode())
+            except OSError as exc:
+                self._pending.pop(req_id, None)
+                self._die_locked(repr(exc))
+                raise ReplicaDead(
+                    f"replica {self.id} send failed: {exc!r}") from exc
+        return fut
+
+    def _read_loop(self):
+        try:
+            for raw in iter(lambda: self._rfile.readline(MAX_LINE_BYTES + 1),
+                            b""):
+                if len(raw) > MAX_LINE_BYTES:
+                    raise ReplicaDead(
+                        f"replica {self.id} sent an oversized frame")
+                self._on_line(json.loads(raw.decode("utf-8", "replace")))
+        except (OSError, ValueError, ReplicaDead) as exc:
+            with self._lock:
+                self._die_locked(repr(exc))
+            return
+        with self._lock:
+            self._die_locked("connection closed (EOF)")
+
+    def _on_line(self, d: dict):
+        with self._lock:
+            fut = self._pending.pop(d.get("id"), None)
+        if fut is None:
+            return
+        if d.get("ok"):
+            fut.set_result(_result_from_json(d))
+            return
+        code = d.get("code")
+        if code == "busy":
+            fut.set_exception(ServeBusy(
+                d.get("error", "replica busy"),
+                retry_after_s=float(d.get("retry_after_s", 0.0))))
+        else:
+            from .spec import ServeTimeout
+            exc_cls = ServeTimeout if code == "timeout" else ServeError
+            fut.set_exception(exc_cls(d.get("error", f"replica error "
+                                                     f"({code})")))
+
+    def _die_locked(self, why: str):
+        if not self.alive and not self._pending:
+            return
+        self.alive = False
+        flightrec.note("fleet_replica_lost", replica=self.id, why=why[:200])
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(ReplicaDead(
+                    f"replica {self.id} died mid-flight: {why}"))
+
+    def stats(self, timeout: float = 60.0) -> dict:
+        """The replica's live ServePool SLO summary (protocol kind
+        ``stats`` — how the router audits warm-pool health fleet-wide)."""
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        fut: Future = Future()
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+            try:
+                self.sock.sendall(
+                    (json.dumps({"id": req_id, "kind": "stats"}) + "\n")
+                    .encode())
+            except OSError as exc:
+                self._pending.pop(req_id, None)
+                self._die_locked(repr(exc))
+                raise ReplicaDead(
+                    f"replica {self.id} send failed: {exc!r}") from exc
+        got = fut.result(timeout=timeout)
+        return got if isinstance(got, dict) else {}
+
+    def retry_hint(self) -> float:
+        return 0.0
+
+    def kill(self) -> None:
+        """SIGKILL the replica process (the chaos lever: in-flight
+        requests fail over through the reader thread's EOF)."""
+        self.proc.kill()
+
+    def close(self) -> None:
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+        self.alive = False
+
+
+def _result_from_json(d: dict):
+    """A full-emit response line -> :class:`ServeResult` (the socket
+    transport reconstitutes exactly what the in-process pool returns; a
+    ``stats`` response passes through as a dict)."""
+    if "stats" in d and "curves" not in d:
+        return d["stats"]
+    res = ServeResult(
+        curves=np.asarray(d["curves"]),
+        autos=np.asarray(d["autos"]),
+        bin_centers=np.asarray(d.get("bin_centers", [])),
+        cohort_requests=int(d.get("cohort_requests", 1)),
+        bucket=int(d.get("bucket", 0)))
+    res.latency_s = float(d.get("latency_ms", 0.0)) / 1e3
+    res.queued_s = float(d.get("queued_ms", 0.0)) / 1e3
+    if d.get("os") is not None:
+        res.os = d["os"]
+    if d.get("lnl") is not None:
+        res.lnlike = {"lnl": np.asarray(d["lnl"])}
+    return res
+
+
+# ---------------------------------------------------------------------------
+# the router tier
+# ---------------------------------------------------------------------------
+
+class _FleetStats:
+    def __init__(self, window: int):
+        self.latency_ms = collections.deque(maxlen=window)
+        self.submitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.rejected = 0
+        self.cancelled = 0
+        self.failovers = 0
+        self.spillovers = 0
+        self.deaths = 0
+        self.owner_served = 0
+        self.per_replica = collections.Counter()
+        self.t_first = None
+        self.t_last = None
+
+
+class ServeFleet:
+    """N replicas + the consistent-hash router (module docstring).
+
+    >>> fleet = ServeFleet([LocalReplica("r0"), LocalReplica("r1")])
+    >>> res = fleet.serve(SimRequest(spec=ArraySpec(npsr=8), n=4, seed=7))
+    >>> res.replica, res.failovers
+    """
+
+    def __init__(self, replicas: Sequence, config: Optional[FleetConfig] = None):
+        if not replicas:
+            raise ValueError("a fleet needs at least one replica")
+        self.config = config or FleetConfig()
+        self.replicas = {r.id: r for r in replicas}
+        if len(self.replicas) != len(replicas):
+            raise ValueError("replica ids must be unique")
+        self.ring = HashRing([r.id for r in replicas],
+                             vnodes=self.config.vnodes)
+        self._lock = threading.Lock()
+        self._inflight = collections.Counter()      # replica id -> count
+        self._stats = _FleetStats(self.config.result_window)
+        self._closed = False
+        flightrec.note("fleet_start", replicas=len(replicas))
+
+    # -- chip accounting ---------------------------------------------------
+    @property
+    def n_chips(self) -> int:
+        """Distinct chips under the fleet: local replicas may share
+        devices (the CPU stand-in), subprocess replicas own theirs."""
+        local_ids: set = set()
+        remote = 0
+        for r in self.replicas.values():
+            ids = r.device_ids()
+            if ids:
+                local_ids.update(ids)
+            else:
+                remote += int(r.n_devices)
+        return max(len(local_ids) + remote, 1)
+
+    def alive_replicas(self) -> List[str]:
+        return [rid for rid, r in self.replicas.items() if r.alive]
+
+    # -- admission / routing ----------------------------------------------
+    def submit(self, req) -> Future:
+        """Route one request; returns a Future resolving to a
+        :class:`ServeResult` whose ``replica``/``failovers`` fields record
+        where it ran. Raises :class:`ServeBusy` (with the aggregated
+        ``retry_after_s``) when every live replica is saturated,
+        :class:`ServeClosed` after shutdown, :class:`ServeError` when no
+        replica is alive."""
+        with self._lock:
+            if self._closed:
+                raise ServeClosed("fleet is closed")
+        spec_hash = resolve_spec_hash(req.spec, {}) \
+            if not isinstance(req.spec, str) else flightrec.spec_hash(
+                {"kind": "registered", "name": req.spec})
+        outer: Future = Future()
+        t = obs.now()
+        inf = _Inflight(req, spec_hash, outer, t,
+                        owner_id=self.ring.owner(spec_hash))
+        with self._lock:
+            self._stats.submitted += 1
+            if self._stats.t_first is None:
+                self._stats.t_first = t
+        self._dispatch(inf, exclude=())
+        return outer
+
+    def serve(self, req, timeout: Optional[float] = None):
+        return self.submit(req).result(timeout=timeout)
+
+    def _mark_dead(self, rid: str, why: str) -> None:
+        r = self.replicas.get(rid)
+        newly = r is not None and r.alive
+        if r is not None:
+            r.alive = False
+        with self._lock:
+            if newly:
+                self._stats.deaths += 1
+        if newly:
+            flightrec.note("fleet_replica_dead", replica=rid,
+                           why=str(why)[:200])
+
+    def _dispatch(self, inf: _Inflight, exclude: Tuple[str, ...]) -> None:
+        """Try the spec's preference order once; busy replicas spill to
+        the next, dead ones are skipped. Runs on the submitter's thread
+        first and on a replica's completion thread after a failover."""
+        hints: List[float] = []
+        spilled = False
+        for rid in self.ring.preference(inf.spec_hash):
+            if rid in exclude:
+                continue
+            replica = self.replicas[rid]
+            if not replica.alive:
+                continue
+            with self._lock:
+                saturated = (self._inflight[rid]
+                             >= self.config.max_inflight_per_replica)
+                if not saturated:
+                    self._inflight[rid] += 1
+            if saturated:
+                # the hint read takes the replica pool's own lock — NEVER
+                # under the fleet lock (a dying pool dispatcher holds its
+                # lock while our completion callback takes the fleet
+                # lock; nesting the other way would be an ABBA deadlock)
+                hints.append(replica.retry_hint()
+                             if hasattr(replica, "retry_hint") else 0.0)
+                spilled = True
+                continue
+            # chaos site (docs/RELIABILITY.md): the router's dispatch to a
+            # replica — `kill` takes the replica down mid-flight, the
+            # failover path must finish the request elsewhere
+            try:
+                faults_mod.check("fleet.replica", replica=rid)
+            except faults_mod.TransientFault:
+                with self._lock:
+                    self._inflight[rid] -= 1
+                spilled = True
+                continue
+            except faults_mod.KillFault:
+                with self._lock:
+                    self._inflight[rid] -= 1
+                self._mark_dead(rid, "injected fleet.replica kill")
+                replica.kill()
+                continue
+            try:
+                inner = replica.submit(inf.req)
+            except ServeBusy as busy:
+                with self._lock:
+                    self._inflight[rid] -= 1
+                    self._stats.spillovers += 1
+                hints.append(getattr(busy, "retry_after_s", 0.0))
+                spilled = True
+                continue
+            except (ReplicaDead, ConnectionError, OSError) as exc:
+                with self._lock:
+                    self._inflight[rid] -= 1
+                self._mark_dead(rid, repr(exc))
+                continue
+            except BaseException:
+                # validation errors etc. propagate to the submitter, but
+                # must not leak the in-flight slot
+                with self._lock:
+                    self._inflight[rid] -= 1
+                raise
+            if spilled:
+                with self._lock:
+                    self._stats.spillovers += 1
+                flightrec.note("fleet_spillover", spec=inf.spec_hash,
+                               to=rid)
+            inf.replica_id = rid
+            inner.add_done_callback(
+                lambda f, inf=inf, rid=rid: self._on_done(inf, rid, f))
+            return
+        # nobody took it
+        if not self.alive_replicas():
+            with self._lock:
+                self._stats.failed += 1
+            err = ServeError("no live replica in the fleet")
+        else:
+            hint = min(hints) if hints else 0.0
+            with self._lock:
+                self._stats.rejected += 1
+            flightrec.note("fleet_busy", spec=inf.spec_hash,
+                           retry_after_s=round(hint, 4))
+            err = ServeBusy(
+                f"every live replica is saturated; retry in ~{hint:.3f}s",
+                retry_after_s=hint)
+        # sync path (first dispatch, called from submit) raises; the
+        # failover path resolves the future instead
+        if inf.failovers == 0 and not inf.outer.done():
+            raise err
+        if not inf.outer.done():
+            inf.outer.set_exception(err)
+
+    def _on_done(self, inf: _Inflight, rid: str, inner: Future) -> None:
+        with self._lock:
+            self._inflight[rid] -= 1
+        exc = inner.exception()
+        if exc is None:
+            res = inner.result()
+            res.replica = rid
+            res.failovers = inf.failovers
+            t_done = obs.now()
+            with self._lock:
+                st = self._stats
+                st.completed += 1
+                st.t_last = t_done
+                st.latency_ms.append((t_done - inf.t_enq) * 1e3)
+                st.per_replica[rid] += 1
+                if rid == inf.owner_id:
+                    st.owner_served += 1
+            inf.outer.set_result(res)
+            return
+        verdict = faults_mod.classify_replica(exc)
+        if (verdict == "replica_death"
+                and inf.failovers < self.config.max_failovers):
+            self._mark_dead(rid, repr(exc))
+            inf.failovers += 1
+            with self._lock:
+                self._stats.failovers += 1
+            flightrec.note("fleet_failover", spec=inf.spec_hash,
+                           from_replica=rid, attempt=inf.failovers)
+            # re-dispatch to the ring's next live sibling: per-request RNG
+            # lanes make the rerun bit-identical per executable shape
+            try:
+                self._dispatch(inf, exclude=(rid,))
+            except ServeBusy as busy:
+                if not inf.outer.done():
+                    inf.outer.set_exception(busy)
+            return
+        if isinstance(exc, ServeBusy) and inf.failovers \
+                < self.config.max_failovers:
+            # async 429 from a socket replica: spill, not fail
+            inf.failovers += 1
+            with self._lock:
+                self._stats.spillovers += 1
+            try:
+                self._dispatch(inf, exclude=(rid,))
+            except ServeBusy as busy:
+                if not inf.outer.done():
+                    inf.outer.set_exception(busy)
+            return
+        from .spec import ServeTimeout
+        with self._lock:
+            if isinstance(exc, ServeTimeout):
+                self._stats.cancelled += 1
+            else:
+                self._stats.failed += 1
+        if not inf.outer.done():
+            inf.outer.set_exception(exc)
+
+    # -- observability -----------------------------------------------------
+    def slo_summary(self) -> dict:
+        """Fleet-level SLO rollup (the ``fleet_*`` rows in
+        docs/SERVING.md's metric table, direction-aware under
+        ``obs compare``/``gate``)."""
+        with self._lock:
+            st = self._stats
+            lat = np.asarray(st.latency_ms, dtype=float)
+            span = ((st.t_last - st.t_first)
+                    if st.t_last is not None and st.t_first is not None
+                    else 0.0)
+            qps = st.completed / span if span > 0 else 0.0
+            out = {
+                "fleet_replicas": len(self.replicas),
+                "fleet_replicas_alive": len(self.alive_replicas()),
+                "fleet_requests": st.completed,
+                "fleet_failed": st.failed,
+                "fleet_rejected": st.rejected,
+                "fleet_qps": round(qps, 3),
+                "fleet_qps_per_chip": round(qps / self.n_chips, 3),
+                "fleet_p50_ms": round(float(np.percentile(lat, 50)), 3)
+                if lat.size else 0.0,
+                "fleet_p99_ms": round(float(np.percentile(lat, 99)), 3)
+                if lat.size else 0.0,
+                "fleet_failovers": st.failovers,
+                "fleet_spillovers": st.spillovers,
+                # derived, not the router's counter: a death detected by
+                # the transport alone (reader EOF with nothing in flight)
+                # must still show up here
+                "fleet_replica_deaths": (len(self.replicas)
+                                         - len(self.alive_replicas())),
+                # the affinity health metric: fraction of completed
+                # requests served by their spec's ring owner — the warm
+                # pools are hot exactly when this stays ~1.0
+                "fleet_warm_hit_rate": round(
+                    st.owner_served / st.completed, 4)
+                if st.completed else 0.0,
+            }
+        # per-replica pool health where the transport exposes it (local
+        # pools always; socket replicas answer the `stats` protocol kind)
+        import concurrent.futures
+
+        compiles = retraces = 0
+        seen = 0
+        for r in self.replicas.values():
+            if not r.alive:
+                continue
+            try:
+                s = (r.slo_summary() if hasattr(r, "slo_summary")
+                     else r.stats(timeout=30.0))
+            except (ServeError, OSError, RuntimeError,
+                    concurrent.futures.TimeoutError):
+                continue
+            if not isinstance(s, dict) or "serve_steady_compiles" not in s:
+                continue
+            seen += 1
+            compiles += int(s.get("serve_steady_compiles", 0))
+            retraces += int(s.get("serve_retraces", 0))
+        if seen:
+            out["fleet_steady_compiles"] = compiles
+            out["fleet_retraces"] = retraces
+        return out
+
+    def reset_stats(self) -> None:
+        """Zero the router's SLO accumulators (the loadgen warmup/measure
+        boundary); replica pools reset theirs separately."""
+        with self._lock:
+            self._stats = _FleetStats(self.config.result_window)
+        for r in self.replicas.values():
+            if isinstance(r, LocalReplica) and r.alive:
+                r.pool.reset_stats()
+
+    def report(self):
+        """Fleet-level RunReport (kind ``serve_fleet``): the router's SLO
+        rollup; per-replica reports merge into a pid-lane trace via
+        :meth:`replica_reports` + ``obs trace``."""
+        from ..obs import RunReport
+
+        meta = {
+            "kind": "serve_fleet",
+            "replicas": len(self.replicas),
+            "n_chips": self.n_chips,
+            "extra_metrics": self.slo_summary(),
+        }
+        return RunReport(meta=meta)
+
+    def replica_reports(self) -> List:
+        """Per-replica RunReports (local transports), each stamped with
+        its ``process_index`` — ``obs.tracefmt.build_trace`` renders them
+        as one merged timeline with a pid lane per replica (socket
+        replicas write the same artifact through ``--report``)."""
+        return [r.report() for r in self.replicas.values()
+                if hasattr(r, "report") and r.alive]
+
+    # -- posterior-as-a-service -------------------------------------------
+    def start_session(self, sess: "SampleSessionSpec",
+                      checkpoint) -> "SamplingSession":
+        """Open a sampling session with replica affinity (the session's
+        hash routes it like any spec) and ``checkpoint`` as the migration
+        unit on failover."""
+        return SamplingSession(self, sess, checkpoint)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        for r in self.replicas.values():
+            try:
+                r.close()
+            except (ServeError, OSError, RuntimeError) as exc:
+                flightrec.note("fleet_replica_close_failed", replica=r.id,
+                               error=repr(exc)[:160])
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+# ---------------------------------------------------------------------------
+# posterior-as-a-service
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass(frozen=True)
+class SampleSessionSpec:
+    """A JSON-expressible long-running sampling session: a synthetic array
+    (:class:`ArraySpec` — the data side) posterior-sampled under a CURN
+    free-spectrum model (the model-independent headline workload,
+    docs/SAMPLING.md). Everything here is a plain scalar so the session
+    request crosses the socket protocol verbatim (the ``sample`` kind in
+    ``serve/cli.py``)."""
+
+    spec: ArraySpec
+    n_steps: int = 32
+    seed: int = 0
+    segment: Optional[int] = None
+    nbin: int = 3
+    n_chains: int = 4
+    n_temps: int = 1
+    warmup: int = 8
+    thin: int = 1
+    step_size: float = 0.3
+    n_leapfrog: int = 4
+    data_seed: int = 0
+
+    def sample_spec(self):
+        from ..infer import ComponentSpec, FreeParam, LikelihoodSpec
+        from ..sample import SampleSpec
+
+        model = LikelihoodSpec(components=(
+            ComponentSpec(target="red", spectrum="batch"),
+            ComponentSpec(target="dm", spectrum="batch"),
+            ComponentSpec(target="curn", nbin=self.nbin,
+                          spectrum="free_spectrum",
+                          free=(FreeParam("log10_rho", (-9.0, -5.0),
+                                          per_bin=True),)),
+        ))
+        return SampleSpec(model=model, n_chains=self.n_chains,
+                          n_temps=self.n_temps, warmup=self.warmup,
+                          thin=self.thin, step_size=self.step_size,
+                          n_leapfrog=self.n_leapfrog)
+
+    def session_hash(self) -> str:
+        d = dataclasses.asdict(self)
+        d["spec"] = self.spec.spec_dict()
+        d["kind"] = "SampleSession"
+        return flightrec.spec_hash(d)
+
+
+class SamplingSession:
+    """One long-running posterior run with replica affinity + failover.
+
+    The session routes to its hash's ring owner and runs there
+    segment-by-segment with a checkpoint at every segment boundary. A
+    replica death mid-run (an injected ``sample.segment`` /
+    ``fleet.replica`` kill, a lost process) migrates the session to the
+    ring's next live sibling, which **resumes from the checkpoint** — and
+    because cross-mesh segment resume is bit-exact (PR 8,
+    tests/test_sample.py), the migrated chains are bit-identical to an
+    uninterrupted run. ``on_segment`` streams each post-warmup segment's
+    thinned draws as it drains (the socket protocol's ``sample`` kind
+    forwards them as one JSON line per segment).
+    """
+
+    def __init__(self, fleet: ServeFleet, sess: SampleSessionSpec,
+                 checkpoint):
+        self.fleet = fleet
+        self.sess = sess
+        self.checkpoint = Path(checkpoint)
+        self.session_hash = sess.session_hash()
+        self.migrations = 0
+        self.replica_id = fleet.ring.owner(self.session_hash)
+
+    def _next_replica(self, exclude):
+        for rid in self.fleet.ring.preference(self.session_hash):
+            r = self.fleet.replicas[rid]
+            if r.alive and rid not in exclude and hasattr(r, "sampling_run"):
+                return rid
+        raise ServeError("no live replica can host the sampling session")
+
+    def run(self, on_segment=None, pipeline_depth: int = 0) -> dict:
+        """Drive the session to completion (synchronously; long-running
+        sessions get their own thread/connection). Returns the
+        :meth:`SamplingRun.run` result dict plus ``session`` bookkeeping.
+        """
+        tried: list = []
+        while True:
+            rid = self._next_replica(tried)
+            self.replica_id = rid
+            replica = self.fleet.replicas[rid]
+            flightrec.note("fleet_session_assign", session=self.session_hash,
+                           replica=rid, migrations=self.migrations)
+            try:
+                run = replica.sampling_run(self.sess)
+                out = run.run(self.sess.n_steps, seed=self.sess.seed,
+                              segment=self.sess.segment,
+                              checkpoint=str(self.checkpoint),
+                              pipeline_depth=pipeline_depth,
+                              on_segment=on_segment)
+                out["session"] = {"hash": self.session_hash,
+                                  "replica": rid,
+                                  "migrations": self.migrations}
+                return out
+            except BaseException as exc:   # noqa: BLE001 — triaged: only
+                # replica-death verdicts migrate, everything else re-raises
+                if (faults_mod.classify_replica(exc) != "replica_death"
+                        or self.migrations
+                        >= self.fleet.config.max_failovers):
+                    raise
+                self.fleet._mark_dead(rid, repr(exc))
+                tried.append(rid)
+                self.migrations += 1
+                flightrec.note("fleet_session_migrate",
+                               session=self.session_hash, from_replica=rid,
+                               attempt=self.migrations)

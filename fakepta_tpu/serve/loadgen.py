@@ -81,14 +81,35 @@ def run_loadgen(spec: Optional[ArraySpec] = None, *, mesh=None,
                 seed: int = 0, baseline: bool = False, verify: int = 3,
                 config: Optional[ServeConfig] = None,
                 compile_cache_dir: Optional[str] = None,
-                report_path=None, lnlike=None) -> dict:
+                report_path=None, lnlike=None, fleet=None,
+                fleet_transport: str = "process", n_specs: int = 6,
+                kill_one_at: Optional[float] = None) -> dict:
     """Generate load, serve it, return one benchmark row (see module doc).
 
     ``rate_hz`` paces submissions open-loop (None = submit as fast as
     admission allows — the max-coalescing regime); ``verify`` solo-checks
     that many served responses bit-for-bit; ``baseline=True`` adds the
     serial figures and the ``serve_speedup_x`` ratio.
+
+    ``fleet`` switches to the **multi-replica mode** (docs/SERVING.md
+    "Fleet"): an int spawns that many replicas (``fleet_transport`` picks
+    subprocess sockets or in-process pools), a prebuilt
+    :class:`~fakepta_tpu.serve.fleet.ServeFleet` is driven as-is. The
+    traffic covers ``n_specs`` distinct specs (the spec-space working set
+    the ring shards), the baseline becomes ONE ServePool serving the same
+    request list (``fleet_speedup_x``), and ``kill_one_at`` kills a
+    replica after that fraction of submissions — the failover A/B: the
+    row records lost requests (must be 0) and every failed-over response
+    stays bit-verified against its solo run.
     """
+    if fleet is not None:
+        return run_fleet_loadgen(
+            spec=spec, fleet=fleet, transport=fleet_transport,
+            n_requests=n_requests, sizes=sizes, kind=kind, seed=seed,
+            baseline=baseline, verify=verify, n_specs=n_specs,
+            kill_one_at=kill_one_at, config=config,
+            compile_cache_dir=compile_cache_dir, report_path=report_path,
+            mesh=mesh)
     spec = spec or ArraySpec()
     pool = ServePool(mesh=mesh, config=config,
                      compile_cache_dir=compile_cache_dir)
@@ -179,4 +200,240 @@ def run_loadgen(spec: Optional[ArraySpec] = None, *, mesh=None,
             row["serve_speedup_x"] = round(
                 row["serve_qps_per_chip"]
                 / (ser["qps"] / n_dev), 2)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# multi-replica (fleet) mode — docs/SERVING.md "Fleet"
+# ---------------------------------------------------------------------------
+
+def make_fleet_requests(specs: Sequence[ArraySpec], n_requests: int,
+                        sizes: Sequence[int], kind: str = "sim",
+                        seed: int = 0):
+    """The fleet's reproducible request list: sizes from the palette,
+    specs CYCLED in order — the LRU-adversarial access pattern, so a
+    single pool whose ``max_specs`` is below the working set misses on
+    (nearly) every request while the sharded fleet stays hot."""
+    rng = np.random.default_rng(seed)
+    ns = rng.choice(np.asarray(sizes, dtype=int), size=n_requests)
+    reqs = []
+    for i, n in enumerate(ns):
+        spec = specs[i % len(specs)]
+        req_seed = 1000 + i
+        if kind == "sim":
+            reqs.append(SimRequest(spec=spec, n=int(n), seed=req_seed))
+        elif kind == "os":
+            reqs.append(OSRequest(spec=spec, n=int(n), seed=req_seed))
+        else:
+            raise ValueError(f"fleet loadgen serves sim/os requests, "
+                             f"not {kind!r}")
+    return reqs
+
+
+def _build_fleet(n_replicas: int, transport: str, spec: ArraySpec,
+                 config, compile_cache_dir, mesh):
+    """N replicas behind the router (subprocess sockets, spawned
+    concurrently so startup is one cold-start wall, or in-process pools)."""
+    import threading
+
+    from .fleet import FleetConfig, LocalReplica, ServeFleet, SocketReplica
+
+    if transport == "inproc":
+        import jax
+        from ..parallel.mesh import make_mesh
+
+        replicas = [LocalReplica(
+            f"r{i}", mesh=mesh or make_mesh(jax.devices()[:1]),
+            config=config, compile_cache_dir=compile_cache_dir, index=i)
+            for i in range(n_replicas)]
+        return ServeFleet(replicas, FleetConfig())
+    if transport != "process":
+        raise ValueError(f"unknown fleet transport {transport!r}")
+    buckets = tuple(config.buckets) if config is not None else None
+    out: list = [None] * n_replicas
+    errs: list = []
+
+    def spawn(i):
+        try:
+            out[i] = SocketReplica(f"r{i}", spec_defaults=spec,
+                                   compile_cache_dir=compile_cache_dir,
+                                   buckets=buckets, index=i)
+        except Exception as exc:   # noqa: BLE001 — re-raised below
+            errs.append(exc)
+
+    threads = [threading.Thread(target=spawn, args=(i,))
+               for i in range(n_replicas)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errs or any(r is None for r in out):
+        for r in out:
+            if r is not None:
+                r.close()
+        raise RuntimeError(f"fleet startup failed: {errs!r}")
+    return ServeFleet(out, FleetConfig())
+
+
+def _submit_politely(fleet, req, futs):
+    """Admission with the backpressure contract: honor aggregated
+    Retry-After hints instead of hammering."""
+    while True:
+        try:
+            futs.append(fleet.submit(req))
+            return
+        except ServeBusy as busy:
+            time.sleep(max(getattr(busy, "retry_after_s", 0.0), 0.002))
+
+
+def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
+                      transport: str = "process", n_requests: int = 96,
+                      sizes: Sequence[int] = (1, 2, 4), kind: str = "sim",
+                      seed: int = 0, baseline: bool = False,
+                      verify: int = 3, n_specs: int = 6,
+                      kill_one_at: Optional[float] = None, config=None,
+                      compile_cache_dir: Optional[str] = None,
+                      report_path=None, mesh=None) -> dict:
+    """Drive a replica fleet with a sharded-spec workload; one row.
+
+    The traffic cycles ``n_specs`` distinct specs (same shapes, distinct
+    ``data_seed`` — one persistent-compile-cache entry serves them all,
+    so every replica cold-start is a cache load). The measured comparison
+    (``baseline=True``) is the SAME request list through one
+    ``ServePool``: on a single chip the fleet's win is aggregate warm
+    capacity (N x ``max_specs`` resident specs vs one pool thrashing its
+    LRU); on multi-chip hosts the N dispatchers also run in parallel.
+    ``kill_one_at`` kills the first spec's owner replica mid-load — the
+    row then records ``fleet_lost_requests`` (0 is the acceptance) and
+    every failed-over response is bit-verified like any other.
+    """
+    import dataclasses as dc
+
+    base = spec or ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4, gwb_ncomp=4)
+    specs = [dc.replace(base, data_seed=100 + i) for i in range(n_specs)]
+    reqs = make_fleet_requests(specs, n_requests, sizes, kind=kind,
+                               seed=seed)
+    if config is None:
+        from ..tune import defaults as tune_defaults
+        config = ServeConfig(buckets=tune_defaults.DEFAULT_FLEET_BUCKETS)
+    flt = fleet if not isinstance(fleet, int) else _build_fleet(
+        fleet, transport, base, config, compile_cache_dir, mesh)
+    own_fleet = isinstance(fleet, int)
+    kill_rid = None
+    warm_buckets = sorted({int(b) for b in config.buckets})
+    try:
+        # warmup: each spec's owner serves one request per ladder bucket,
+        # so the measured window is steady-state (mirrors the solo mode)
+        for s in specs:
+            for b in warm_buckets:
+                flt.serve(dc.replace(reqs[0], spec=s, n=b, seed=0),
+                          timeout=600.0)
+        flt.reset_stats()
+
+        if kill_one_at is not None:
+            kill_rid = flt.ring.owner(specs[0].spec_hash())
+        kill_at = (int(kill_one_at * len(reqs))
+                   if kill_one_at is not None else None)
+        futs: list = []
+        for i, r in enumerate(reqs):
+            if kill_at is not None and i == kill_at:
+                flt._mark_dead(kill_rid, "loadgen chaos kill")
+                flt.replicas[kill_rid].kill()
+            _submit_politely(flt, r, futs)
+        from ..obs import flightrec
+        results, lost = [], 0
+        for f in futs:
+            try:
+                results.append(f.result(timeout=600.0))
+            except Exception as exc:   # noqa: BLE001 — recorded + counted:
+                # a lost accepted request is THE failover acceptance
+                # failure, surfaced in the row (fleet_lost_requests != 0)
+                flightrec.note("fleet_request_lost", error=repr(exc)[:200])
+                results.append(None)
+                lost += 1
+        row = dict(flt.slo_summary())
+        row["fleet_kind"] = kind
+        row["fleet_transport"] = ("inproc" if not own_fleet
+                                  else transport)
+        row["fleet_lost_requests"] = lost
+        if kill_at is not None:
+            row["fleet_killed_replica"] = kill_rid
+
+        if verify:
+            # the RNG-lane contract on fleet traffic: sampled responses
+            # PLUS every failed-over response, bit-compared against the
+            # same request served alone at the same bucket shape
+            rng = np.random.default_rng(seed + 1)
+            done = [i for i, r in enumerate(results) if r is not None]
+            picks = set(rng.choice(done, size=min(verify, len(done)),
+                                   replace=False).tolist())
+            picks |= {i for i in done if results[i].failovers > 0}
+            sims: dict = {}
+            import jax
+            from ..parallel.mesh import make_mesh
+
+            solo_mesh = mesh or make_mesh(jax.devices()[:1])
+            for i in sorted(picks):
+                r, res = reqs[i], results[i]
+                sh = r.spec.spec_hash()
+                if sh not in sims:
+                    sims[sh] = r.spec.build(
+                        mesh=solo_mesh,
+                        compile_cache_dir=compile_cache_dir)
+                alone = sims[sh].run(res.bucket, chunk=res.bucket,
+                                     lanes=[(r.seed, r.n)],
+                                     pipeline_depth=0, **r.run_kwargs())
+                if not (np.array_equal(alone["curves"][:r.n], res.curves)
+                        and np.array_equal(alone["autos"][:r.n],
+                                           res.autos)):
+                    raise AssertionError(
+                        f"fleet response for request {i} (replica "
+                        f"{res.replica}, failovers {res.failovers}) "
+                        f"differs from the same request served alone — "
+                        f"the RNG-lane contract is broken")
+            row["fleet_verified"] = len(picks)
+            row["fleet_verified_failover"] = sum(
+                1 for i in picks if results[i].failovers > 0)
+        if report_path is not None:
+            flt.report().save(report_path)
+    finally:
+        if own_fleet:
+            flt.close()
+
+    if baseline:
+        # ONE pool, the SAME traffic: its LRU warm pool is the only spec
+        # residency, so the working set thrashes it (docs/SERVING.md
+        # "Fleet" has the full accounting of what this A/B measures)
+        import jax
+        from ..parallel.mesh import make_mesh
+
+        solo = ServePool(mesh=mesh or make_mesh(jax.devices()[:1]),
+                         config=config,
+                         compile_cache_dir=compile_cache_dir)
+        try:
+            for s in specs:
+                for b in warm_buckets:
+                    solo.submit(dc.replace(reqs[0], spec=s, n=b,
+                                           seed=0)).result(timeout=600.0)
+            solo.reset_stats()
+            sfuts: list = []
+            for r in reqs:
+                while True:
+                    try:
+                        sfuts.append(solo.submit(r))
+                        break
+                    except ServeBusy as busy:
+                        time.sleep(max(
+                            getattr(busy, "retry_after_s", 0.0), 0.002))
+            for f in sfuts:
+                f.result(timeout=600.0)
+            ssum = solo.slo_summary()
+        finally:
+            solo.close()
+        row["fleet_solo_qps"] = ssum.get("serve_qps_per_chip", 0.0) \
+            * solo.n_devices
+        row["fleet_solo_p50_ms"] = ssum.get("serve_p50_ms", 0.0)
+        if row["fleet_solo_qps"] > 0 and row.get("fleet_qps"):
+            row["fleet_speedup_x"] = round(
+                row["fleet_qps"] / row["fleet_solo_qps"], 2)
     return row

@@ -105,6 +105,10 @@ class ServeResult:
     cohort_requests: int = 1         # how many requests rode the dispatch
     bucket: int = 0                  # padded dispatch shape
     pad_waste_frac: float = 0.0      # 1 - cohort realizations / bucket
+    # fleet routing facts (serve/fleet.py): which replica served it, and
+    # how many mid-flight failovers the request survived (0 = first try)
+    replica: str = ""
+    failovers: int = 0
 
 
 class _Pending:

@@ -40,6 +40,13 @@ DEFAULT_BUCKETS = (16, 32, 64, 128, 256, 512, 1024)
 #: cohort sizes)
 BUCKET_RATIO = 2
 
+#: default bucket ladder for the FLEET load benchmark (docs/SERVING.md
+#: "Fleet"): deliberately short — the fleet figure measures routing +
+#: aggregate warm capacity across replicas, so the warmup bill is one
+#: executable per (spec, bucket) and small-request cohorts cap early
+#: instead of exercising ladder breadth (the solo loadgen covers that)
+DEFAULT_FLEET_BUCKETS = (16, 32)
+
 # --- tuner constants (fakepta_tpu.tune) ------------------------------------
 
 #: store schema tag + version; entries written by a different version are

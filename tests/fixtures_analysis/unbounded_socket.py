@@ -1,0 +1,42 @@
+"""Seeded true positives + clean near-misses for unbounded-socket-io."""
+import socket
+
+
+def dial(host):
+    return socket.create_connection((host, 80))
+
+
+def serve_once(listener):
+    conn, _addr = listener.accept()
+    return conn.recv(4096)
+
+
+class Handler:
+    def handle(self, sock):
+        sockfile = sock.makefile("rb")
+        return self.rfile.readline(65536), sockfile
+
+
+# -- clean near-misses ------------------------------------------------------
+def dial_bounded(host):
+    return socket.create_connection((host, 80), timeout=5.0)
+
+
+def serve_bounded(listener, idle_s):
+    listener.settimeout(idle_s)
+    conn, _addr = listener.accept()
+    return conn.recv(4096)
+
+
+class BoundedHandler:
+    def setup(self, sock, idle_s):
+        sock.settimeout(idle_s)
+
+    def handle(self, sock):
+        sockfile = sock.makefile("rb")
+        return self.rfile.readline(65536), sockfile
+
+
+def plain_file(fh):
+    # regular-file readline is not socket I/O; never flagged
+    return fh.readline()

@@ -1,0 +1,512 @@
+"""The serve fleet (ISSUE 12): spec-hash router, replicas, failover.
+
+Lean by construction, like test_serve: the in-process fleet fixture is
+module-scoped and serves every routed/failed-over case (each (spec,
+bucket) executable compiles once and is reused by the solo-reference
+assertions through the same pool entries); the ring tests are pure host
+math; the socket lanes are subprocess-backed and slow-marked except one
+2-replica smoke.
+"""
+
+import dataclasses
+import json
+import socket as socket_mod
+
+import numpy as np
+import pytest
+
+from fakepta_tpu import faults
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.serve import (ArraySpec, FleetConfig, LocalReplica,
+                               SampleSessionSpec, ServeBusy, ServeConfig,
+                               ServeFleet, ServeTimeout, SimRequest)
+from fakepta_tpu.serve.router import HashRing
+
+SPEC0 = ArraySpec(npsr=4, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                  data_seed=100)
+SPEC1 = dataclasses.replace(SPEC0, data_seed=101)
+
+
+# ---------------------------------------------------------------------------
+# the router (pure host math)
+# ---------------------------------------------------------------------------
+
+def _hashes(n):
+    return [f"{i:06x}spec" for i in range(n)]
+
+
+def test_ring_owner_stable_and_balanced():
+    """Two independently built rings agree on every owner (no process
+    salt), and 64 vnodes keep per-replica load near 1/N."""
+    ids = ["r0", "r1", "r2"]
+    a, b = HashRing(ids), HashRing(ids)
+    hs = _hashes(3000)
+    assert [a.owner(h) for h in hs] == [b.owner(h) for h in hs]
+    shard = a.shard(hs)
+    for rid in ids:
+        assert 0.15 < len(shard[rid]) / len(hs) < 0.55
+
+
+def test_ring_join_leave_remaps_about_one_nth():
+    """The consistent-hash contract: a leave moves ONLY the departed
+    replica's specs, a join moves ~1/N of everyone's."""
+    ids = ["r0", "r1", "r2", "r3"]
+    ring = HashRing(ids)
+    hs = _hashes(3000)
+    before = {h: ring.owner(h) for h in hs}
+    ring.remove("r2")
+    after = {h: ring.owner(h) for h in hs}
+    moved = {h for h in hs if before[h] != after[h]}
+    assert moved == {h for h in hs if before[h] == "r2"}
+    ring.add("r2")
+    assert {h: ring.owner(h) for h in hs} == before   # rejoin restores
+    ring.add("r4")
+    moved5 = sum(1 for h in hs if ring.owner(h) != before[h])
+    assert 0.10 < moved5 / len(hs) < 0.35             # ~1/5 remap
+
+
+def test_ring_preference_and_membership_errors():
+    ring = HashRing(["r0", "r1", "r2"])
+    h = SPEC0.spec_hash()
+    pref = ring.preference(h)
+    assert pref[0] == ring.owner(h)
+    assert sorted(pref) == ["r0", "r1", "r2"]
+    # the failover contract: with the owner gone, traffic converges on
+    # what was the ring's next choice
+    ring.remove(pref[0])
+    assert ring.owner(h) == pref[1]
+    with pytest.raises(ValueError, match="already on the ring"):
+        ring.add(pref[1])
+    with pytest.raises(ValueError, match="not on the ring"):
+        ring.remove("nope")
+
+
+# ---------------------------------------------------------------------------
+# the in-process fleet (one module fixture, scripted phases)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def fleet():
+    """2 local replicas, tiny specs, every served case the module asserts
+    on; the mid-flight failover is scripted via the fleet.replica /
+    serve.dispatch chaos sites so it is deterministic."""
+    import jax
+
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=0.01)
+    replicas = [LocalReplica(f"r{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    out = {"fleet": flt}
+    # phase 1: one request per spec — routed to each spec's ring owner
+    out["A"] = flt.serve(SimRequest(spec=SPEC0, n=5, seed=11), timeout=300)
+    out["B"] = flt.serve(SimRequest(spec=SPEC1, n=3, seed=22), timeout=300)
+    # phase 2: repeat A — affinity: same replica, warm executable
+    out["A2"] = flt.serve(SimRequest(spec=SPEC0, n=5, seed=11), timeout=300)
+    yield out
+    flt.close()
+
+
+def test_fleet_routes_by_spec_hash_with_affinity(fleet):
+    flt = fleet["fleet"]
+    owner0 = flt.ring.owner(SPEC0.spec_hash())
+    owner1 = flt.ring.owner(SPEC1.spec_hash())
+    assert fleet["A"].replica == owner0
+    assert fleet["B"].replica == owner1
+    assert fleet["A2"].replica == owner0
+    assert flt.slo_summary()["fleet_warm_hit_rate"] == 1.0
+
+
+def test_fleet_response_bit_identical_to_solo_run(fleet):
+    """The RNG-lane contract holds through the router: a routed response
+    is bit-identical to the same request served alone at the same bucket
+    on the owning replica's own simulator."""
+    flt = fleet["fleet"]
+    owner0 = flt.ring.owner(SPEC0.spec_hash())
+    entry = flt.replicas[owner0].pool._pool.get(SPEC0.spec_hash(), SPEC0)
+    alone = entry.sim.run(8, chunk=8, lanes=[(11, 5)], pipeline_depth=0)
+    assert np.array_equal(fleet["A"].curves, alone["curves"][:5])
+    assert np.array_equal(fleet["A"].autos, alone["autos"][:5])
+    assert np.array_equal(fleet["A2"].curves, fleet["A"].curves)
+
+
+def test_midflight_failover_is_bit_identical(fleet):
+    """Kill the owner's dispatcher mid-flight (serve.dispatch kill): the
+    router re-dispatches the in-flight request to the ring sibling, whose
+    response is bit-identical — and the dead replica stays dead."""
+    flt = fleet["fleet"]
+    owner0 = flt.ring.owner(SPEC0.spec_hash())
+    sibling = flt.ring.preference(SPEC0.spec_hash())[1]
+    plan = faults.FaultPlan(
+        [faults.FaultSpec("serve.dispatch", "kill", at=(0,))])
+    with faults.inject(plan):
+        res = flt.serve(SimRequest(spec=SPEC0, n=5, seed=11), timeout=300)
+    assert res.replica == sibling
+    assert res.failovers == 1
+    assert not flt.replicas[owner0].alive
+    assert np.array_equal(res.curves, fleet["A"].curves)
+    assert np.array_equal(res.autos, fleet["A"].autos)
+    slo = flt.slo_summary()
+    assert slo["fleet_failovers"] >= 1
+    assert slo["fleet_replica_deaths"] >= 1
+    # spec1 still routes fine on the surviving replica
+    again = flt.serve(SimRequest(spec=SPEC1, n=3, seed=22), timeout=300)
+    assert np.array_equal(again.curves, fleet["B"].curves)
+
+
+def test_fleet_report_and_pid_lane_merge(fleet):
+    """The fleet rollup is an obs artifact and per-replica reports merge
+    into one Chrome trace with a pid lane per replica."""
+    from fakepta_tpu.obs.trace import build_trace, validate_trace
+
+    flt = fleet["fleet"]
+    rep = flt.report()
+    assert rep.meta["kind"] == "serve_fleet"
+    summ = rep.summary()
+    assert summ["fleet_requests"] >= 4
+    assert summ["fleet_steady_compiles"] == 0 and summ["fleet_retraces"] == 0
+    reports = flt.replica_reports()
+    assert reports, "no replica reports"
+    trace = build_trace(reports)
+    validate_trace(trace)
+    pids = {e["pid"] for e in trace["traceEvents"]}
+    assert len(pids) == len(reports)
+
+
+def test_fleet_metric_directions_gate_and_compare():
+    from fakepta_tpu.obs.gate import gate_row
+    from fakepta_tpu.obs.report import metric_exempt, metric_higher_is_better
+
+    assert metric_higher_is_better("fleet_qps_per_chip")
+    assert metric_higher_is_better("fleet_speedup_x")
+    assert metric_higher_is_better("fleet_warm_hit_rate")
+    for k in ("fleet_p50_ms", "fleet_p99_ms", "fleet_failovers",
+              "fleet_lost_requests", "fleet_steady_compiles"):
+        assert not metric_higher_is_better(k), k
+        assert not metric_exempt(k), k
+    assert metric_exempt("fleet_replicas")
+    assert metric_exempt("fleet_transport")
+    hist = [{"platform": "cpu", "fleet_qps_per_chip": 100.0 * j,
+             "fleet_p99_ms": 30.0} for j in (0.98, 1.02)]
+    head = {"platform": "cpu", "fleet_qps_per_chip": 40.0,
+            "fleet_p99_ms": 120.0}
+    verdicts = {r.metric: r.verdict for r in gate_row(head, hist)}
+    assert verdicts["fleet_qps_per_chip"] == "regression"
+    assert verdicts["fleet_p99_ms"] == "regression"
+
+
+def test_fleet_backpressure_aggregates_hints_without_compiling():
+    """Saturate every replica's router-side in-flight bound with requests
+    that never dispatch (long window + deadlines): the fleet 429 carries
+    an aggregated Retry-After hint, spillover tries the sibling first,
+    and nothing ever compiles."""
+    import jax
+
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=30.0)
+    replicas = [LocalReplica(f"b{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig(max_inflight_per_replica=1))
+    try:
+        futs = [flt.submit(SimRequest(spec=SPEC0, n=2, seed=s,
+                                      deadline_s=0.05))
+                for s in (1, 2)]     # owner, then spillover to sibling
+        with pytest.raises(ServeBusy) as exc_info:
+            flt.submit(SimRequest(spec=SPEC0, n=2, seed=3))
+        assert exc_info.value.retry_after_s >= 0.0
+        slo = flt.slo_summary()
+        assert slo["fleet_rejected"] == 1
+        assert slo["fleet_spillovers"] >= 1
+        for f in futs:
+            with pytest.raises(ServeTimeout):
+                f.result(timeout=60)
+        # a request no ladder can hold fails sync, like the pool's own
+        with pytest.raises(ValueError, match="bucket ladder"):
+            flt.submit(SimRequest(spec=SPEC0, n=64, seed=4))
+    finally:
+        flt.close()
+
+
+def test_request_json_roundtrip_and_busy_hint_crosses_wire():
+    """The client/server protocol halves agree: request_to_json ->
+    request_from_json reproduces the request, and a ServeBusy error line
+    carries the Retry-After hint the router aggregates."""
+    from fakepta_tpu.serve import InferRequest, OSRequest, curn_grid_spec
+    from fakepta_tpu.serve.cli import (error_json, request_from_json,
+                                       request_to_json)
+
+    r = OSRequest(spec=SPEC0, n=4, seed=9, deadline_s=0.25, orf="dipole",
+                  null=True)
+    d = request_to_json(r, 7)
+    assert d["id"] == 7 and d["deadline_ms"] == 250.0
+    back = request_from_json(json.loads(json.dumps(d)), None)
+    assert back == r
+    with pytest.raises(ValueError, match="no JSON form"):
+        request_to_json(InferRequest(spec=SPEC0, n=2,
+                                     lnlike=curn_grid_spec(k=2)), 1)
+    err = error_json(3, ServeBusy("full", retry_after_s=0.125))
+    assert err["code"] == "busy" and err["retry_after_s"] == 0.125
+
+
+# ---------------------------------------------------------------------------
+# shared compile cache: a sibling's cold start is a load, not a compile
+# ---------------------------------------------------------------------------
+
+def test_sibling_replica_cold_start_hits_shared_cache(tmp_path):
+    """ISSUE 12 satellite (extends the PR 9 cache-file assertion): after
+    replica A prewarms a spec, a FRESH sibling pool serving the same spec
+    adds NOTHING to the shared persistent compile cache — its cold start
+    is a cache load — and serves bit-identically."""
+    import jax
+
+    from fakepta_tpu.serve import WarmPool
+
+    cache = tmp_path / "fleet_cache"
+    mesh = make_mesh(jax.devices()[:1])
+    try:
+        wp_a = WarmPool(mesh, compile_cache_dir=str(cache))
+        entry_a = wp_a.get(SPEC0.spec_hash(), SPEC0)
+        wp_a.prewarm(entry_a, (8,))
+        assert list(cache.glob("*")), \
+            "replica A's prewarm wrote nothing to the cache"
+        out_a = entry_a.sim.run(8, chunk=8, lanes=[(7, 4)],
+                                pipeline_depth=0)
+        # snapshot AFTER A's first real dispatch: run() adds its own
+        # finisher executables beyond the prewarmed step program
+        files_a = sorted(f.name for f in cache.glob("*"))
+
+        # the sibling: same spec, same cache, fresh simulator + jit caches
+        wp_b = WarmPool(mesh, compile_cache_dir=str(cache))
+        entry_b = wp_b.get(SPEC0.spec_hash(), SPEC0)
+        wp_b.prewarm(entry_b, (8,))
+        out_b = entry_b.sim.run(8, chunk=8, lanes=[(7, 4)],
+                                pipeline_depth=0)
+        files_b = sorted(f.name for f in cache.glob("*"))
+        assert files_b == files_a, (
+            "the sibling's cold start compiled a NEW cache entry — "
+            "replica cold-start must be a cache load")
+        np.testing.assert_array_equal(out_a["curves"], out_b["curves"])
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# posterior-as-a-service: affinity, migration, streamed delivery
+# ---------------------------------------------------------------------------
+
+def test_sampling_session_migrates_bit_exactly(tmp_path):
+    """A replica kill mid-session (sample.segment kill at segment 2)
+    migrates the session to the ring sibling, which resumes from the
+    segment-boundary checkpoint: final chains BIT-IDENTICAL to an
+    uninterrupted run, streamed segments cover the whole run with
+    at-least-once delivery."""
+    import jax
+
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=0.01)
+    cache = tmp_path / "cache"
+    replicas = [LocalReplica(f"s{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, compile_cache_dir=str(cache),
+                             index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    sess = SampleSessionSpec(spec=SPEC0, n_steps=16, seed=3, segment=4,
+                             nbin=2, n_chains=4, warmup=4, thin=1,
+                             n_leapfrog=3)
+    try:
+        owner = flt.ring.owner(sess.session_hash())
+        # the uninterrupted reference, on the owner's own mesh
+        ref = flt.replicas[owner].sampling_run(sess).run(
+            sess.n_steps, seed=sess.seed, segment=sess.segment,
+            pipeline_depth=0)
+
+        streamed = {}
+        plan = faults.FaultPlan(
+            [faults.FaultSpec("sample.segment", "kill", at=(2,))])
+        session = flt.start_session(sess, tmp_path / "ck")
+        with faults.inject(plan):
+            out = session.run(
+                on_segment=lambda idx, arr: streamed.setdefault(
+                    idx, np.array(arr)))
+        assert out["session"]["migrations"] == 1
+        assert out["session"]["replica"] != owner
+        assert not flt.replicas[owner].alive
+        np.testing.assert_array_equal(out["theta"], ref["theta"])
+        # streamed delivery covered every post-warmup segment, each
+        # bit-identical to its slice of the uninterrupted chains
+        kept = np.concatenate([streamed[i] for i in sorted(streamed)])
+        np.testing.assert_array_equal(kept, ref["theta"])
+    finally:
+        flt.close()
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+# ---------------------------------------------------------------------------
+# socket transport (subprocess replicas)
+# ---------------------------------------------------------------------------
+
+def _socket_fleet(n, cache, buckets=(8,)):
+    import threading
+
+    from fakepta_tpu.serve import SocketReplica
+
+    out = [None] * n
+    errs = []
+
+    def spawn(i):
+        try:
+            out[i] = SocketReplica(f"p{i}", spec_defaults=SPEC0,
+                                   compile_cache_dir=str(cache),
+                                   buckets=buckets, index=i)
+        except Exception as exc:   # noqa: BLE001 — surfaced below
+            errs.append(exc)
+
+    ts = [threading.Thread(target=spawn, args=(i,)) for i in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert not errs and all(out), f"fleet startup failed: {errs!r}"
+    return ServeFleet(out, FleetConfig())
+
+
+def test_socket_fleet_two_replica_smoke(tmp_path):
+    """The lean tier-1 socket lane: 2 subprocess replicas over the shared
+    compile cache serve both specs bit-identically to a parent-side solo
+    run, with zero steady-state compiles (everything heavier is
+    slow-marked)."""
+    import jax
+
+    flt = _socket_fleet(2, tmp_path / "cache")
+    try:
+        a = flt.serve(SimRequest(spec=SPEC0, n=5, seed=11), timeout=300)
+        b = flt.serve(SimRequest(spec=SPEC1, n=3, seed=22), timeout=300)
+        a2 = flt.serve(SimRequest(spec=SPEC0, n=5, seed=11), timeout=300)
+        assert np.array_equal(a2.curves, a.curves)
+        # parent-side solo reference shares the cache (a load, and the
+        # SAME 1-device mesh/executable shape as the replicas)
+        sim = SPEC0.build(mesh=make_mesh(jax.devices()[:1]),
+                          compile_cache_dir=str(tmp_path / "cache"))
+        alone = sim.run(8, chunk=8, lanes=[(11, 5)], pipeline_depth=0)
+        assert np.array_equal(a.curves, alone["curves"][:5])
+        assert np.array_equal(a.autos, alone["autos"][:5])
+        assert b.curves.shape == (3, SPEC1.nbins)
+        slo = flt.slo_summary()
+        assert slo["fleet_steady_compiles"] == 0
+        assert slo["fleet_requests"] == 3
+    finally:
+        flt.close()
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+@pytest.mark.slow
+def test_socket_fleet_kill_failover_loses_nothing(tmp_path):
+    """3 subprocess replicas; SIGKILL one mid-stream: every accepted
+    request completes (failed over through the reader's EOF), responses
+    stay bit-identical to solo runs, and the fleet records the death."""
+    import jax
+
+    flt = _socket_fleet(3, tmp_path / "cache")
+    try:
+        # warm the owner of SPEC0 so the kill happens on warm traffic
+        flt.serve(SimRequest(spec=SPEC0, n=8, seed=0), timeout=300)
+        victim = flt.ring.owner(SPEC0.spec_hash())
+        futs = [flt.submit(SimRequest(spec=SPEC0, n=4, seed=100 + i))
+                for i in range(3)]
+        flt.replicas[victim].kill()      # SIGKILL mid-stream
+        futs += [flt.submit(SimRequest(spec=SPEC0, n=4, seed=103 + i))
+                 for i in range(3)]
+        results = [f.result(timeout=300) for f in futs]
+        assert all(r is not None for r in results)
+        slo = flt.slo_summary()
+        assert slo["fleet_replica_deaths"] >= 1
+        sim = SPEC0.build(mesh=make_mesh(jax.devices()[:1]),
+                          compile_cache_dir=str(tmp_path / "cache"))
+        for i, r in enumerate(results):
+            alone = sim.run(r.bucket, chunk=r.bucket,
+                            lanes=[(100 + i, 4)], pipeline_depth=0)
+            assert np.array_equal(r.curves, alone["curves"][:4]), (
+                f"request {i} (replica {r.replica}, failovers "
+                f"{r.failovers}) broke the RNG-lane contract")
+        # post-kill traffic routes around the corpse
+        again = flt.serve(SimRequest(spec=SPEC0, n=4, seed=7), timeout=300)
+        assert again.replica != victim
+    finally:
+        flt.close()
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+@pytest.mark.slow
+def test_socket_sample_session_streams_segments(tmp_path):
+    """The socket protocol's posterior-as-a-service kind: one `sample`
+    request streams per-segment lines then the summary line."""
+    import jax
+
+    from fakepta_tpu.serve import SocketReplica
+
+    r = SocketReplica("sm0", spec_defaults=SPEC0,
+                      compile_cache_dir=str(tmp_path / "cache"),
+                      buckets=(8,), index=0)
+    try:
+        with socket_mod.create_connection(("127.0.0.1", r.port),
+                                          timeout=300) as conn:
+            conn.settimeout(300)
+            req = {"id": 1, "kind": "sample", "steps": 8, "seed": 3,
+                   "segment": 4,
+                   "spec": dataclasses.asdict(SPEC0),
+                   "session": {"nbin": 2, "n_chains": 4, "warmup": 4,
+                               "n_leapfrog": 3},
+                   "checkpoint": str(tmp_path / "ck")}
+            conn.sendall((json.dumps(req) + "\n").encode())
+            rfile = conn.makefile("rb")
+            lines = []
+            while True:
+                raw = rfile.readline(8 * 1024 * 1024)
+                assert raw, "connection closed before the done line"
+                msg = json.loads(raw)
+                lines.append(msg)
+                if msg.get("done"):
+                    break
+        assert all(m["ok"] for m in lines)
+        segs = [m for m in lines if "seg" in m and not m.get("done")]
+        assert segs and all("theta" in m for m in segs)
+        done = lines[-1]
+        assert done["n_kept"] == sum(m["n"] for m in segs)
+        assert "rhat_max" in done["summary"]
+    finally:
+        r.close()
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()
+
+
+@pytest.mark.slow
+def test_fleet_loadgen_inproc_row(tmp_path):
+    """run_loadgen(fleet=...) end-to-end: the row schema, zero lost
+    requests under a scripted mid-load kill, failover responses verified
+    inside the generator (it raises on any bit mismatch)."""
+    import jax
+
+    from fakepta_tpu.serve import run_loadgen
+
+    row = run_loadgen(
+        spec=SPEC0, fleet=2, fleet_transport="inproc", n_requests=16,
+        sizes=(1, 2), n_specs=3, seed=0, verify=2, baseline=False,
+        kill_one_at=0.5,
+        config=ServeConfig(buckets=(8,), coalesce_window_s=0.005),
+        compile_cache_dir=str(tmp_path / "cache"))
+    try:
+        assert row["fleet_lost_requests"] == 0
+        assert row["fleet_requests"] == 16
+        assert row["fleet_replica_deaths"] == 1
+        assert row["fleet_steady_compiles"] == 0
+        assert row["fleet_verified"] >= 2
+        assert row["fleet_warm_hit_rate"] < 1.0   # the dead shard moved
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        from jax.experimental.compilation_cache import compilation_cache
+        compilation_cache.reset_cache()

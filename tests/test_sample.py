@@ -457,6 +457,7 @@ def test_timeline_has_segment_spans_only_and_warm_start_hits_cache():
 # the headline workload: CURN free-spectrum posterior
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow
 def test_free_spectrum_posterior_converges_and_recovers_truth():
     """The flagship acceptance (CPU-scale stand-in): R-hat <= 1.01 on every
     sampled dim, healthy ESS, and the per-bin log10_rho posterior covers
@@ -482,6 +483,7 @@ def test_free_spectrum_posterior_converges_and_recovers_truth():
     assert np.all(theta >= bounds[:, 0]) and np.all(theta <= bounds[:, 1])
 
 
+@pytest.mark.slow
 def test_cli_smoke_and_artifact_roundtrip(tmp_path):
     """`python -m fakepta_tpu.sample run` emits the summary line and an
     obs-diffable artifact that summarize/gate can read."""

@@ -126,6 +126,7 @@ def test_os_request_is_cohort_independent(served):
     np.testing.assert_allclose(got["p_value"], want_p)
 
 
+@pytest.mark.slow
 def test_mesh_shape_invariance_2x2x2(served):
     """The same request served by a 2x2x2-mesh pool reproduces the
     single-device response at the engine's mesh-invariance tolerance (the

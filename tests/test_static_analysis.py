@@ -72,6 +72,10 @@ CASES = [
     ("hardcoded_knob.py", LIB,
      {("hardcoded-dispatch-knob", 6), ("hardcoded-dispatch-knob", 7),
       ("hardcoded-dispatch-knob", 8), ("hardcoded-dispatch-knob", 9)}),
+    ("unbounded_socket.py", LIB,
+     {("unbounded-socket-io", 6), ("unbounded-socket-io", 10),
+      ("unbounded-socket-io", 11), ("unbounded-socket-io", 16),
+      ("unbounded-socket-io", 17)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -126,6 +130,9 @@ def test_dtype_policy_paths_exist():
     for rel in policy.DISPATCH_KNOB_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale DISPATCH_KNOB_MODULES entry: {rel}"
+    for rel in policy.SOCKET_IO_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale SOCKET_IO_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
