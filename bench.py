@@ -111,6 +111,24 @@ RunReport each ``sim.run()`` attaches):
   RNG-lane contract). ``fleet_steady_compiles`` must stay 0: all replicas
   share the persistent compile cache, so cold starts and failover shard
   absorption are cache loads, not compiles;
+- ``append_latency_ms`` / ``restage_ms`` / ``append_speedup_x`` /
+  ``stream_appends`` / ``stream_toas`` / ``stream_rebuckets`` /
+  ``stream_recompiles``: the streaming-ingestion lane
+  (``fakepta_tpu.stream``, docs/STREAMING.md; ``benchmarks/suite.py``
+  config 14 is the same recipe). A stream accumulates bulk history on its
+  frozen Fourier grids, then one observing epoch arrives:
+  ``append_latency_ms`` (lower-better) is the steady-state cost of the
+  additive rank-k Woodbury-moment append, ``restage_ms`` the full
+  recompute of the same store through the same kernels, and
+  ``append_speedup_x`` (higher-better) their ratio — the acceptance
+  figure, >= 5x at the flagship config (the append is O(new-epoch), the
+  restage O(history)). ``stream_recompiles`` MUST stay 0: appends within
+  the current (block bucket, epoch capacity) rungs reuse compiled
+  executables, and any retrace means the bucket ladder stopped covering
+  the traffic (``stream_appends``/``stream_toas``/``stream_rebuckets``
+  are exempt shape facts). The accelerator lane streams the flagship
+  100-psr x 15-yr array with ECORR epoch blocks; the CPU stand-in a
+  reduced one (``platform`` disambiguates);
 - ``faults_retries`` / ``faults_degradations`` / ``faults_rollbacks``: the
   measured run's recovery counters (``fakepta_tpu.faults``,
   docs/RELIABILITY.md) — transient dispatch/drain retries, degradation-
@@ -410,6 +428,30 @@ def main():
         row["fused_bytes_reduction_x"] = round(
             row["model_bytes_per_chunk"]
             / row["model_bytes_per_chunk_fused"], 2)
+
+    # the streaming lane (fakepta_tpu.stream, docs/STREAMING.md): a stream
+    # accumulates bulk history on its frozen grids, then one observing
+    # epoch arrives — the A/B is the additive rank-k append against a full
+    # restage of the same store on the same kernels (O(new-epoch) vs
+    # O(history)); append_speedup_x is the acceptance figure (>= 5x at
+    # the flagship config) and stream_recompiles the zero-expected ladder
+    # canary. Sizes mirror benchmarks/suite.py config 14.
+    from fakepta_tpu.stream.bench import run_append_ab
+    yr_s = 365.25 * 86400.0
+    if platform != "cpu":
+        stream_row = run_append_ab(npsr=100, ntoa=780, tspan_years=15.0,
+                                   n_red=30, n_dm=100, nbin=10,
+                                   history=780, epoch_width=8,
+                                   ecorr_dt=15.0 * yr_s / 64, seed=0)
+    else:
+        stream_row = run_append_ab(npsr=16, ntoa=128, tspan_years=15.0,
+                                   n_red=8, n_dm=8, nbin=8, history=1024,
+                                   epoch_width=8,
+                                   ecorr_dt=15.0 * yr_s / 50, seed=0)
+    for key in ("append_latency_ms", "restage_ms", "append_speedup_x",
+                "stream_appends", "stream_toas", "stream_rebuckets",
+                "stream_recompiles"):
+        row[key] = stream_row[key]
 
     # the fleet lane (fakepta_tpu.serve.fleet, docs/SERVING.md "Fleet"):
     # 3 subprocess replicas behind the spec-hash router vs ONE pool on

@@ -3,7 +3,9 @@
 8: the flagship with per-realization hyperparameter sampling, 9: the flagship
 with a per-realization sampled CW source, 10: the 256-pulsar scale-out,
 11: the flagship with per-realization white-noise sampling, 12: the chaos
-lane, 13: the multi-replica serve fleet A/B with mid-load replica kill).
+lane, 13: the multi-replica serve fleet A/B with mid-load replica kill,
+14: the streaming-ingestion A/B — single-epoch incremental append vs full
+restage, docs/STREAMING.md).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -481,6 +483,43 @@ def config13():
             "value": row.get("fleet_speedup_x", 0.0), "unit": "x", **row}
 
 
+def config14():
+    """Streaming lane (fakepta_tpu.stream, docs/STREAMING.md): the
+    incremental-append-vs-full-restage A/B. A stream accumulates bulk
+    history on its frozen grids, then one new observing epoch arrives:
+    ``append_speedup_x`` is the full-restage wall time over the additive
+    rank-k append's (same kernels, same store — pure O(new-epoch) vs
+    O(history) work; acceptance >= 5x at the flagship config), and
+    ``stream_recompiles`` must stay 0 (every append rides an
+    already-compiled (block bucket, epoch capacity) executable). The
+    accelerator lane streams the flagship 100-psr x 15-yr array with
+    ECORR epoch blocks; the CPU stand-in a reduced one (``platform``
+    disambiguates, as everywhere)."""
+    import jax
+
+    from fakepta_tpu.stream.bench import run_append_ab
+
+    yr_s = 365.25 * 86400.0
+    if jax.devices()[0].platform != "cpu":
+        row = run_append_ab(npsr=100, ntoa=780, tspan_years=15.0,
+                            n_red=30, n_dm=100, nbin=10, history=780,
+                            epoch_width=8, ecorr_dt=15.0 * yr_s / 64,
+                            mesh=None, seed=0)
+    else:
+        row = run_append_ab(npsr=16, ntoa=128, tspan_years=15.0,
+                            n_red=8, n_dm=8, nbin=8, history=1024,
+                            epoch_width=8, ecorr_dt=15.0 * yr_s / 50,
+                            mesh=None, seed=0)
+    if row["stream_recompiles"]:
+        raise RuntimeError("stream appends recompiled within their "
+                           "buckets — the ladder canary is broken, "
+                           "refusing to record a speedup through it")
+    return {"config": 14,
+            "metric": "single-epoch append speedup vs full restage "
+                      "(streaming ingestion, ECORR epoch blocks)",
+            "value": row["append_speedup_x"], "unit": "x", **row}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -681,7 +720,8 @@ def config5():
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
-                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13])
+                    default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
+                             14])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--nreal-scale", type=float, default=1.0,
@@ -708,7 +748,7 @@ def main():
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13}
+           11: config11, 12: config12, 13: config13, 14: config14}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
     # platform identity single-sourced through the tuner's fingerprint

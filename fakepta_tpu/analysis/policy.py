@@ -85,6 +85,21 @@ DTYPE_POLICY = {
     "fakepta_tpu/sample/run.py": "host-f64",
     "fakepta_tpu/sample/model.py": "host-f64",
     "fakepta_tpu/sample/cli.py": "host-f64",
+    # the serve protocol codec: JSON request lines stage their TOA blocks
+    # and theta grids to host f64 arrays (the same staging role the other
+    # subsystem CLIs play); the device work happens in the pool/stream
+    # layers under their own policies.
+    "fakepta_tpu/serve/cli.py": "host-f64",
+    # the streaming-ingestion subsystem: append-vs-restage is certified as
+    # an f64 oracle (docs/STREAMING.md), so the StreamState kernels, the
+    # rolling OS statistic, and the refresher's Laplace warm start all run
+    # under enable_x64 when the stream dtype is f64 (the default). The
+    # incremental-moment device math itself is dtype-polymorphic jnp
+    # (ops/woodbury.py append_parts under the default device-f32 policy).
+    "fakepta_tpu/stream/state.py": "host-f64",
+    "fakepta_tpu/stream/refresh.py": "host-f64",
+    "fakepta_tpu/stream/bench.py": "host-f64",
+    "fakepta_tpu/detect/streaming.py": "host-f64",
 }
 DTYPE_DEFAULT_LIBRARY = "device-f32"
 DTYPE_EXEMPT = "exempt"

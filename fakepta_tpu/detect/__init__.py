@@ -17,6 +17,8 @@ Layers (docs/DETECTION.md):
 - :class:`DetectionRun` — the host facade: one call runs a null-calibrated
   detection study and emits a schema-versioned summary artifact that
   ``python -m fakepta_tpu.obs compare`` can diff.
+- :class:`StreamingOS` (:mod:`streaming`) — the rolling per-append variant
+  over a stream's accumulated Woodbury moments (docs/STREAMING.md).
 - CLI: ``python -m fakepta_tpu.detect run ...``.
 """
 
@@ -24,8 +26,10 @@ from .operators import (DETECT_SCHEMA, OSOperator, OSSpec, as_spec,
                         assemble, build_operators, pair_weighting,
                         pulsar_noise_levels)
 from .run import DetectionRun
+from .streaming import StreamingOS
 
 __all__ = [
-    "DETECT_SCHEMA", "DetectionRun", "OSOperator", "OSSpec", "as_spec",
-    "assemble", "build_operators", "pair_weighting", "pulsar_noise_levels",
+    "DETECT_SCHEMA", "DetectionRun", "OSOperator", "OSSpec", "StreamingOS",
+    "as_spec", "assemble", "build_operators", "pair_weighting",
+    "pulsar_noise_levels",
 ]

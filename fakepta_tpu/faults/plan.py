@@ -29,7 +29,16 @@ site                      where it is checked
 ``serve.dispatch``        ServePool's dispatcher thread, per cohort
 ``sample.segment``        SamplingRun.run, before each segment dispatch
 ``fleet.replica``         ServeFleet's router, per dispatch to a replica
+``ingest.append``         StreamState.append, at the top of each TOA block
 ========================  ====================================================
+
+``ingest.append`` is checked BEFORE any state mutates, so a raising kind
+(``transient``/``fatal``) leaves the stream untouched and a retry of the
+same block is deterministic; the ``torn`` kind lets the block land and
+then corrupts its checkpoint file before simulated process death
+(:class:`KillFault`) — resume must detect the bad CRC and roll back to the
+last consistent :class:`~fakepta_tpu.stream.StreamState`
+(docs/STREAMING.md).
 
 Fault kinds: ``transient`` / ``fatal`` raise (:class:`TransientFault` /
 :class:`FatalFault`); ``degrade`` / ``precision`` raise the ladder triggers

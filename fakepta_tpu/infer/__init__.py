@@ -36,12 +36,15 @@ from .model import (BATCH_SPECTRUM, INFER_SCHEMA, ComponentSpec,
                     theta_grid)
 from .reconstruct import wiener_coefficients, wiener_reconstruct
 from .run import InferenceRun
+from .schema import (SPEC_SCHEMA, model_from_json, model_to_json,
+                     spec_from_json, spec_to_json)
 
 __all__ = [
-    "BATCH_SPECTRUM", "INFER_SCHEMA", "ComponentSpec", "CompiledLikelihood",
-    "FreeParam", "InferSpec", "InferenceRun", "LikelihoodSpec", "as_spec",
-    "assemble", "box_from_unconstrained", "box_log_prior",
-    "box_to_unconstrained", "box_unconstrained_log_prior",
+    "BATCH_SPECTRUM", "INFER_SCHEMA", "SPEC_SCHEMA", "ComponentSpec",
+    "CompiledLikelihood", "FreeParam", "InferSpec", "InferenceRun",
+    "LikelihoodSpec", "as_spec", "assemble", "box_from_unconstrained",
+    "box_log_prior", "box_to_unconstrained", "box_unconstrained_log_prior",
     "box_unconstrained_log_prior_grad", "build", "lanes_per_point",
+    "model_from_json", "model_to_json", "spec_from_json", "spec_to_json",
     "theta_grid", "wiener_coefficients", "wiener_reconstruct",
 ]

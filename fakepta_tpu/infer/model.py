@@ -339,6 +339,25 @@ class CompiledLikelihood:
         self.ncols = 2 * sum(c["nbin"] * c["bands"] for c in comps)
 
     # -- host helpers ------------------------------------------------------
+    def column_slices(self):
+        """Basis-column extent of every component, in declaration order.
+
+        Returns ``((target, start, stop), ...)`` — one entry per concatenated
+        block of :meth:`basis`/:meth:`phi` (a ``'sys'`` component emits one
+        entry per band). This is the public column map consumers use to
+        address a component's GP coefficients without re-deriving the layout:
+        the streaming detection statistic slices the ``'curn'`` columns of
+        the conditional-mean coefficient vector with it.
+        """
+        out = []
+        start = 0
+        for c in self._comps:
+            width = 2 * c["nbin"]
+            for _ in range(c["bands"]):
+                out.append((c["target"], start, start + width))
+                start += width
+        return tuple(out)
+
     def validate_theta(self, theta) -> np.ndarray:
         """Coerce a theta batch to a host (K, D) float array."""
         arr = np.asarray(theta, dtype=float)

@@ -63,7 +63,14 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # keeping) wins; tune_probe_s keeps the lower-is-
                     # better default (probe time is pure overhead) and
                     # the `tuned` flag itself is exempt (a run-shape fact)
-                    "tuned_speedup_x", "tuned_real_per_s_per_chip"}
+                    "tuned_speedup_x", "tuned_real_per_s_per_chip",
+                    # the streaming-ingestion lane (fakepta_tpu.stream,
+                    # docs/STREAMING.md): the incremental-append-vs-full-
+                    # restage A/B multiple is the lane's whole point —
+                    # append_latency_ms keeps the lower-is-better default,
+                    # and stream_recompiles keeps it too (any growth past
+                    # the zero history is the bucket ladder regressing)
+                    "append_speedup_x"}
 
 # suffix rules cover the detect lane's per-ORF metric names
 # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
@@ -127,7 +134,15 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # regression-bearing tune metrics are tuned_speedup_x,
                   # tuned_real_per_s_per_chip — higher-better above — and
                   # tune_probe_s, lower-better default)
-                  "tuned", "tune_probes"}
+                  "tuned", "tune_probes",
+                  # streaming-lane shape facts (fakepta_tpu.stream): how
+                  # many TOAs/appends the window ingested and how often the
+                  # bucket ladder legitimately stepped up are traffic
+                  # description (the regression-bearing stream metrics are
+                  # append_speedup_x — higher-better above — and
+                  # append_latency_ms / stream_recompiles, lower-better
+                  # defaults)
+                  "stream_appends", "stream_toas", "stream_rebuckets"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")

@@ -106,6 +106,78 @@ def res_parts(r, tmat, sigma2, mask, epoch_idx=None, ecorr_amp=None,
     return parts
 
 
+def pad_epoch_parts(parts, num_epochs: int):
+    """Zero-extend the per-epoch ECORR arrays (``a``/``v``/``s``) to a larger
+    epoch capacity.
+
+    Exact by construction: a zero epoch row has ``a_e = 0`` so its
+    Sherman-Morrison gain ``g = 1/(1+a) = 1`` multiplies zero segment sums,
+    and ``log1p(0) = 0`` adds nothing to the determinant — padded epochs are
+    algebraically inert, which is what lets the streaming path snap epoch
+    counts to a capacity rung without changing any likelihood value.
+    """
+    out = dict(parts)
+    for key in ("a", "v", "s"):
+        if key not in parts:
+            continue
+        have = parts[key].shape[0]
+        if num_epochs < have:
+            raise ValueError(f"epoch capacity cannot shrink: parts[{key!r}] "
+                             f"has {have} epochs, requested {num_epochs}")
+        pad = [(0, num_epochs - have)] + [(0, 0)] * (parts[key].ndim - 1)
+        out[key] = jnp.pad(parts[key], pad)
+    return out
+
+
+def append_parts(parts, tmat, sigma2, mask, r=None, epoch_idx=None,
+                 ecorr_amp=None, num_epochs: int = 0):
+    """Rank-k additive update of summed moment parts with a block of new TOAs.
+
+    Every entry of a :func:`fixed_parts`/:func:`res_parts` dict is a plain
+    sum over TOAs **on a frozen basis grid**, so appending a block is exactly
+    "compute the block's parts, add" — O(new-epoch) work instead of a full
+    restage. The ECORR arrays are per-epoch segment sums keyed by *global*
+    epoch ids, so they extend additively too: ``num_epochs`` names the new
+    (monotonically non-decreasing) epoch capacity, existing arrays are
+    zero-padded up to it (:func:`pad_epoch_parts` — exact), and the block's
+    segment sums land on top. The caller owns the frozen-grid contract: the
+    appended ``tmat`` (and ``r``) must be evaluated against the SAME
+    normalization the accumulated parts used, else the moments are sums of
+    different bases and nothing cancels (``fakepta_tpu.stream`` pins the
+    grid for exactly this reason).
+
+    Dispatches on the dict shape: a residual dict (``"d0" in parts``)
+    requires ``r``; a fixed dict forbids it. Returns a NEW dict (inputs
+    untouched) whose epoch arrays have capacity
+    ``max(num_epochs, existing)``. The f64 oracle in ``tests/test_stream.py``
+    proves append(A)+append(B) == restage(A∪B) to <= 1e-8 per pulsar,
+    ECORR blocks included.
+    """
+    is_res = "d0" in parts
+    if is_res and r is None:
+        raise ValueError("appending to a res_parts dict requires r")
+    if not is_res and r is not None:
+        raise ValueError("appending to a fixed_parts dict forbids r "
+                         "(did you mean the res_parts dict?)")
+    cap = num_epochs
+    for key in ("a", "s"):
+        if key in parts:
+            cap = max(cap, parts[key].shape[0])
+    if is_res:
+        block = res_parts(r, tmat, sigma2, mask, epoch_idx, ecorr_amp,
+                          num_epochs=num_epochs)
+    else:
+        block = fixed_parts(tmat, sigma2, mask, epoch_idx, ecorr_amp,
+                            num_epochs=num_epochs)
+    old = pad_epoch_parts(parts, cap) if cap else dict(parts)
+    new = pad_epoch_parts(block, cap) if cap else block
+    out = {k: old[k] + new[k] if k in new else old[k] for k in old}
+    for k in new:
+        if k not in out:      # first ECORR-bearing block of a stream
+            out[k] = new[k]
+    return out
+
+
 def finish_fixed(parts):
     """(M, lndetN, n_valid, corr) from summed fixed parts.
 

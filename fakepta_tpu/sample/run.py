@@ -212,7 +212,7 @@ class SamplingRun:
     """
 
     def __init__(self, batch, spec, residuals=None, truth=None, mesh=None,
-                 data_seed=0, compile_cache_dir=None):
+                 data_seed=0, compile_cache_dir=None, warm_from=None):
         from ..parallel.mesh import make_mesh
 
         pipeline_mod.configure_compile_cache(compile_cache_dir)
@@ -254,7 +254,18 @@ class SamplingRun:
                              f"{np.asarray(batch.t_own).shape}")
         self.residuals = residuals
         self._mom64 = self._host_moments(residuals)
-        self._fit_laplace()
+        # warm_from: a previous run's laplace_state() — the damped-Newton
+        # fit starts at the prior mode instead of zero (the streaming
+        # posterior-refresh path: data grew by one epoch, so the new mode
+        # is a few steps from the old one, not sixty)
+        v0 = None
+        if warm_from is not None:
+            v0 = np.asarray(warm_from["mode_v"], dtype=np.float64)
+            if v0.shape != (self.compiled.D,):
+                raise ValueError(
+                    f"warm_from mode_v has shape {v0.shape}; this model "
+                    f"has D={self.compiled.D}")
+        self._fit_laplace(v0=v0)
 
         psr_sh = NamedSharding(self.mesh, P(PSR_AXIS))
         self._mom_dev = tuple(
@@ -265,6 +276,7 @@ class SamplingRun:
         self.retraces = 0
         self.last_report = None
         self.last_result = None
+        self.last_z = None
 
     # ------------------------------------------------------------------
     # host-f64 staging (one-off; the sanctioned host-float64 layer)
@@ -363,18 +375,22 @@ class SamplingRun:
             return np.asarray(jax.grad(self._lnpost64)(
                 jnp.asarray(v, jnp.float64)))
 
-    def _fit_laplace(self, max_iter: int = 60):
+    def _fit_laplace(self, max_iter: int = 60, v0=None):
         """Damped-Newton mode fit + Laplace factor — the Hessian-lane warm
         start: chains initialize at ``mode + C z, z ~ N(0, I)`` and the HMC
         kernel runs in the C-whitened space (C C^T = (-H)^{-1}), so a
-        near-Gaussian posterior is near-isotropic for the integrator."""
+        near-Gaussian posterior is near-isotropic for the integrator.
+        ``v0`` starts the Newton iteration from a previous mode (the
+        streaming warm start) instead of the unconstrained origin."""
         d = self.compiled.D
         with _host_ctx():
             grad_fn = jax.grad(self._lnpost64)
             hess_fn = jax.hessian(self._lnpost64)
-            v = np.zeros(d)
+            v = np.zeros(d) if v0 is None else np.array(v0, dtype=float)
             f = float(self._lnpost64(v))
+            self.laplace_iters = 0
             for _ in range(max_iter):
+                self.laplace_iters += 1
                 g = np.asarray(grad_fn(v))
                 h = np.asarray(hess_fn(v))
                 a = -h
@@ -416,6 +432,13 @@ class SamplingRun:
         self.chol_cov = linv.T                 # C with C C^T = (-H)^{-1}
         self.mode_theta = np.asarray(
             self.compiled.theta_from_unit(1 / (1 + np.exp(-v))))
+
+    def laplace_state(self) -> dict:
+        """The Laplace fit as a plain dict — feed it to a NEW run's
+        ``warm_from=`` after the data changed (the streaming refresh path:
+        ``fakepta_tpu.stream.PosteriorRefresher``)."""
+        return {"mode_v": np.array(self.mode_v),
+                "chol_cov": np.array(self.chol_cov)}
 
     # ------------------------------------------------------------------
     # the chain program (one jitted segment; zero host syncs inside)
@@ -811,7 +834,8 @@ class SamplingRun:
 
     def run(self, n_steps: int, seed=0, segment=None, checkpoint=None,
             pipeline_depth=None, progress=None, eventlog=None,
-            recovery=None, tuned: bool = False, on_segment=None) -> dict:
+            recovery=None, tuned: bool = False, on_segment=None,
+            init_z=None) -> dict:
         """Run ``n_steps`` post-warmup MCMC steps (plus the spec's warmup).
 
         The chain loop dispatches one jitted SEGMENT program at a time —
@@ -843,6 +867,14 @@ class SamplingRun:
         the checkpoint append — at-least-once delivery across a
         kill/resume; the serve fleet's ``SamplingSession`` is the
         consumer, docs/SERVING.md).
+
+        ``init_z`` seeds the chains' whitened positions from a previous
+        posterior (a (K, T, D) array — the streaming refresh warm start)
+        instead of the standard-normal Laplace draw. Deliberately a
+        **z-only** snapshot: the cached likelihood parts are NOT carried
+        over (the data changed under a refresh), so ``_init_state``
+        recomputes them against the CURRENT moments. A checkpoint resume
+        always wins over ``init_z``.
         """
         t_run0 = obs.now()
         obs.subscribe_jax_monitoring()
@@ -890,6 +922,14 @@ class SamplingRun:
                 done_segments = resume["done"]
                 snapshot0 = resume["snapshot"]
                 out = list(resume["thinned"])
+        if snapshot0 is None and init_z is not None:
+            z0 = np.asarray(init_z, dtype=self._dtype)
+            if z0.shape != (k, t_count, d):
+                raise ValueError(f"init_z must have shape "
+                                 f"({k}, {t_count}, {d}); got {z0.shape}")
+            # z-only snapshot: _init_state sees the missing cached parts
+            # and refreshes them against the current data's moments
+            snapshot0 = dict(self._zero_accum_host(), z=z0)
         state = self._init_state(seed, refresh, snapshot0)
 
         depth = max(int(pipeline_depth), 0)
@@ -1102,6 +1142,10 @@ class SamplingRun:
         kept = [a for a in out if a is not None]
         theta = (np.concatenate(kept, axis=0) if kept
                  else np.zeros((0, k, d), dt))
+        #: final whitened chain positions — the z-only warm start the
+        #: streaming refresh hands the NEXT run (after remapping through
+        #: the new Laplace coordinates; stream/refresh.py)
+        self.last_z = np.asarray(state_h["z"])
         diag = diagnostics(state_h, k, t_count, total_steps)
         if diag["divergences"] > 0:
             obs.flightrec.note("chain_divergences",

@@ -18,6 +18,12 @@ lane), a shared persistent compile cache (replica cold-start = cache
 load), and posterior-as-a-service :class:`SamplingSession`\\ s that
 migrate between replicas at segment-boundary checkpoints.
 
+Streaming ingestion (docs/STREAMING.md): :class:`AppendRequest` /
+:class:`StreamRequest` feed named :class:`~fakepta_tpu.stream.StreamState`
+sessions through the pool's :class:`StreamManager` — O(new-block) appends
+with a rolling detection statistic, routed by the fleet with stream
+affinity (by stream name, no saturation spillover) to the owning replica.
+
 Embeddable surface::
 
     from fakepta_tpu.serve import ArraySpec, ServePool, SimRequest
@@ -40,15 +46,17 @@ from .loadgen import run_fleet_loadgen, run_loadgen
 from .pool import PoolEntry, WarmPool
 from .router import HashRing
 from .scheduler import ServeConfig, ServePool, ServeResult
-from .spec import (DEFAULT_BUCKETS, ArraySpec, InferRequest, OSRequest,
-                   ServeBusy, ServeClosed, ServeError, ServeTimeout,
-                   SimRequest, curn_grid_spec)
+from .spec import (DEFAULT_BUCKETS, AppendRequest, ArraySpec, InferRequest,
+                   OSRequest, ServeBusy, ServeClosed, ServeError,
+                   ServeTimeout, SimRequest, StreamRequest, curn_grid_spec)
+from .streams import StreamManager
 
 __all__ = [
-    "DEFAULT_BUCKETS", "ArraySpec", "FleetConfig", "HashRing",
-    "InferRequest", "LocalReplica", "OSRequest", "PoolEntry",
+    "DEFAULT_BUCKETS", "AppendRequest", "ArraySpec", "FleetConfig",
+    "HashRing", "InferRequest", "LocalReplica", "OSRequest", "PoolEntry",
     "ReplicaDead", "SampleSessionSpec", "SamplingSession", "ServeBusy",
     "ServeClosed", "ServeConfig", "ServeError", "ServeFleet", "ServePool",
     "ServeResult", "ServeTimeout", "SimRequest", "SocketReplica",
-    "WarmPool", "curn_grid_spec", "run_fleet_loadgen", "run_loadgen",
+    "StreamManager", "StreamRequest", "WarmPool", "curn_grid_spec",
+    "run_fleet_loadgen", "run_loadgen",
 ]

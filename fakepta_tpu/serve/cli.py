@@ -27,7 +27,26 @@ Request line schema (shared by stdin/socket/replica)::
      "spec": {"npsr": 20, ...} | "registered-name",   # optional: default spec
      "deadline_ms": 250,                               # optional
      "orf": "hd", "weighting": "noise", "null": false, # kind == "os"
-     "grid": {"k": 4, "nbin": 10}}                     # kind == "infer"
+     "grid": {"k": 4, "nbin": 10},                     # kind == "infer"
+     "lnlike": {"schema": "fakepta_tpu.infer-spec/1", ...}}  # infer, exact
+
+(``"lnlike"`` is a full :mod:`fakepta_tpu.infer.schema` InferSpec document
+— the exact likelihood request; ``"grid"`` remains the shorthand.)
+
+Streaming ingestion kinds (docs/STREAMING.md; requests are replica-affine
+— a fleet routes them by stream name, never spilling to a sibling)::
+
+    {"id": 2, "kind": "append", "stream": "ng20", "toas": [[...]],
+     "residuals": [[...]],                             # (P, B) seconds
+     "sigma2": [[...]], "freqs": [[...]],              # optional
+     "ecorr_amp": [[...]], "counts": [...],            # optional
+     "spec": {...}, "ecorr_dt": 2592000.0,             # open-time options
+     "watch": "hd", "checkpoint": "/shared/stream"}    # (first touch only)
+    {"id": 3, "kind": "stream", "stream": "ng20"}      # rolling stats
+
+Both answer ``{"id", "ok": true, "stream": {...payload...}}`` — the
+append payload carries latency/bucket/recompile counters plus the rolling
+detection statistic when the stream was opened with ``watch``.
 
 plus two fleet-protocol kinds: ``{"id", "kind": "stats"}`` answers with
 the pool's live SLO summary, and ``{"id", "kind": "sample", "steps": 64,
@@ -66,8 +85,9 @@ import numpy as np
 
 from ..obs import flightrec
 from .scheduler import ServeConfig, ServePool
-from .spec import (ArraySpec, InferRequest, OSRequest, ServeBusy,
-                   ServeTimeout, SimRequest, curn_grid_spec)
+from .spec import (AppendRequest, ArraySpec, InferRequest, OSRequest,
+                   ServeBusy, ServeTimeout, SimRequest, StreamRequest,
+                   curn_grid_spec)
 
 #: longest request line a server will read before declaring the frame
 #: malformed and closing the connection (a hostile client could otherwise
@@ -103,6 +123,27 @@ def request_from_json(d: dict, default_spec: ArraySpec):
     """One request line -> request object (see module docstring schema)."""
     kind = d.get("kind", "sim")
     spec = d.get("spec")
+    if kind in ("append", "stream"):
+        # stream-affine kinds: no n/seed, spec only as an open-time
+        # template (never defaulted — an already-open stream needs none)
+        stream_spec = ArraySpec(**spec) if isinstance(spec, dict) else None
+        deadline = d.get("deadline_ms")
+        deadline_s = (float(deadline) / 1e3 if deadline is not None
+                      else None)
+        if kind == "stream":
+            return StreamRequest(stream=str(d["stream"]),
+                                 deadline_s=deadline_s)
+        arr = lambda k: (np.asarray(d[k], dtype=np.float64)  # noqa: E731
+                         if d.get(k) is not None else None)
+        return AppendRequest(
+            stream=str(d["stream"]), toas=arr("toas"),
+            residuals=arr("residuals"), spec=stream_spec,
+            sigma2=arr("sigma2"), freqs=arr("freqs"),
+            ecorr_amp=arr("ecorr_amp"), counts=arr("counts"),
+            ecorr_dt=(float(d["ecorr_dt"])
+                      if d.get("ecorr_dt") is not None else None),
+            watch=d.get("watch"), checkpoint=d.get("checkpoint"),
+            deadline_s=deadline_s)
     if spec is None:
         spec = default_spec
     elif isinstance(spec, dict):
@@ -121,18 +162,28 @@ def request_from_json(d: dict, default_spec: ArraySpec):
                          weighting=d.get("weighting", "noise"),
                          null=bool(d.get("null", False)))
     if kind == "infer":
-        grid = d.get("grid") or {}
-        lnlike = curn_grid_spec(
-            k=int(grid.get("k", 4)),
-            log10_A=tuple(grid.get("log10_A", (-15.2, -14.2))),
-            gamma=tuple(grid.get("gamma", (3.0, 6.0))),
-            nbin=int(grid.get("nbin", 10)))
+        if d.get("lnlike") is not None:
+            # the exact form: a full infer.schema InferSpec document —
+            # what lets ANY InferRequest cross the socket protocol
+            from ..infer import spec_from_json
+            lnlike = spec_from_json(d["lnlike"])
+        else:
+            grid = d.get("grid") or {}
+            lnlike = curn_grid_spec(
+                k=int(grid.get("k", 4)),
+                log10_A=tuple(grid.get("log10_A", (-15.2, -14.2))),
+                gamma=tuple(grid.get("gamma", (3.0, 6.0))),
+                nbin=int(grid.get("nbin", 10)))
         return InferRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
                             lnlike=lnlike)
     raise ValueError(f"unknown request kind {kind!r}")
 
 
 def response_json(req_id, res, emit: str = "summary") -> dict:
+    if isinstance(res, dict):
+        # stream-affine kinds resolve to plain payload dicts (already
+        # JSON-shaped; no per-realization arrays to thin by emit mode)
+        return {"id": req_id, "ok": True, "stream": res}
     out = {
         "id": req_id, "ok": True, "n": int(res.curves.shape[0]),
         "latency_ms": round(res.latency_s * 1e3, 3),
@@ -162,11 +213,34 @@ def response_json(req_id, res, emit: str = "summary") -> dict:
     return out
 
 
-def request_to_json(req: SimRequest, req_id) -> dict:
+def request_to_json(req, req_id) -> dict:
     """Request object -> protocol line (the client half of
     :func:`request_from_json`; the fleet's socket transport uses it).
-    ``InferRequest`` carries an arbitrary :class:`InferSpec`, which has no
-    general JSON form — route those through an in-process replica."""
+    ``InferRequest`` serializes its :class:`InferSpec` through
+    :mod:`fakepta_tpu.infer.schema`, so likelihood and stream requests
+    cross the socket like every other kind."""
+    if getattr(req, "stream_affine", False):
+        d = {"id": req_id, "kind": req.kind, "stream": str(req.stream)}
+        if req.deadline_s is not None:
+            d["deadline_ms"] = req.deadline_s * 1e3
+        if req.kind == "append":
+            for key in ("toas", "residuals", "sigma2", "freqs",
+                        "ecorr_amp", "counts"):
+                val = getattr(req, key)
+                if val is not None:
+                    d[key] = np.asarray(val).tolist()
+            if req.spec is not None:
+                if not isinstance(req.spec, ArraySpec):
+                    raise ValueError("only ArraySpec stream templates "
+                                     "cross the socket protocol")
+                d["spec"] = dataclasses.asdict(req.spec)
+            if req.ecorr_dt is not None:
+                d["ecorr_dt"] = float(req.ecorr_dt)
+            if req.watch is not None:
+                d["watch"] = str(req.watch)
+            if req.checkpoint is not None:
+                d["checkpoint"] = str(req.checkpoint)
+        return d
     d = {"id": req_id, "kind": req.kind, "n": int(req.n),
          "seed": int(req.seed)}
     if req.deadline_s is not None:
@@ -179,8 +253,8 @@ def request_to_json(req: SimRequest, req_id) -> dict:
         raise ValueError("only named or ArraySpec requests cross the "
                          "socket protocol")
     if isinstance(req, InferRequest):
-        raise ValueError("InferRequest has no JSON form (arbitrary "
-                         "InferSpec); use the in-process fleet transport")
+        from ..infer import spec_to_json
+        d["lnlike"] = spec_to_json(req.lnlike)
     if isinstance(req, OSRequest):
         d["orf"] = (req.orf if isinstance(req.orf, str) else list(req.orf))
         d["weighting"] = req.weighting
@@ -395,10 +469,9 @@ def _cmd_socket(args, banner: bool = False) -> int:
         jax.config.update("jax_platforms", args.jax_platform)
     if getattr(args, "x64", False):
         import jax
-        # fakepta: allow[dtype-policy] a replica subprocess must mirror
-        # its router's x64 mode or scalar promotion desyncs the response
-        # bit-identity contract; set at process entry before any device
-        # use — CLI plumbing, not library math
+        # a replica subprocess must mirror its router's x64 mode or
+        # scalar promotion desyncs the response bit-identity contract;
+        # set at process entry before any device use
         jax.config.update("jax_enable_x64", True)
     mesh = None
     if getattr(args, "devices", None):

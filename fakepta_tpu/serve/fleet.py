@@ -385,9 +385,11 @@ class SocketReplica:
 def _result_from_json(d: dict):
     """A full-emit response line -> :class:`ServeResult` (the socket
     transport reconstitutes exactly what the in-process pool returns; a
-    ``stats`` response passes through as a dict)."""
+    ``stats`` or stream payload passes through as a dict)."""
     if "stats" in d and "curves" not in d:
         return d["stats"]
+    if "stream" in d and "curves" not in d:
+        return d["stream"]
     res = ServeResult(
         curves=np.asarray(d["curves"]),
         autos=np.asarray(d["autos"]),
@@ -476,8 +478,16 @@ class ServeFleet:
         with self._lock:
             if self._closed:
                 raise ServeClosed("fleet is closed")
-        spec_hash = resolve_spec_hash(req.spec, {}) \
-            if not isinstance(req.spec, str) else flightrec.spec_hash(
+        if getattr(req, "stream_affine", False):
+            # stream affinity: the routing identity is the STREAM NAME —
+            # every append/stats request for one stream prefers the same
+            # ring owner, where the accumulated moments live
+            spec_hash = flightrec.spec_hash(
+                {"kind": "stream", "name": req.affinity_key()})
+        elif not isinstance(req.spec, str):
+            spec_hash = resolve_spec_hash(req.spec, {})
+        else:
+            spec_hash = flightrec.spec_hash(
                 {"kind": "registered", "name": req.spec})
         outer: Future = Future()
         t = obs.now()
@@ -511,6 +521,11 @@ class ServeFleet:
         first and on a replica's completion thread after a failover."""
         hints: List[float] = []
         spilled = False
+        # stream-affine requests NEVER spill on saturation: the stream's
+        # moments live on exactly one replica, so a busy owner means
+        # ServeBusy, not a sibling (dead owners ARE skipped — failover
+        # re-opens the stream, continuous via a shared checkpoint)
+        affine = bool(getattr(inf.req, "stream_affine", False))
         for rid in self.ring.preference(inf.spec_hash):
             if rid in exclude:
                 continue
@@ -522,6 +537,10 @@ class ServeFleet:
                              >= self.config.max_inflight_per_replica)
                 if not saturated:
                     self._inflight[rid] += 1
+            if saturated and affine:
+                hints.append(replica.retry_hint()
+                             if hasattr(replica, "retry_hint") else 0.0)
+                break
             if saturated:
                 # the hint read takes the replica pool's own lock — NEVER
                 # under the fleet lock (a dying pool dispatcher holds its
@@ -552,8 +571,11 @@ class ServeFleet:
             except ServeBusy as busy:
                 with self._lock:
                     self._inflight[rid] -= 1
-                    self._stats.spillovers += 1
                 hints.append(getattr(busy, "retry_after_s", 0.0))
+                if affine:
+                    break              # no spillover for stream affinity
+                with self._lock:
+                    self._stats.spillovers += 1
                 spilled = True
                 continue
             except (ReplicaDead, ConnectionError, OSError) as exc:
@@ -603,8 +625,11 @@ class ServeFleet:
         exc = inner.exception()
         if exc is None:
             res = inner.result()
-            res.replica = rid
-            res.failovers = inf.failovers
+            if isinstance(res, dict):  # stream payloads are plain dicts
+                res = dict(res, replica=rid, failovers=inf.failovers)
+            else:
+                res.replica = rid
+                res.failovers = inf.failovers
             t_done = obs.now()
             with self._lock:
                 st = self._stats
@@ -633,9 +658,11 @@ class ServeFleet:
                 if not inf.outer.done():
                     inf.outer.set_exception(busy)
             return
-        if isinstance(exc, ServeBusy) and inf.failovers \
+        if isinstance(exc, ServeBusy) and not getattr(
+                inf.req, "stream_affine", False) and inf.failovers \
                 < self.config.max_failovers:
-            # async 429 from a socket replica: spill, not fail
+            # async 429 from a socket replica: spill, not fail (stream-
+            # affine requests surface the busy instead — no spillover)
             inf.failovers += 1
             with self._lock:
                 self._stats.spillovers += 1

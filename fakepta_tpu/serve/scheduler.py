@@ -243,6 +243,7 @@ class ServePool:
         self._queues: dict = {}          # cohort_key -> deque[_Pending]
         self._pending = 0
         self._closed = False
+        self._stream_mgr = None          # lazy StreamManager (streams.py)
         self._t0 = obs.now()             # pool epoch for timeline spans
         self._stats = _Stats(self.config.result_window)
         self._timeline = collections.deque(maxlen=self.config.result_window)
@@ -273,6 +274,11 @@ class ServePool:
         :class:`ServeResult`. Raises :class:`ServeBusy` past the configured
         queue depth, :class:`ServeClosed` after shutdown, ``ValueError``
         for an unserveable shape."""
+        if getattr(req, "stream_affine", False):
+            # stream-affine kinds bypass the microbatch scheduler: nothing
+            # to coalesce (appends mutate ONE stream, in order) — executed
+            # synchronously under the StreamManager's per-stream lock
+            return self._submit_stream(req)
         n = int(req.n)
         if not 0 < n <= self._max_bucket:
             raise ValueError(
@@ -310,6 +316,30 @@ class ServePool:
             self._stats.queue_depth_max = max(self._stats.queue_depth_max,
                                               self._pending)
             self._cond.notify_all()
+        return fut
+
+    def _submit_stream(self, req) -> Future:
+        """Admit + execute one stream-affine request (docs/STREAMING.md).
+        Synchronous by design — an append is O(new-block) on the stream's
+        warm kernels — but still future-shaped so the fleet transports and
+        ``serve()`` treat every kind uniformly. ServeError subclasses
+        raise at the submit site (admission semantics, like ``n``
+        validation); anything else resolves the future exceptionally."""
+        with self._lock:
+            if self._closed:
+                raise ServeClosed("pool is closed")
+            mgr = self._stream_mgr
+            if mgr is None:
+                from .streams import StreamManager
+                mgr = self._stream_mgr = StreamManager()
+        fut: Future = Future()
+        try:
+            fut.set_result(mgr.handle(req))
+        except ServeError:
+            raise                      # admission semantics: raise at submit
+        except Exception as exc:       # noqa: BLE001 — future contract
+            fut.set_exception(exc)
+        obs.count("serve.stream_requests")
         return fut
 
     def _retry_after_locked(self) -> float:
@@ -704,6 +734,8 @@ class ServePool:
         self._dispatcher.join()
         self._demux_q.put(_STOP)
         self._demux_thread.join()
+        if self._stream_mgr is not None:
+            self._stream_mgr.close()
 
     def __enter__(self):
         return self

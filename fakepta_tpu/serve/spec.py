@@ -211,6 +211,64 @@ class InferRequest(SimRequest):
         return {"lnlike": self.lnlike}
 
 
+@dataclasses.dataclass(frozen=True)
+class AppendRequest:
+    """Streaming ingestion: append a TOA block to the named stream
+    (docs/STREAMING.md). The first touch of a ``stream`` name must carry a
+    ``spec`` — its synthetic array becomes the stream's frozen-grid
+    template (:class:`~fakepta_tpu.stream.StreamState`); ``ecorr_dt`` /
+    ``watch`` / ``checkpoint`` are open-time options, ignored (with a
+    flight-recorder note) once the stream exists. ``toas``/``residuals``
+    are (P, B) absolute seconds / seconds; ``counts`` marks the valid
+    prefix per pulsar.
+
+    Stream requests are AFFINE: the fleet routes them by stream name (not
+    spec hash) to the owning replica and never spills them to a sibling on
+    saturation — the accumulated moments live on exactly one replica.
+    Failover on replica death opens a fresh stream on the next ring
+    sibling, which is only continuous when the stream was opened with a
+    ``checkpoint`` on a shared filesystem (the sampling-session contract).
+    """
+
+    stream: str = ""
+    toas: object = None
+    residuals: object = None
+    spec: Optional[SpecLike] = None
+    sigma2: object = None
+    freqs: object = None
+    ecorr_amp: object = None
+    counts: object = None
+    ecorr_dt: Optional[float] = None
+    watch: Optional[str] = None
+    checkpoint: Optional[str] = None
+    deadline_s: Optional[float] = None
+
+    kind = "append"
+    stream_affine = True
+
+    def affinity_key(self) -> str:
+        """The fleet routing identity: the stream NAME, so every request
+        touching one stream lands on the same replica."""
+        return f"stream:{self.stream}"
+
+
+@dataclasses.dataclass(frozen=True)
+class StreamRequest:
+    """Read the named stream's rolling state: totals, bucket/recompile
+    counters, and the last detection statistic (``StreamState.stats()``).
+    Affine like :class:`AppendRequest` — stats come from the replica that
+    owns the moments."""
+
+    stream: str = ""
+    deadline_s: Optional[float] = None
+
+    kind = "stream"
+    stream_affine = True
+
+    def affinity_key(self) -> str:
+        return f"stream:{self.stream}"
+
+
 def curn_grid_spec(k: int = 4, log10_A=(-15.2, -14.2), gamma=(3.0, 6.0),
                    nbin: int = 10):
     """A small CURN (log10_A, gamma) grid InferSpec — the JSON-expressible

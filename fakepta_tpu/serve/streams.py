@@ -1,0 +1,110 @@
+"""StreamManager: the pool-side executor for stream-affine requests.
+
+Stream requests never enter the microbatch scheduler — there is nothing to
+coalesce (an append mutates ONE stream's accumulated moments, in order)
+and nothing to bucket at the cohort level (the stream buckets its own
+append blocks on the :mod:`fakepta_tpu.tune.defaults` ladder).
+:meth:`ServePool.submit` intercepts ``stream_affine`` requests before
+admission and hands them here; execution is synchronous on the submitter's
+thread under a per-stream lock, so appends to one stream serialize (the
+additive-update order IS the stream's history) while distinct streams
+proceed concurrently.
+
+Sessions are opened lazily by the first :class:`~fakepta_tpu.serve.spec
+.AppendRequest` naming a stream: its ``spec``'s synthetic array becomes
+the frozen-grid template, and ``ecorr_dt``/``watch``/``checkpoint`` are
+open-time options (a later request repeating them is flight-recorded and
+ignored — the grid contract forbids reconfiguring a live stream). With a
+``checkpoint`` path the open REPLAYS any consistent on-disk blocks, which
+is how a fleet failover resumes a stream on a sibling replica.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..obs import flightrec
+from .spec import ArraySpec, ServeError
+
+#: payload schema tag for stream responses (mirrors STREAM_SCHEMA's role
+#: for on-disk artifacts; versioned separately because the wire payload is
+#: a serve-layer contract)
+STREAM_PAYLOAD_SCHEMA = "fakepta_tpu.serve-stream/1"
+
+
+class StreamManager:
+    """Named :class:`~fakepta_tpu.stream.StreamState` sessions for one
+    pool. ``mesh=None`` keeps stream device arrays unsharded — stream
+    state is per-pulsar small and pool meshes need not divide a stream
+    template's pulsar count."""
+
+    def __init__(self, mesh=None):
+        self.mesh = mesh
+        self._lock = threading.Lock()
+        self._streams: dict = {}      # name -> (threading.Lock, StreamState)
+
+    def _session(self, req):
+        """The (lock, state) pair for ``req.stream``, opening it when the
+        request carries a spec."""
+        name = str(req.stream)
+        if not name:
+            raise ServeError("stream requests need a non-empty stream name")
+        with self._lock:
+            entry = self._streams.get(name)
+            if entry is not None:
+                if getattr(req, "spec", None) is not None:
+                    flightrec.note("serve_stream_reopen_ignored",
+                                   stream=name)
+                return entry
+            spec = getattr(req, "spec", None)
+            if spec is None:
+                raise ServeError(
+                    f"stream {name!r} is not open; the first append must "
+                    f"carry a spec (its array is the frozen-grid template)")
+            if not isinstance(spec, ArraySpec):
+                raise ServeError("stream templates must be declarative "
+                                 "ArraySpecs (named simulator "
+                                 "registrations have no batch to pin a "
+                                 "grid from)")
+            from ..stream import StreamState
+
+            template, _gwb = spec.parts()
+            state = StreamState(template, mesh=self.mesh,
+                                ecorr_dt=req.ecorr_dt, watch=req.watch,
+                                checkpoint=req.checkpoint)
+            entry = (threading.Lock(), state)
+            self._streams[name] = entry
+            flightrec.note("serve_stream_open", stream=name,
+                           npsr=state.npsr,
+                           replayed=int(state.appends),
+                           rolled_back=int(state.rolled_back))
+            return entry
+
+    def handle(self, req) -> dict:
+        """Execute one stream-affine request; returns the wire payload."""
+        lock, state = self._session(req)
+        name = str(req.stream)
+        if req.kind == "append":
+            if req.toas is None or req.residuals is None:
+                raise ServeError("append needs toas and residuals")
+            with lock:
+                info = state.append(req.toas, req.residuals,
+                                    sigma2=req.sigma2, freqs=req.freqs,
+                                    ecorr_amp=req.ecorr_amp,
+                                    counts=req.counts)
+            return dict(info, kind="append", stream=name,
+                        payload_schema=STREAM_PAYLOAD_SCHEMA)
+        if req.kind == "stream":
+            with lock:
+                stats = state.stats()
+            return dict(stats, kind="stream", stream=name,
+                        payload_schema=STREAM_PAYLOAD_SCHEMA)
+        raise ServeError(f"unknown stream request kind {req.kind!r}")
+
+    def stream_names(self):
+        with self._lock:
+            return sorted(self._streams)
+
+    def close(self) -> None:
+        with self._lock:
+            self._streams.clear()

@@ -1,0 +1,40 @@
+"""fakepta_tpu.stream — append-TOA ingestion: O(new-epoch), not O(restage).
+
+Everything else in the engine is batch over a frozen dataset; real PTAs
+accrete TOAs for decades, and an always-on served product (ROADMAP item 5)
+should never pay a full restage when one epoch of data arrives. The
+per-pulsar Woodbury moments (``T^T N^-1 T``, ``T^T N^-1 r``,
+``r^T N^-1 r``, ``ln det N``) are plain sums over TOAs, so new data is a
+rank-k *additive* update (:func:`fakepta_tpu.ops.woodbury.append_parts`)
+plus an ECORR epoch-block extension — provided the Fourier grid is FROZEN
+(docs/STREAMING.md has the algebra and the one trap: a grid that rescaled
+with Tspan would silently change every old basis value).
+
+Layers:
+
+- :class:`StreamState` (:mod:`state`) — the per-pulsar container: pinned
+  frequency grids from a template batch, accumulated device moments,
+  bucketed append kernels that ride a serve-style ladder so shape churn
+  never recompiles, a full-restage oracle path, and an atomic
+  :class:`StreamCheckpoint` (torn appends roll back to the last consistent
+  state; chaos site ``ingest.append``).
+- :class:`~fakepta_tpu.detect.streaming.StreamingOS` — the rolling
+  on-device detection statistic, refreshed from the stream's moments after
+  every append with obs-gated significance tracking.
+- :class:`PosteriorRefresher` (:mod:`refresh`) — continuous posterior
+  refresh: each data arrival warm-starts a new
+  :class:`~fakepta_tpu.sample.SamplingRun` from the previous posterior's
+  Laplace mode and final chain state, and promotes the new posterior only
+  through an R-hat gate.
+- the served surface — ``AppendRequest``/``StreamRequest``
+  (:mod:`fakepta_tpu.serve.spec`), executed by the pool's
+  :class:`~fakepta_tpu.serve.streams.StreamManager` and routed by the
+  fleet with stream affinity to the owning replica.
+"""
+
+from .refresh import PosteriorRefresher
+from .state import (STREAM_SCHEMA, StreamCheckpoint, StreamState,
+                    default_stream_model)
+
+__all__ = ["STREAM_SCHEMA", "PosteriorRefresher", "StreamCheckpoint",
+           "StreamState", "default_stream_model"]

@@ -1,0 +1,94 @@
+"""The streaming lane's A/B recipe: incremental append vs full restage.
+
+Shared by ``bench.py`` and ``benchmarks/suite.py`` (config 14) the way the
+serve lanes share ``run_loadgen``: one function stages a stream with bulk
+history, then measures a single-epoch append against a full restage of the
+same accumulated store on the SAME kernels (``restage`` deliberately
+reuses the append executable at the store's capacity rung, so the A/B is
+pure O(new-epoch)-vs-O(history) work, not a compiler difference). Timing
+rides the obs clock (:func:`fakepta_tpu.obs.now` — the same clock behind
+every recorded latency in the repo); the first append at each rung and the
+first restage are warmup (they pay the compile), the recorded figures are
+best-of-``repeats`` steady state.
+
+Row metrics (``obs compare``/``gate`` directions in ``obs/report.py``):
+``append_latency_ms`` (lower-better), ``restage_ms`` (the baseline side),
+``append_speedup_x`` = restage/append (higher-better; the acceptance is
+>= 5x at the flagship config), ``stream_rebuckets`` (a shape fact) and
+``stream_recompiles`` (zero-expected canary — any retrace means the
+bucket ladder stopped covering the traffic).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import obs
+from ..batch import PulsarBatch
+from .state import StreamState, default_stream_model
+
+
+def run_append_ab(*, npsr: int = 16, ntoa: int = 260,
+                  tspan_years: float = 15.0, n_red: int = 10,
+                  n_dm: int = 10, nbin: int = 10, history: int = 512,
+                  epoch_width: int = 8, ecorr_dt=None, mesh=None,
+                  repeats: int = 3, seed: int = 0) -> dict:
+    """Stage ``history`` TOAs/pulsar of bulk history, then A/B one
+    ``epoch_width``-TOA append against a full restage. Returns the bench
+    row fragment (module docstring)."""
+    import jax
+
+    from .. import constants as const
+    from ..utils.compat import enable_x64
+
+    with enable_x64():
+        template = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa,
+                                         tspan_years=tspan_years,
+                                         n_red=n_red, n_dm=n_dm, seed=seed,
+                                         dtype=jax.numpy.float64)
+        stream = StreamState(template, default_stream_model(nbin=nbin),
+                             ecorr_dt=ecorr_dt, mesh=mesh)
+    rng = np.random.default_rng(seed + 1)
+    tspan = tspan_years * const.yr
+
+    def block(lo, hi, width):
+        t = np.sort(rng.uniform(lo * tspan, hi * tspan, (npsr, width)),
+                    axis=1)
+        kw = {}
+        if ecorr_dt is not None:
+            kw["ecorr_amp"] = np.abs(rng.normal(3e-7, 1e-7,
+                                                (npsr, width)))
+        return (t, rng.normal(0.0, 1e-7, (npsr, width))), kw
+
+    # bulk history in two blocks (exercises a mid-stream epoch extension),
+    # then one warmup epoch append that compiles the steady-state kernel
+    # at the final (block bucket, epoch capacity) pair
+    half = history // 2
+    for lo, hi, width in ((0.0, 0.45, half), (0.45, 0.9, history - half)):
+        (t, r), kw = block(lo, hi, width)
+        stream.append(t, r, **kw)
+    (t, r), kw = block(0.90, 0.97, epoch_width)
+    stream.append(t, r, **kw)
+
+    append_ms = float("inf")
+    for k in range(repeats):
+        (t, r), kw = block(0.97, 1.0, epoch_width)
+        append_ms = min(append_ms, stream.append(t, r, **kw)["latency_ms"])
+
+    stream.restage()                       # warmup: the restage compile
+    restage_ms = float("inf")
+    for _ in range(repeats):
+        t0 = obs.now()
+        stream.restage()
+        restage_ms = min(restage_ms, (obs.now() - t0) * 1e3)
+    restage_ms = round(restage_ms, 3)
+
+    return {
+        "append_latency_ms": append_ms,
+        "restage_ms": restage_ms,
+        "append_speedup_x": round(restage_ms / max(append_ms, 1e-9), 2),
+        "stream_appends": int(stream.appends),
+        "stream_toas": int(stream._n.sum()),
+        "stream_rebuckets": int(stream.rebuckets),
+        "stream_recompiles": int(stream.recompiles),
+    }

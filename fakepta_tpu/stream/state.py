@@ -1,0 +1,768 @@
+"""StreamState: the per-pulsar append-TOA container (docs/STREAMING.md).
+
+**The frozen-grid contract.** Woodbury moments are additive over TOAs only
+if every TOA — old and new — is projected onto the SAME Fourier basis. The
+batch layer normalizes times by Tspan (``t/Tspan_p`` per pulsar,
+``t/Tspan_array`` for CURN), so a naive "rebuild the batch with the new
+data" changes Tspan and with it every *old* basis value: the old moments
+would be sums over a basis that no longer exists, and nothing is additive.
+A stream therefore pins its grids ONCE from a template batch — ``df_own``
+(per-pulsar bin width, 1/Tspan_ref) and ``tspan_common`` — and normalizes
+every appended absolute TOA against those frozen scales. Appends are then
+exactly additive by construction (:func:`fakepta_tpu.ops.woodbury
+.append_parts`), which the f64 oracle test pins at <= 1e-8 per pulsar.
+ECORR epochs use *global* ids (``floor(t_abs / ecorr_dt)``) for the same
+reason: an epoch's identity never changes when later data arrives.
+
+**Re-bucket policy.** Three shapes churn as a stream grows, and each rides
+its own geometric ladder (:mod:`fakepta_tpu.tune.defaults`:
+``STREAM_BLOCK_BUCKETS`` / ``STREAM_GROWTH_RATIO``) so the compiled-kernel
+key set stays O(log growth): the append-block width (pads to the smallest
+ladder rung), the ECORR epoch capacity, and the host storage capacity.
+Appends within the current rungs reuse the cached executable — ZERO
+recompiles, enforced by the same trace-count retrace guard the engine uses
+(``stream_recompiles`` is a zero-expected bench canary). A rung crossing is
+one counted ``stream.rebuckets`` event and at most one fresh compile.
+
+**Torn-append recovery.** With a checkpoint attached, every appended block
+lands as its own ``.b<k>.npz`` via :func:`fakepta_tpu.utils.io
+.write_atomic` with a CRC32 manifest; resume replays the blocks through the
+same append kernels (bit-identical — appends are deterministic), and a torn
+final block rolls back to the last consistent state (chaos site
+``ingest.append``, kind ``torn``; docs/RELIABILITY.md).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from types import SimpleNamespace
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding
+from jax.sharding import PartitionSpec as Psp
+
+from .. import faults
+from .. import obs
+from ..infer import model as infer_model
+from ..ops import woodbury
+from ..parallel.mesh import PSR_AXIS
+from ..tune import defaults as tune_defaults
+from ..utils.compat import enable_x64
+
+#: schema tag for stream artifacts (manifest + served stats payloads)
+STREAM_SCHEMA = "fakepta_tpu.stream/1"
+
+
+def default_stream_model(nbin: int = 10, log10_A=(-15.5, -13.5),
+                         gamma=(2.0, 6.0)):
+    """The standard streaming model: batch-pinned red + DM noise plus a
+    free-powerlaw CURN component (the process the rolling detection
+    statistic watches). Mirrors :func:`fakepta_tpu.serve.spec
+    .curn_grid_spec`'s model with the stream's default bounds."""
+    return infer_model.LikelihoodSpec(components=(
+        infer_model.ComponentSpec(target="red", spectrum="batch"),
+        infer_model.ComponentSpec(target="dm", spectrum="batch"),
+        infer_model.ComponentSpec(target="curn", nbin=int(nbin), free=(
+            infer_model.FreeParam("log10_A", tuple(log10_A)),
+            infer_model.FreeParam("gamma", tuple(gamma)))),
+    ))
+
+
+def _snap(n: int, ladder, ratio: int) -> int:
+    """Smallest ladder rung >= n; past the top rung, keep multiplying by
+    ``ratio`` (so bulk history appends stay legal with O(log) extra
+    compiles)."""
+    if n <= 0:
+        raise ValueError(f"bucket size must be positive, got {n}")
+    for b in ladder:
+        if n <= b:
+            return int(b)
+    b = int(ladder[-1])
+    while b < n:
+        b *= int(ratio)
+    return b
+
+
+class StreamCheckpoint:
+    """Append-block checkpoint: one small ``.b<k>.npz`` per append plus a
+    CRC32 manifest, every file via :func:`~fakepta_tpu.utils.io
+    .write_atomic`. Resume replays the raw blocks through the stream's own
+    append kernels — deterministic, so the resumed state is bit-identical —
+    and a torn block rolls back to the last consistent append
+    (``stream_rollback`` flight-recorded, ``faults.rollbacks`` counted)."""
+
+    def __init__(self, path):
+        from pathlib import Path
+        self.path = Path(path)
+        self._sums: dict = {}        # block index -> CRC32
+
+    def _block_path(self, k: int):
+        return self.path.with_name(self.path.name + f".b{k:06d}.npz")
+
+    def _write_manifest(self, ident: dict, n_blocks: int) -> None:
+        from ..utils.io import npz_bytes, write_atomic
+        manifest = dict(
+            npsr=np.int64(ident["npsr"]), ncols=np.int64(ident["ncols"]),
+            ecorr_dt=np.float64(ident["ecorr_dt"]),
+            n_blocks=np.int64(n_blocks),
+            sums=np.asarray([self._sums.get(k, 0) for k in range(n_blocks)],
+                            dtype=np.int64))
+        write_atomic(self.path, npz_bytes(**manifest))
+
+    def save_block(self, ident: dict, k: int, arrays: dict) -> None:
+        from ..utils.io import npz_bytes, write_atomic
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        self._sums[k] = write_atomic(self._block_path(k),
+                                     npz_bytes(**arrays))
+        self._write_manifest(ident, k + 1)
+
+    def corrupt_block(self, k: int) -> None:
+        """Chaos-harness hook: simulate the torn write fsync cannot prevent
+        (failing storage drops the block's pages after the rename became
+        durable) — resume must detect the bad CRC and roll back."""
+        p = self._block_path(k)
+        data = p.read_bytes()
+        p.write_bytes(data[:max(len(data) // 2, 1)])
+
+    def load_blocks(self, ident: dict):
+        """``(blocks, rolled_back)`` — verified raw append blocks in order,
+        after rolling back past the first torn/corrupt one."""
+        import io as _io
+        import zipfile
+        import zlib
+        if not self.path.exists():
+            return [], 0
+        try:
+            with np.load(self.path, allow_pickle=False) as z:
+                manifest = {k: z[k] for k in z.files}
+        except (OSError, ValueError, zipfile.BadZipFile) as exc:
+            obs.flightrec.note("stream_manifest_corrupt",
+                               path=str(self.path), error=repr(exc)[:200])
+            self.delete()
+            return [], 0
+        for key in ("npsr", "ncols"):
+            if int(manifest[key]) != int(ident[key]):
+                raise ValueError(
+                    f"stream checkpoint {self.path} was written by a "
+                    f"different stream ({key}={int(manifest[key])}, this "
+                    f"stream has {int(ident[key])}); delete it or use a "
+                    f"different path")
+        if float(manifest["ecorr_dt"]) != float(ident["ecorr_dt"]):
+            raise ValueError(
+                f"stream checkpoint {self.path} uses ecorr_dt="
+                f"{float(manifest['ecorr_dt'])}, this stream "
+                f"{float(ident['ecorr_dt'])}; delete it or use a "
+                f"different path")
+        total = int(manifest["n_blocks"])
+        sums = manifest["sums"]
+        blocks = []
+        good = total
+        self._sums = {}
+        for k in range(total):
+            try:
+                data = self._block_path(k).read_bytes()
+                crc = zlib.crc32(data)
+                if k < len(sums) and crc != int(sums[k]):
+                    raise ValueError(f"block {k} checksum mismatch "
+                                     f"(torn write)")
+                with np.load(_io.BytesIO(data), allow_pickle=False) as z:
+                    blocks.append({key: z[key] for key in z.files})
+                self._sums[k] = crc
+            except (OSError, ValueError, KeyError,
+                    zipfile.BadZipFile) as exc:
+                obs.flightrec.note("stream_rollback", block=k,
+                                   error=repr(exc)[:200])
+                good = k
+                blocks = blocks[:good]
+                break
+        if good < total:
+            # drop the bad tail and rewrite the manifest: the on-disk
+            # checkpoint is the last CONSISTENT StreamState again
+            for k in range(good, total):
+                self._block_path(k).unlink(missing_ok=True)
+                self._sums.pop(k, None)
+            obs.count("faults.rollbacks", total - good)
+            if good == 0:
+                self.delete()
+            else:
+                self._write_manifest(ident, good)
+        return blocks, total - good
+
+    def delete(self):
+        for p in self.path.parent.glob(self.path.name + ".b*.npz"):
+            p.unlink(missing_ok=True)
+        self.path.unlink(missing_ok=True)
+        self._sums = {}
+
+
+class StreamState:
+    """Append-TOA state for one PTA: frozen grids, accumulated device
+    moments, bucketed O(new-epoch) append kernels (class docstring above;
+    algebra in docs/STREAMING.md).
+
+    ``template`` pins the geometry (npsr, sky positions, stored noise PSDs)
+    and the FROZEN frequency grids (``df_own``, ``tspan_common``); the
+    stream itself starts empty — the template's TOAs are reference scales,
+    not data. ``model`` is the :class:`~fakepta_tpu.infer.LikelihoodSpec`
+    whose basis/phi the moments live on (default
+    :func:`default_stream_model`); ``'sys'`` components are rejected (their
+    per-band TOA masks are not well-defined for not-yet-seen data).
+    ``ecorr_dt`` (seconds) enables ECORR epoch blocks with global epoch
+    ids. ``watch`` names an ORF ("hd", ...) to arm the rolling
+    :class:`~fakepta_tpu.detect.streaming.StreamingOS` refreshed on every
+    append. ``checkpoint`` attaches a :class:`StreamCheckpoint` path and
+    REPLAYS any existing consistent blocks before returning.
+
+    Appended absolute TOAs are seconds from the stream's shared origin
+    (the template's own origin: its synthetic arrays start at t=0).
+    """
+
+    def __init__(self, template, model=None, *, theta_ref=None, mesh=None,
+                 ecorr_dt: Optional[float] = None, watch=None,
+                 checkpoint=None, block_buckets=None, growth_ratio=None,
+                 dtype=np.float64):
+        self.template = template
+        self.model = model if model is not None else default_stream_model()
+        self._compiled = infer_model.build(self.model, template)
+        if any(c["target"] == "sys" for c in self._compiled._comps):
+            raise ValueError("streaming does not support 'sys' components "
+                             "(per-band TOA membership is undefined for "
+                             "future data); model red/dm/chrom/curn only")
+        self.npsr = int(template.npsr)
+        self.ncols = int(self._compiled.ncols)
+        self.mesh = mesh
+        if mesh is not None:
+            shards = int(mesh.shape.get(PSR_AXIS, 1))
+            if self.npsr % shards != 0:
+                raise ValueError(f"npsr={self.npsr} must be divisible by "
+                                 f"the psr mesh axis ({shards})")
+        self._dtype = np.dtype(dtype)
+        self._x64 = self._dtype.itemsize == 8
+        self.ecorr_dt = None if ecorr_dt is None else float(ecorr_dt)
+        if theta_ref is None:
+            theta_ref = self._compiled.theta_from_unit(
+                np.full(self._compiled.D, 0.5))
+        self.theta_ref = np.asarray(theta_ref, dtype=np.float64)
+        self._buckets = tuple(block_buckets if block_buckets is not None
+                              else tune_defaults.STREAM_BLOCK_BUCKETS)
+        self._ratio = int(growth_ratio if growth_ratio is not None
+                          else tune_defaults.STREAM_GROWTH_RATIO)
+
+        # frozen grids + per-pulsar defaults from the template (host f64)
+        self._df_own = np.asarray(template.df_own, dtype=np.float64)
+        self._tspan = float(np.asarray(template.tspan_common,
+                                       dtype=np.float64))
+        tmask = np.asarray(template.mask, dtype=np.float64)
+        tsig = np.asarray(template.sigma2, dtype=np.float64)
+        self._sigma2_default = (np.sum(tsig * tmask, axis=1)
+                                / np.maximum(np.sum(tmask, axis=1), 1.0))
+        with self._ctx():
+            self._nsb = self._template_views()
+
+        # host store of raw appended data (the restage/refresh source)
+        self._cap = 0
+        self._n = np.zeros(self.npsr, dtype=np.int64)
+        self._store: dict = {}
+        # accumulated device moment parts
+        self._ecap = 0
+        with self._ctx():
+            self._fixed, self._res = self._zero_parts()
+        self._kernels: dict = {}
+        self._trace_counts: dict = {}
+        self.appends = 0
+        self.rebuckets = 0
+        self.recompiles = 0
+        self.compiles = 0
+        self.rolled_back = 0
+        self._moments_cache = None
+        self._watch = None
+        self._watch_orf = watch
+        self.last_stats: Optional[dict] = None
+
+        self._ckpt = None
+        if checkpoint is not None:
+            self._ckpt = (checkpoint if isinstance(checkpoint,
+                                                   StreamCheckpoint)
+                          else StreamCheckpoint(checkpoint))
+            self._resume()
+
+    # ------------------------------------------------------------------
+    # staging helpers
+    # ------------------------------------------------------------------
+    def _ctx(self):
+        """Dtype context for kernel trace/dispatch: the stream accumulates
+        moments across appends, so it defaults to f64 (the sanctioned
+        host-f64 staging layer; an f32 stream is legal where the platform
+        demands it and drift is bounded by periodic :meth:`restage`)."""
+        import contextlib
+        return enable_x64() if self._x64 else contextlib.nullcontext()
+
+    def _template_views(self) -> SimpleNamespace:
+        """Stream-dtype views of the template fields ``basis``/``phi``
+        read — the phi/finish-side namespace (times are NOT data here)."""
+        b = self.template
+        cast = lambda x: jnp.asarray(np.asarray(x, dtype=self._dtype))  # noqa: E731
+        return SimpleNamespace(
+            t_own=cast(b.t_own), t_common=cast(b.t_common),
+            freqs=cast(b.freqs), df_own=cast(b.df_own),
+            tspan_common=cast(b.tspan_common), red_psd=cast(b.red_psd),
+            dm_psd=cast(b.dm_psd), chrom_psd=cast(b.chrom_psd),
+            sys_psd=cast(b.sys_psd),
+            sys_mask=jnp.asarray(np.asarray(b.sys_mask)))
+
+    def _put(self, arr):
+        """Device placement: pulsar-axis sharded when a mesh is attached
+        (per-pulsar moments are embarrassingly parallel over 'psr')."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        spec = Psp(PSR_AXIS, *([None] * (np.ndim(arr) - 1)))
+        return jax.device_put(jnp.asarray(arr),
+                              NamedSharding(self.mesh, spec))
+
+    def _zero_parts(self):
+        p, c = self.npsr, self.ncols
+        dt = self._dtype
+        fixed = {"M": self._put(np.zeros((p, c, c), dt)),
+                 "lndetN": self._put(np.zeros(p, dt)),
+                 "n_valid": self._put(np.zeros(p, dt))}
+        res = {"d0": self._put(np.zeros(p, dt)),
+               "dT": self._put(np.zeros((p, c), dt))}
+        if self._ecap:
+            fixed["a"] = self._put(np.zeros((p, self._ecap), dt))
+            fixed["v"] = self._put(np.zeros((p, self._ecap, c), dt))
+            res["s"] = self._put(np.zeros((p, self._ecap), dt))
+        return fixed, res
+
+    def _note_trace(self, signature) -> None:
+        """The engine's retrace guard: a second trace of the same kernel
+        key is an unexpected recompile (the ``stream_recompiles``
+        zero-expected canary)."""
+        n = self._trace_counts.get(signature, 0) + 1
+        self._trace_counts[signature] = n
+        if n > 1:
+            self.recompiles += 1
+            obs.count("stream.recompiles")
+        else:
+            self.compiles += 1
+            obs.count("stream.compiles")
+
+    # ------------------------------------------------------------------
+    # kernels (cached per (block bucket, epoch capacity))
+    # ------------------------------------------------------------------
+    def _kernel(self, nb: int):
+        key = (int(nb), int(self._ecap))
+        fn = self._kernels.get(key)
+        if fn is None:
+            fn = self._build_kernel(*key)
+            self._kernels[key] = fn
+        return fn
+
+    def _build_kernel(self, nb: int, ecap: int):
+        compiled, p = self._compiled, self.npsr
+        df_own = self._nsb.df_own
+        with self._ctx():       # the pinned scale must hold stream dtype
+            tspan = jnp.asarray(self._tspan, self._dtype)
+
+        def kern(fixed, res, t_abs, mask, sigma2, freqs, epoch_idx,
+                 ecorr_amp, r):
+            self._note_trace(("append", nb, ecap))
+            # the frozen-grid normalization: absolute seconds against the
+            # PINNED per-pulsar df_own / common tspan — never re-derived
+            # from the accumulated data (module docstring)
+            bview = SimpleNamespace(
+                t_own=t_abs * df_own[:, None], t_common=t_abs / tspan,
+                freqs=freqs, sys_mask=jnp.zeros((p, 1, nb), bool))
+            tmat = compiled.basis(bview)
+
+            if ecap:
+                fixed2 = jax.vmap(
+                    lambda f, tm, s2, mk, ei, ea: woodbury.append_parts(
+                        f, tm, s2, mk, epoch_idx=ei, ecorr_amp=ea,
+                        num_epochs=ecap))(fixed, tmat, sigma2, mask,
+                                          epoch_idx, ecorr_amp)
+                res2 = jax.vmap(
+                    lambda rs, tm, s2, mk, rr, ei, ea:
+                    woodbury.append_parts(
+                        rs, tm, s2, mk, r=rr, epoch_idx=ei, ecorr_amp=ea,
+                        num_epochs=ecap))(res, tmat, sigma2, mask, r,
+                                          epoch_idx, ecorr_amp)
+            else:
+                fixed2 = jax.vmap(
+                    lambda f, tm, s2, mk: woodbury.append_parts(
+                        f, tm, s2, mk))(fixed, tmat, sigma2, mask)
+                res2 = jax.vmap(
+                    lambda rs, tm, s2, mk, rr: woodbury.append_parts(
+                        rs, tm, s2, mk, r=rr))(res, tmat, sigma2, mask, r)
+            return fixed2, res2
+
+        return jax.jit(kern)
+
+    def _finish_fn(self):
+        key = ("finish", int(self._ecap))
+        fn = self._kernels.get(key)
+        if fn is None:
+            def fin(fixed, res):
+                self._note_trace(key)
+                m, lndet, nv, corr = jax.vmap(woodbury.finish_fixed)(fixed)
+                if corr is None:
+                    d0, dt = jax.vmap(
+                        lambda rp: woodbury.finish_res(rp))(res)
+                else:
+                    d0, dt = jax.vmap(woodbury.finish_res)(res, corr)
+                return m, lndet, nv, d0, dt
+            fn = jax.jit(fin)
+            self._kernels[key] = fn
+        return fn
+
+    # ------------------------------------------------------------------
+    # capacity ladders
+    # ------------------------------------------------------------------
+    def _grow_epochs(self, need: int) -> None:
+        """Snap the ECORR epoch capacity up to the next rung and zero-pad
+        the accumulated parts (exact; woodbury.pad_epoch_parts semantics on
+        the batched arrays)."""
+        new_cap = _snap(need, self._buckets, self._ratio)
+        grow = new_cap - self._ecap
+        first = self._ecap == 0
+        with self._ctx():
+            if self._ecap == 0:
+                self._ecap = new_cap
+                p, c, dt = self.npsr, self.ncols, self._dtype
+                self._fixed = dict(
+                    self._fixed,
+                    a=self._put(np.zeros((p, new_cap), dt)),
+                    v=self._put(np.zeros((p, new_cap, c), dt)))
+                self._res = dict(self._res,
+                                 s=self._put(np.zeros((p, new_cap), dt)))
+            else:
+                self._fixed = dict(
+                    self._fixed,
+                    a=jnp.pad(self._fixed["a"], ((0, 0), (0, grow))),
+                    v=jnp.pad(self._fixed["v"],
+                              ((0, 0), (0, grow), (0, 0))))
+                self._res = dict(
+                    self._res,
+                    s=jnp.pad(self._res["s"], ((0, 0), (0, grow))))
+                self._ecap = new_cap
+        if not first:                 # first allocation is not a rebucket
+            self.rebuckets += 1
+            obs.count("stream.rebuckets")
+            obs.flightrec.note("stream_rebucket", what="epochs",
+                               capacity=int(new_cap))
+
+    def _grow_store(self, need: int) -> None:
+        """Snap the host raw-data capacity up to the next rung (the
+        restage/refresh source arrays; a host realloc, no compile)."""
+        new_cap = _snap(need, self._buckets, self._ratio)
+        p = self.npsr
+        grown = {}
+        for key, fill in (("t", 0.0), ("r", 0.0), ("sigma2", 1.0),
+                          ("freqs", 1400.0), ("ecorr", 0.0)):
+            arr = np.full((p, new_cap), fill, dtype=np.float64)
+            if self._cap:
+                arr[:, :self._cap] = self._store[key]
+            grown[key] = arr
+        mask = np.zeros((p, new_cap), dtype=bool)
+        eidx = np.zeros((p, new_cap), dtype=np.int64)
+        if self._cap:
+            mask[:, :self._cap] = self._store["mask"]
+            eidx[:, :self._cap] = self._store["eidx"]
+        grown["mask"], grown["eidx"] = mask, eidx
+        self._store = grown
+        if self._cap:
+            self.rebuckets += 1
+            obs.count("stream.rebuckets")
+            obs.flightrec.note("stream_rebucket", what="store",
+                               capacity=int(new_cap))
+        self._cap = new_cap
+
+    # ------------------------------------------------------------------
+    # the append path
+    # ------------------------------------------------------------------
+    def _ident(self) -> dict:
+        return {"npsr": self.npsr, "ncols": self.ncols,
+                "ecorr_dt": 0.0 if self.ecorr_dt is None else self.ecorr_dt}
+
+    def append(self, toas, residuals, *, sigma2=None, freqs=None,
+               ecorr_amp=None, counts=None) -> dict:
+        """Ingest one block of new TOAs — O(block), never O(history).
+
+        ``toas``/``residuals`` are (P, B) absolute seconds / seconds;
+        ``counts`` (P,) marks how many leading entries per pulsar are real
+        (default: all B). ``sigma2`` defaults to the template's mean white
+        variance per pulsar; ``freqs`` to 1400 MHz; ``ecorr_amp`` (legal
+        only with ``ecorr_dt`` set) to zero. Returns the append stats dict
+        (latency, bucket, totals, and — with ``watch`` armed — the rolling
+        detection statistic).
+        """
+        act = faults.check("ingest.append", seq=int(self.appends))
+        toas = np.asarray(toas, dtype=np.float64)
+        residuals = np.asarray(residuals, dtype=np.float64)
+        if toas.ndim != 2 or toas.shape[0] != self.npsr:
+            raise ValueError(f"toas must be ({self.npsr}, B), got "
+                             f"{toas.shape}")
+        if residuals.shape != toas.shape:
+            raise ValueError(f"residuals shape {residuals.shape} != toas "
+                             f"shape {toas.shape}")
+        b0 = toas.shape[1]
+        if counts is None:
+            counts = np.full(self.npsr, b0, dtype=np.int64)
+        else:
+            counts = np.asarray(counts, dtype=np.int64)
+            if counts.shape != (self.npsr,) or np.any(counts < 0) \
+                    or np.any(counts > b0):
+                raise ValueError(f"counts must be ({self.npsr},) in "
+                                 f"[0, {b0}]")
+        if ecorr_amp is not None and self.ecorr_dt is None:
+            raise ValueError("ecorr_amp given but the stream was built "
+                             "without ecorr_dt")
+        block = {
+            "t": toas, "r": residuals, "counts": counts,
+            "sigma2": (np.broadcast_to(self._sigma2_default[:, None],
+                                       toas.shape).copy()
+                       if sigma2 is None
+                       else np.broadcast_to(
+                           np.asarray(sigma2, dtype=np.float64),
+                           toas.shape).copy()),
+            "freqs": (np.full(toas.shape, 1400.0) if freqs is None
+                      else np.broadcast_to(
+                          np.asarray(freqs, dtype=np.float64),
+                          toas.shape).copy()),
+            "ecorr": (np.zeros(toas.shape) if ecorr_amp is None
+                      else np.broadcast_to(
+                          np.asarray(ecorr_amp, dtype=np.float64),
+                          toas.shape).copy()),
+        }
+        info = self._ingest(block, record=True)
+        if act == "torn":
+            # chaos harness: the block landed and the manifest references
+            # it, then failing storage tore its pages and the process died
+            # — resume must roll back to the last consistent StreamState
+            if self._ckpt is not None:
+                self._ckpt.corrupt_block(self.appends - 1)
+            raise faults.KillFault(
+                f"injected torn stream append at block {self.appends - 1}")
+        return info
+
+    def _ingest(self, block: dict, record: bool) -> dict:
+        t0 = obs.now()
+        toas, counts = block["t"], block["counts"]
+        b0 = toas.shape[1]
+        nb = _snap(b0, self._buckets, self._ratio)
+        valid = np.arange(b0)[None, :] < counts[:, None]
+
+        def padded(arr, fill, dt=np.float64):
+            out = np.full((self.npsr, nb), fill, dtype=dt)
+            out[:, :b0] = np.where(valid, arr, fill)
+            return out
+
+        t_pad = padded(toas, 0.0)
+        r_pad = padded(block["r"], 0.0)
+        s_pad = padded(block["sigma2"], 1.0)
+        f_pad = padded(block["freqs"], 1400.0)
+        e_pad = padded(block["ecorr"], 0.0)
+        rebucketed = False
+        if self.ecorr_dt is not None:
+            eidx = np.floor_divide(toas, self.ecorr_dt).astype(np.int64)
+            eidx = np.where(valid, eidx, 0)
+            if np.any(eidx < 0):
+                raise ValueError("TOAs before the stream origin are not "
+                                 "appendable (negative epoch id)")
+            need = int(eidx.max(initial=-1)) + 1 if np.any(valid) else 0
+            if need > self._ecap:
+                grew = self._ecap > 0
+                self._grow_epochs(need)
+                rebucketed = rebucketed or grew
+            ei_pad = np.zeros((self.npsr, nb), dtype=np.int32)
+            ei_pad[:, :b0] = eidx
+        else:
+            ei_pad = np.zeros((self.npsr, nb), dtype=np.int32)
+        m_pad = np.zeros((self.npsr, nb), dtype=bool)
+        m_pad[:, :b0] = valid
+
+        need_cap = int((self._n + counts).max())
+        if need_cap > self._cap:
+            grew = self._cap > 0      # first allocation is not a rebucket
+            self._grow_store(need_cap)
+            rebucketed = rebucketed or grew
+
+        kernel = self._kernel(nb)
+        with self._ctx():
+            args = tuple(self._put(a) for a in
+                         (t_pad, m_pad, s_pad, f_pad, ei_pad, e_pad, r_pad))
+            fixed, res = kernel(self._fixed, self._res, args[0], args[1],
+                                args[2], args[3], args[4], args[5], args[6])
+            jax.block_until_ready(fixed["M"])
+        self._fixed, self._res = fixed, res
+        self._moments_cache = None
+
+        # host raw store (restage oracle + posterior refresh source)
+        for p in range(self.npsr):
+            c, n = int(counts[p]), int(self._n[p])
+            if c == 0:
+                continue
+            self._store["t"][p, n:n + c] = toas[p, :c]
+            self._store["r"][p, n:n + c] = block["r"][p, :c]
+            self._store["sigma2"][p, n:n + c] = block["sigma2"][p, :c]
+            self._store["freqs"][p, n:n + c] = block["freqs"][p, :c]
+            self._store["ecorr"][p, n:n + c] = block["ecorr"][p, :c]
+            self._store["mask"][p, n:n + c] = True
+            self._store["eidx"][p, n:n + c] = ei_pad[p, :c]
+        self._n = self._n + counts
+        k = self.appends
+        self.appends += 1
+
+        if record and self._ckpt is not None:
+            self._ckpt.save_block(self._ident(), k, {
+                "t": toas, "r": block["r"], "counts": counts,
+                "sigma2": block["sigma2"], "freqs": block["freqs"],
+                "ecorr": block["ecorr"]})
+
+        info = {
+            "schema": STREAM_SCHEMA, "append": k,
+            "n_new": int(counts.sum()), "n_toas": int(self._n.sum()),
+            "block_bucket": int(nb), "epoch_capacity": int(self._ecap),
+            "rebucketed": bool(rebucketed),
+            "rebuckets": int(self.rebuckets),
+            "compiles": int(self.compiles),
+            "recompiles": int(self.recompiles),
+        }
+        if record:
+            obs.count("stream.appends")
+            if self._watch_orf is not None:
+                info.update(self._watcher().update(self.moments()))
+        info["latency_ms"] = round((obs.now() - t0) * 1e3, 3)
+        self.last_stats = info
+        return info
+
+    def _resume(self) -> None:
+        blocks, rolled_back = self._ckpt.load_blocks(self._ident())
+        self.rolled_back = int(rolled_back)
+        for blk in blocks:
+            self._ingest({k: np.asarray(v) for k, v in blk.items()},
+                         record=False)
+            obs.count("stream.replays")
+        if blocks and self._watch_orf is not None:
+            self._watcher().update(self.moments())
+
+    # ------------------------------------------------------------------
+    # consumers: moments, likelihood, detection, restage, refresh views
+    # ------------------------------------------------------------------
+    def moments(self):
+        """``(M, lndetN, n_valid, d0, dT)`` finished from the accumulated
+        parts (cached until the next append)."""
+        if self._moments_cache is None:
+            fin = self._finish_fn()
+            with self._ctx():
+                self._moments_cache = fin(self._fixed, self._res)
+        return self._moments_cache
+
+    def lnlike(self, theta) -> float:
+        """GP-marginalized lnL of the accumulated data at one theta."""
+        m, lndet, nv, d0, dt = self.moments()
+        with self._ctx():
+            phi = self._compiled.phi(jnp.asarray(theta, self._dtype),
+                                     self._nsb)
+            lnl = jax.vmap(woodbury.lnlike_from_moments)(
+                d0, dt, m, lndet, nv, phi)
+            return float(jnp.sum(lnl))
+
+    def _watcher(self):
+        if self._watch is None:
+            from ..detect.streaming import StreamingOS
+            self._watch = StreamingOS(
+                self._compiled, self._nsb,
+                np.asarray(self.template.pos, dtype=np.float64),
+                orf=self._watch_orf, theta_ref=self.theta_ref)
+        return self._watch
+
+    def restage(self):
+        """Recompute the moment parts from ALL stored raw data in one shot
+        — the O(history) path a stream exists to avoid. Kept as the A/B
+        baseline, the oracle's reference, and the drift bound for f32
+        streams. Returns fresh ``(fixed, res)`` parts; the accumulated
+        state is untouched."""
+        if self._cap == 0:
+            with self._ctx():
+                return self._zero_parts()
+        nb = self._cap            # already rung-snapped by _grow_store
+        kernel = self._kernel(nb)
+        st = self._store
+        with self._ctx():
+            zero_f, zero_r = self._zero_parts()
+            args = tuple(self._put(a) for a in (
+                st["t"], st["mask"], st["sigma2"], st["freqs"],
+                st["eidx"].astype(np.int32), st["ecorr"], st["r"]))
+            fixed, res = kernel(zero_f, zero_r, args[0], args[1], args[2],
+                                args[3], args[4], args[5], args[6])
+            jax.block_until_ready(fixed["M"])
+        return fixed, res
+
+    def restage_moments(self):
+        """Finished moments from a fresh :meth:`restage` (the append-vs-
+        restage oracle's reference side)."""
+        fixed, res = self.restage()
+        fin = self._finish_fn()
+        with self._ctx():
+            return fin(fixed, res)
+
+    def batch_view(self):
+        """The accumulated data as a PulsarBatch on the FROZEN grids — the
+        posterior-refresh input (``fakepta_tpu.sample`` consumes it).
+        ECORR epoch ids are densified per pulsar (grouping is all the
+        Sherman-Morrison correction needs)."""
+        if self._cap == 0:
+            raise ValueError("stream has no data yet")
+        st = self.template
+        cap = self._cap
+        t_abs = self._store["t"]
+        mask = self._store["mask"]
+        eidx = np.zeros((self.npsr, cap), dtype=np.int32)
+        if self.ecorr_dt is not None:
+            for p in range(self.npsr):
+                n = int(self._n[p])
+                if n:
+                    uniq, inv = np.unique(self._store["eidx"][p, :n],
+                                          return_inverse=True)
+                    eidx[p, :n] = inv.astype(np.int32)
+        dt = np.asarray(st.t_own).dtype
+        return dataclasses.replace(
+            st,
+            t_own=jnp.asarray(t_abs * self._df_own[:, None], dt),
+            t_common=jnp.asarray(t_abs / self._tspan, dt),
+            mask=jnp.asarray(mask),
+            freqs=jnp.asarray(self._store["freqs"], dt),
+            sigma2=jnp.asarray(np.where(mask, self._store["sigma2"], 1.0),
+                               dt),
+            epoch_idx=jnp.asarray(eidx),
+            ecorr_amp=jnp.asarray(self._store["ecorr"], dt),
+            sys_psd=jnp.zeros((self.npsr, 1, 1), dt),
+            sys_mask=jnp.zeros((self.npsr, 1, cap), dtype=bool))
+
+    def residuals_view(self) -> np.ndarray:
+        """(P, cap) masked residuals aligned with :meth:`batch_view`."""
+        return self._store["r"] * self._store["mask"]
+
+    def stats(self) -> dict:
+        """The served ``StreamRequest`` payload: totals, bucket state, and
+        the last rolling-detection numbers."""
+        out = {
+            "schema": STREAM_SCHEMA,
+            "appends": int(self.appends),
+            "n_toas": int(self._n.sum()),
+            "npsr": int(self.npsr),
+            "capacity": int(self._cap),
+            "epoch_capacity": int(self._ecap),
+            "rebuckets": int(self.rebuckets),
+            "compiles": int(self.compiles),
+            "recompiles": int(self.recompiles),
+            "rolled_back": int(self.rolled_back),
+        }
+        if self.last_stats is not None:
+            for key in ("snr", "amp2", "significance_sigma", "latency_ms"):
+                if key in self.last_stats:
+                    out[key] = self.last_stats[key]
+        return out

@@ -47,6 +47,22 @@ BUCKET_RATIO = 2
 #: instead of exercising ladder breadth (the solo loadgen covers that)
 DEFAULT_FLEET_BUCKETS = (16, 32)
 
+# --- streaming dispatch knobs (fakepta_tpu.stream) -------------------------
+
+#: append-block bucket ladder: an appended TOA block pads up to the
+#: smallest rung >= its width, so every single-epoch append of a P-pulsar
+#: array (a handful of TOAs per pulsar) compiles ONE small-block kernel and
+#: reuses it forever — the "shape churn never recompiles" contract of
+#: docs/STREAMING.md. Same geometric shape as DEFAULT_BUCKETS, smaller
+#: rungs (append blocks are epochs, not cohorts).
+STREAM_BLOCK_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
+
+#: growth ratio past the top ladder rung AND for the stream's storage /
+#: ECORR-epoch capacity rungs: capacities only ever move to the next
+#: power-of-ratio rung, so a stream that doubles its data recompiles
+#: O(log growth) times total, not O(appends)
+STREAM_GROWTH_RATIO = 2
+
 # --- tuner constants (fakepta_tpu.tune) ------------------------------------
 
 #: store schema tag + version; entries written by a different version are

@@ -239,9 +239,16 @@ def test_request_json_roundtrip_and_busy_hint_crosses_wire():
     assert d["id"] == 7 and d["deadline_ms"] == 250.0
     back = request_from_json(json.loads(json.dumps(d)), None)
     assert back == r
-    with pytest.raises(ValueError, match="no JSON form"):
-        request_to_json(InferRequest(spec=SPEC0, n=2,
-                                     lnlike=curn_grid_spec(k=2)), 1)
+    # InferRequest crosses the wire too (the InferSpec JSON schema closed
+    # the old "no JSON form" gap); the spec roundtrips by value
+    ri = InferRequest(spec=SPEC0, n=2, lnlike=curn_grid_spec(k=2))
+    backi = request_from_json(json.loads(json.dumps(request_to_json(ri, 1))),
+                              None)
+    assert backi.spec == ri.spec
+    assert backi.lnlike.model == ri.lnlike.model
+    assert backi.lnlike.mode == ri.lnlike.mode
+    np.testing.assert_array_equal(np.asarray(backi.lnlike.theta),
+                                  np.asarray(ri.lnlike.theta))
     err = error_json(3, ServeBusy("full", retry_after_s=0.125))
     assert err["code"] == "busy" and err["retry_after_s"] == 0.125
 

@@ -32,6 +32,9 @@ def _gwb_cfg(batch, ncomp=8, log10_A=-13.5, gamma=13 / 3):
     return GWBConfig(psd=psd, orf="hd")
 
 
+@pytest.mark.slow   # ~16 s: tier-1 budget reclaim for the streaming lane
+# (the per-backend sibling test_sys_zero_width_sampling_reproduces_fixed_psd_run
+# keeps the pinned-range == fixed-PSD contract in tier-1)
 def test_zero_width_sampling_reproduces_fixed_psd_run(batch):
     """Pinned (a == b) uniform ranges must reproduce the fixed-PSD program:
     the coefficient/white/GWB streams are untouched by sampling, and the

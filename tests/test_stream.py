@@ -1,0 +1,461 @@
+"""Streaming TOA ingestion (ISSUE 14): append-vs-restage oracle, bucket
+ladder, checkpoint/torn recovery, rolling detection, posterior refresh,
+and the served/routed surface.
+
+Lean by construction: one module-scoped stream accumulates three variable-
+count ECORR blocks and every moment/oracle/counter/detection assertion
+reads it; the chaos lanes use a tiny checkpointed stream of their own; the
+posterior-refresher test appends a one-TOA block sized to stay inside the
+already-compiled capacity rungs so both refresh cycles share executables.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fakepta_tpu import constants as const
+from fakepta_tpu import faults
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.serve import ArraySpec
+from fakepta_tpu.stream import (STREAM_SCHEMA, StreamState,
+                                default_stream_model)
+
+NPSR = 4
+TSPAN_YEARS = 3.0
+TSPAN_S = TSPAN_YEARS * const.yr
+ECORR_DT = 2.0e6                      # ~45 global epochs over the span
+
+#: the variable valid-prefix counts of the three module blocks (per block,
+#: per pulsar) — exercises masked padding and ragged per-pulsar totals;
+#: max per-pulsar total is 15, so the store capacity snaps to rung 16 and
+#: a later 1-TOA append (the refresher test) stays inside it
+COUNTS = [np.array([6, 5, 6, 6]), np.array([5, 5, 4, 5]),
+          np.array([4, 3, 4, 4])]
+WIDTHS = [6, 5, 4]
+
+
+def _template():
+    return PulsarBatch.synthetic(npsr=NPSR, ntoa=48,
+                                 tspan_years=TSPAN_YEARS, n_red=4, n_dm=4,
+                                 n_chrom=2, seed=3, dtype=jnp.float64)
+
+
+def _blocks(seed=5, widths=WIDTHS, counts=COUNTS, t_hi=0.95):
+    """Chronological blocks of absolute-second TOAs with ragged counts."""
+    rng = np.random.default_rng(seed)
+    total = sum(widths)
+    t_all = np.sort(rng.uniform(0.0, t_hi * TSPAN_S, (NPSR, total)), axis=1)
+    blocks, lo = [], 0
+    for w, c in zip(widths, counts):
+        blocks.append({
+            "t": t_all[:, lo:lo + w],
+            "r": rng.normal(0.0, 1e-7, (NPSR, w)),
+            "s2": (1e-7 + rng.uniform(0.0, 5e-8, (NPSR, w))) ** 2,
+            "ec": np.abs(rng.normal(3e-7, 1e-7, (NPSR, w))),
+            "counts": np.asarray(c, dtype=np.int64),
+        })
+        lo += w
+    return blocks
+
+
+def _bulk(blocks):
+    """The same data as ONE block: valid entries concatenated per pulsar."""
+    totals = np.sum([b["counts"] for b in blocks], axis=0)
+    width = int(totals.max())
+    out = {k: np.zeros((NPSR, width)) for k in ("t", "r", "s2", "ec")}
+    out["s2"][:] = 1.0
+    for p in range(NPSR):
+        n = 0
+        for b in blocks:
+            c = int(b["counts"][p])
+            for k in ("t", "r", "s2", "ec"):
+                out[k][p, n:n + c] = b[k][p, :c]
+            n += c
+    out["counts"] = totals.astype(np.int64)
+    return out
+
+
+def _append_all(stream, blocks):
+    return [stream.append(b["t"], b["r"], sigma2=b["s2"],
+                          ecorr_amp=b["ec"], counts=b["counts"])
+            for b in blocks]
+
+
+def _rel_err(got, want):
+    scale = max(float(np.max(np.abs(want))), 1e-300)
+    return float(np.max(np.abs(got - want))) / scale
+
+
+@pytest.fixture(scope="module")
+def streamed():
+    """One stream, three ECORR appends, plus its restaged reference."""
+    template = _template()
+    model = default_stream_model(nbin=4)
+    stream = StreamState(template, model, ecorr_dt=ECORR_DT, watch="hd")
+    blocks = _blocks()
+    infos = _append_all(stream, blocks)
+    return {
+        "template": template, "model": model, "stream": stream,
+        "blocks": blocks, "infos": infos,
+        "streamed": [np.asarray(x) for x in stream.moments()],
+        "restaged": [np.asarray(x) for x in stream.restage_moments()],
+    }
+
+
+# ---------------------------------------------------------------------------
+# the f64 oracle: incremental appends == one-shot restage
+# ---------------------------------------------------------------------------
+
+def test_append_matches_restage_f64_oracle(streamed):
+    """The tentpole contract: three masked ECORR appends accumulate the
+    SAME per-pulsar moments a full restage of the union computes, to
+    <= 1e-8 RELATIVE error (M entries scale like 1/sigma^2 ~ 1e14, so the
+    comparison must be relative; observed agreement is ~1e-15)."""
+    for name, got, want in zip(("M", "lndetN", "n_valid", "d0", "dT"),
+                               streamed["streamed"], streamed["restaged"]):
+        assert _rel_err(got, want) <= 1e-8, name
+    # n_valid is an exact TOA count: per-pulsar sums of the ragged blocks
+    totals = np.sum([b["counts"] for b in streamed["blocks"]], axis=0)
+    np.testing.assert_array_equal(streamed["streamed"][2], totals)
+
+
+def test_block_size_invariance_bulk_vs_incremental(streamed):
+    """The same union appended as ONE bulk block (different block bucket,
+    different kernel) lands on the same moments and the same lnL."""
+    bulk = _bulk(streamed["blocks"])
+    other = StreamState(streamed["template"], streamed["model"],
+                        ecorr_dt=ECORR_DT)
+    other.append(bulk["t"], bulk["r"], sigma2=bulk["s2"],
+                 ecorr_amp=bulk["ec"], counts=bulk["counts"])
+    for got, want in zip(other.moments(), streamed["streamed"]):
+        assert _rel_err(np.asarray(got), want) <= 1e-8
+    lnl_a = streamed["stream"].lnlike(streamed["stream"].theta_ref)
+    lnl_b = other.lnlike(other.theta_ref)
+    assert abs(lnl_a - lnl_b) <= 1e-8 * max(abs(lnl_b), 1.0)
+
+
+def test_mesh_invariance(streamed):
+    """Identical moments on a 1x1x1 mesh and a 2x2x2 mesh (the pulsar
+    axis shards the per-pulsar moments; collectives cannot change them)."""
+    results = []
+    for mesh in (make_mesh(jax.devices()[:1]),
+                 make_mesh(jax.devices(), psr_shards=2, toa_shards=2)):
+        s = StreamState(streamed["template"], streamed["model"],
+                        ecorr_dt=ECORR_DT, mesh=mesh)
+        _append_all(s, streamed["blocks"])
+        results.append([np.asarray(x) for x in s.moments()])
+    for got, on_one, want in zip(results[0], results[1],
+                                 streamed["streamed"]):
+        assert _rel_err(got, want) <= 1e-10
+        assert _rel_err(on_one, want) <= 1e-10
+
+
+# ---------------------------------------------------------------------------
+# the bucket ladder: zero recompiles, counted rebuckets
+# ---------------------------------------------------------------------------
+
+def test_zero_recompiles_within_buckets(streamed):
+    """Every (block bucket, epoch capacity) kernel traces exactly once —
+    the stream_recompiles zero-expected canary, enforced by the same
+    retrace guard the engine uses."""
+    stream = streamed["stream"]
+    assert stream.recompiles == 0
+    assert stream.compiles > 0
+    assert streamed["infos"][-1]["recompiles"] == 0
+    assert all(n == 1 for n in stream._trace_counts.values())
+
+
+def test_rebucket_policy_first_allocation_is_free(streamed):
+    """The first store/epoch allocation is not a rebucket; later rung
+    crossings are counted and flagged on the append info."""
+    infos = streamed["infos"]
+    assert infos[0]["rebucketed"] is False
+    assert streamed["stream"].rebuckets > 0
+    assert any(i["rebucketed"] for i in infos[1:])
+    assert infos[-1]["rebuckets"] == streamed["stream"].rebuckets
+
+
+def test_append_info_schema(streamed):
+    info = streamed["infos"][-1]
+    assert info["schema"] == STREAM_SCHEMA
+    assert info["n_toas"] == int(np.sum([b["counts"].sum()
+                                         for b in streamed["blocks"]]))
+    assert info["block_bucket"] == 8          # widths 4-6 all snap to 8
+    assert info["latency_ms"] >= 0.0
+
+
+def test_stream_rejects_bad_blocks(streamed):
+    stream = streamed["stream"]
+    with pytest.raises(ValueError):
+        stream.append(np.zeros((NPSR + 1, 3)), np.zeros((NPSR + 1, 3)))
+    with pytest.raises(ValueError):
+        stream.append(np.zeros((NPSR, 3)), np.zeros((NPSR, 2)))
+    with pytest.raises(ValueError):
+        stream.append(np.zeros((NPSR, 3)), np.zeros((NPSR, 3)),
+                      counts=np.array([4, 1, 1, 1]))
+    with pytest.raises(ValueError):            # before the stream origin
+        stream.append(np.full((NPSR, 2), -5e6), np.zeros((NPSR, 2)))
+    no_ecorr = StreamState(streamed["template"], streamed["model"])
+    with pytest.raises(ValueError):
+        no_ecorr.append(np.ones((NPSR, 2)), np.zeros((NPSR, 2)),
+                        ecorr_amp=np.full((NPSR, 2), 1e-7))
+
+
+# ---------------------------------------------------------------------------
+# the rolling detection statistic
+# ---------------------------------------------------------------------------
+
+def test_streaming_os_rides_every_append(streamed):
+    """With watch armed every append reports the rolling OS; the streamed
+    statistic equals the statistic of the restaged moments (same jitted
+    update on oracle-equal inputs)."""
+    for info in streamed["infos"]:
+        for key in ("amp2", "snr", "significance_sigma"):
+            assert np.isfinite(info[key])
+    watcher = streamed["stream"]._watcher()
+    from_stream = watcher.update(streamed["stream"].moments())
+    from_restage = watcher.update(streamed["stream"].restage_moments())
+    for key in ("amp2", "snr", "significance_sigma"):
+        np.testing.assert_allclose(from_stream[key], from_restage[key],
+                                   rtol=1e-8)
+
+
+# ---------------------------------------------------------------------------
+# checkpoint / torn-append recovery (chaos site ingest.append)
+# ---------------------------------------------------------------------------
+
+def _ckpt_stream(template, model, path):
+    return StreamState(template, model, ecorr_dt=ECORR_DT, checkpoint=path)
+
+
+def test_checkpoint_resume_bitwise_across_append_boundary(streamed,
+                                                          tmp_path):
+    """A fresh StreamState on the same checkpoint replays the appended
+    blocks through its own kernels to BIT-IDENTICAL moments."""
+    path = tmp_path / "stream.ckpt"
+    first = _ckpt_stream(streamed["template"], streamed["model"], path)
+    _append_all(first, streamed["blocks"][:2])
+    want = [np.asarray(x) for x in first.moments()]
+    resumed = _ckpt_stream(streamed["template"], streamed["model"], path)
+    assert resumed.appends == 2
+    assert resumed.rolled_back == 0
+    for got, ref in zip(resumed.moments(), want):
+        np.testing.assert_array_equal(np.asarray(got), ref)
+    # and the boundary holds: appending the third block to the RESUMED
+    # stream matches the original stream continuing
+    blk = streamed["blocks"][2]
+    first.append(blk["t"], blk["r"], sigma2=blk["s2"],
+                 ecorr_amp=blk["ec"], counts=blk["counts"])
+    resumed.append(blk["t"], blk["r"], sigma2=blk["s2"],
+                   ecorr_amp=blk["ec"], counts=blk["counts"])
+    for got, ref in zip(resumed.moments(), first.moments()):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+def test_torn_append_rolls_back_to_last_consistent_state(streamed,
+                                                         tmp_path):
+    """The ingest.append torn lane: the block lands, its checkpoint file
+    tears, the process dies — resume detects the bad CRC and rolls back
+    bitwise to the last consistent StreamState."""
+    path = tmp_path / "torn.ckpt"
+    stream = _ckpt_stream(streamed["template"], streamed["model"], path)
+    _append_all(stream, streamed["blocks"][:2])
+    want = [np.asarray(x) for x in stream.moments()]
+    blk = streamed["blocks"][2]
+    plan = faults.FaultPlan([faults.FaultSpec("ingest.append", "torn",
+                                              at=(0,))])
+    with faults.inject(plan):
+        with pytest.raises(faults.KillFault):
+            stream.append(blk["t"], blk["r"], sigma2=blk["s2"],
+                          ecorr_amp=blk["ec"], counts=blk["counts"])
+    assert plan.fired == [("ingest.append", "torn", 0)]
+    resumed = _ckpt_stream(streamed["template"], streamed["model"], path)
+    assert resumed.rolled_back == 1
+    assert resumed.appends == 2
+    for got, ref in zip(resumed.moments(), want):
+        np.testing.assert_array_equal(np.asarray(got), ref)
+
+
+def test_transient_fault_leaves_stream_untouched(streamed):
+    """A raising fault fires before any mutation, so the retry of the
+    same block is deterministic and the oracle still holds."""
+    stream = StreamState(streamed["template"], streamed["model"],
+                         ecorr_dt=ECORR_DT)
+    blocks = streamed["blocks"]
+    _append_all(stream, blocks[:1])
+    plan = faults.FaultPlan([faults.FaultSpec("ingest.append", "transient",
+                                              at=(0,))])
+    blk = blocks[1]
+    with faults.inject(plan):
+        with pytest.raises(faults.TransientFault):
+            stream.append(blk["t"], blk["r"], sigma2=blk["s2"],
+                          ecorr_amp=blk["ec"], counts=blk["counts"])
+    assert stream.appends == 1
+    stream.append(blk["t"], blk["r"], sigma2=blk["s2"],
+                  ecorr_amp=blk["ec"], counts=blk["counts"])
+    _append_all(stream, blocks[2:])
+    for got, want in zip(stream.moments(), streamed["streamed"]):
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_checkpoint_identity_mismatch_is_a_hard_error(streamed, tmp_path):
+    path = tmp_path / "ident.ckpt"
+    stream = _ckpt_stream(streamed["template"], streamed["model"], path)
+    _append_all(stream, streamed["blocks"][:1])
+    with pytest.raises(ValueError):
+        StreamState(streamed["template"], streamed["model"],
+                    ecorr_dt=ECORR_DT * 2, checkpoint=path)
+
+
+# ---------------------------------------------------------------------------
+# continuous posterior refresh
+# ---------------------------------------------------------------------------
+
+def test_posterior_refresh_warm_starts_and_gates(streamed):
+    """Cycle 2 warm-starts from cycle 1 (Laplace mode + remapped chains)
+    and converges the Laplace fit in no more iterations; promotion is
+    R-hat gated."""
+    from fakepta_tpu.sample import SampleSpec
+    from fakepta_tpu.stream import PosteriorRefresher
+
+    stream = streamed["stream"]
+    spec = SampleSpec(model=stream.model, n_chains=2, warmup=4,
+                      step_size=0.3)
+    ref = PosteriorRefresher(stream, spec, rhat_gate=1e9)
+    info1 = ref.refresh(n_steps=16, seed=1)
+    assert info1["warm_started"] is False
+    assert info1["chains_warm_started"] is False
+    assert info1["promoted"] is True and ref.posterior is not None
+    # one new TOA per pulsar: stays inside the compiled capacity rungs
+    t_new = np.full((NPSR, 1), 0.96 * TSPAN_S)
+    stream.append(t_new, np.full((NPSR, 1), 1e-8))
+    assert stream.recompiles == 0
+    info2 = ref.refresh(n_steps=16, seed=2)
+    assert info2["warm_started"] is True
+    assert info2["chains_warm_started"] is True
+    assert info2["laplace_iters"] <= info1["laplace_iters"]
+    assert info2["n_toas"] == info1["n_toas"] + NPSR
+    # the gate: an impossible R-hat bound rejects promotion but still
+    # advances the warm state
+    strict = PosteriorRefresher(stream, spec, rhat_gate=1e-6)
+    info3 = strict.refresh(n_steps=16, seed=3)
+    assert info3["promoted"] is False
+    assert strict.posterior is None
+    assert strict._warm is not None
+
+
+def test_refresher_rejects_mismatched_model(streamed):
+    from fakepta_tpu.sample import SampleSpec
+    from fakepta_tpu.stream import PosteriorRefresher
+
+    other = default_stream_model(nbin=3)
+    with pytest.raises(ValueError):
+        PosteriorRefresher(streamed["stream"],
+                           SampleSpec(model=other, n_chains=2))
+
+
+# ---------------------------------------------------------------------------
+# the served surface: pool execution, JSON protocol, fleet affinity
+# ---------------------------------------------------------------------------
+
+STREAM_SPEC = ArraySpec(npsr=4, ntoa=40, tspan_years=3.0, n_red=3, n_dm=3,
+                        gwb_ncomp=3)
+
+
+def _append_req(stream="s0", width=4, seed=9, spec=STREAM_SPEC, **kw):
+    from fakepta_tpu.serve import AppendRequest
+
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.uniform(0.0, 0.9 * TSPAN_S, (4, width)), axis=1)
+    return AppendRequest(stream=stream, toas=t,
+                         residuals=rng.normal(0.0, 1e-7, (4, width)),
+                         spec=spec, **kw)
+
+
+def test_serve_pool_executes_stream_requests():
+    """ServePool intercepts stream-affine requests: appends serialize
+    into the named stream and StreamRequest reads its stats payload."""
+    from fakepta_tpu.serve import (ServeError, ServePool, StreamRequest)
+    from fakepta_tpu.serve.streams import STREAM_PAYLOAD_SCHEMA
+
+    pool = ServePool(mesh=make_mesh(jax.devices()[:1]))
+    try:
+        r1 = pool.submit(_append_req(seed=9)).result(timeout=300)
+        r2 = pool.submit(_append_req(seed=10)).result(timeout=300)
+        assert r1["kind"] == "append" and r1["payload_schema"] == \
+            STREAM_PAYLOAD_SCHEMA
+        assert r2["n_toas"] == r1["n_toas"] + 16
+        assert r2["recompiles"] == 0
+        stats = pool.submit(StreamRequest(stream="s0")).result(timeout=300)
+        assert stats["kind"] == "stream" and stats["appends"] == 2
+        # an unopened stream (no spec) is a ServeError at submit
+        with pytest.raises(ServeError):
+            pool.submit(StreamRequest(stream="nope"))
+    finally:
+        pool.close()
+
+
+def test_stream_request_json_roundtrip():
+    """Append/stream/infer requests survive the socket protocol: object
+    -> JSON line -> object with equal payloads (the InferSpec schema
+    satellite rides the same codec)."""
+    from fakepta_tpu.serve import StreamRequest, curn_grid_spec
+    from fakepta_tpu.serve.cli import (request_from_json, request_to_json,
+                                       response_json)
+    from fakepta_tpu.serve.spec import InferRequest
+
+    req = _append_req(ecorr_amp=np.full((4, 4), 1e-7), ecorr_dt=ECORR_DT,
+                      watch="hd")
+    wire = json.loads(json.dumps(request_to_json(req, req_id=3)))
+    back = request_from_json(wire, default_spec=None)
+    assert back.stream == "s0" and back.kind == "append"
+    np.testing.assert_array_equal(back.toas, req.toas)
+    np.testing.assert_array_equal(back.residuals, req.residuals)
+    np.testing.assert_array_equal(back.ecorr_amp, req.ecorr_amp)
+    assert back.spec == req.spec
+    assert back.ecorr_dt == ECORR_DT and back.watch == "hd"
+
+    sreq = StreamRequest(stream="s0", deadline_s=1.5)
+    sback = request_from_json(json.loads(json.dumps(
+        request_to_json(sreq, req_id=4))), default_spec=None)
+    assert sback == sreq
+
+    ireq = InferRequest(spec=STREAM_SPEC, n=2, seed=7,
+                        lnlike=curn_grid_spec(k=3, nbin=4))
+    iwire = json.loads(json.dumps(request_to_json(ireq, req_id=5)))
+    iback = request_from_json(iwire, default_spec=None)
+    assert iback.lnlike.model == ireq.lnlike.model
+    assert iback.lnlike.mode == ireq.lnlike.mode
+    np.testing.assert_array_equal(iback.lnlike.theta, ireq.lnlike.theta)
+
+    # stream payloads are already JSON-shaped dicts on the response side
+    out = response_json(3, {"kind": "append", "n_toas": 16})
+    assert out == {"id": 3, "ok": True,
+                   "stream": {"kind": "append", "n_toas": 16}}
+
+
+def test_fleet_routes_streams_with_affinity():
+    """Every request touching one stream lands on the SAME replica (the
+    accumulated moments live there), with the payload tagged."""
+    from fakepta_tpu.serve import (FleetConfig, LocalReplica, ServeConfig,
+                                   ServeFleet, StreamRequest)
+
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=0.01)
+    replicas = [LocalReplica(f"r{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    try:
+        res = [flt.serve(_append_req(seed=s), timeout=300)
+               for s in (11, 12, 13)]
+        owners = {r["replica"] for r in res}
+        assert len(owners) == 1
+        assert res[-1]["n_toas"] == 48
+        stats = flt.serve(StreamRequest(stream="s0"), timeout=300)
+        assert stats["replica"] in owners
+        assert stats["appends"] == 3
+    finally:
+        flt.close()

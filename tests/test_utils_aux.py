@@ -181,6 +181,7 @@ def test_timer_blocks_on_device_work(sim):
     assert s["run"]["n"] == 1 and s["run"]["total_s"] > 0
 
 
+@pytest.mark.slow   # ~22 s: tier-1 budget reclaim for the streaming lane
 def test_trace_writes_profile(tmp_path):
     from fakepta_tpu.utils.profiling import trace
     with trace(tmp_path / "tr"):
