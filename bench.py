@@ -111,6 +111,22 @@ RunReport each ``sim.run()`` attaches):
   RNG-lane contract). ``fleet_steady_compiles`` must stay 0: all replicas
   share the persistent compile cache, so cold starts and failover shard
   absorption are cache loads, not compiles;
+- ``fleet_heartbeat_misses`` / ``fleet_breaker_opens`` /
+  ``fleet_timeouts`` / ``fleet_joins`` / ``fleet_drains`` /
+  ``scale_events`` / ``fleet_join_steady_compiles``: the fleet lifecycle
+  lane (``fakepta_tpu.serve.health``/``.autoscale``, docs/RELIABILITY.md
+  "Fleet lifecycle"; ``benchmarks/suite.py`` config 15 runs the elastic
+  chaos A/B — ramp, wedge one replica's heartbeats, SIGKILL another,
+  autoscale a third in). Heartbeat misses and breaker opens keep the
+  lower-is-better default: the scripted wedge produces a known floor,
+  and growth past it means replicas are degrading unscripted.
+  ``fleet_timeouts`` and ``fleet_lost_requests`` MUST stay 0 — a wedged
+  replica is breakered out of band, never discovered by a client timing
+  out into it. ``fleet_joins``/``fleet_drains``/``scale_events`` are
+  exempt membership-churn shape facts, and
+  ``fleet_join_steady_compiles`` must stay 0: an autoscale-joined
+  replica prewarms its absorbed shard from the shared compile cache
+  (warm loads, not compiles);
 - ``append_latency_ms`` / ``restage_ms`` / ``append_speedup_x`` /
   ``stream_appends`` / ``stream_toas`` / ``stream_rebuckets`` /
   ``stream_recompiles``: the streaming-ingestion lane

@@ -520,6 +520,60 @@ def config14():
             "value": row["append_speedup_x"], "unit": "x", **row}
 
 
+def config15():
+    """Elastic chaos lane (docs/RELIABILITY.md "Fleet lifecycle"): the
+    lifecycle A/B the health plane + elastic membership + autoscaler must
+    survive in one run. The elastic loadgen ramps the config13 working
+    set, WEDGES one replica's heartbeats at 20% (the breaker must drain
+    it with zero client-visible timeouts — the wedge is caught out of
+    band), SIGKILLs another at 45% (reader-EOF failover), and autoscales
+    a fresh replica in at 70% (its shard prewarmed from the shared
+    compile cache: ``fleet_join_steady_compiles`` must stay 0). Every
+    failed-over response is bit-verified against a solo run before the
+    row ships; ``fleet_lost_requests``/``fleet_timeouts`` must be 0. The
+    headline ``value`` is ``fleet_p99_ms`` UNDER the chaos — the latency
+    a client actually sees while the fleet loses, wedges and grows
+    replicas."""
+    import tempfile
+
+    import jax
+
+    from fakepta_tpu.serve import ArraySpec, run_elastic_loadgen
+
+    if jax.devices()[0].platform != "cpu":
+        spec = ArraySpec(npsr=40, ntoa=260, n_red=10, n_dm=10,
+                         gwb_ncomp=10)
+        n_requests, transport = 96, "process"
+    else:
+        spec = ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4, gwb_ncomp=4)
+        n_requests, transport = 48, "inproc"
+    cache = tempfile.mkdtemp(prefix="elastic_cache_")
+    row = run_elastic_loadgen(
+        spec=spec, n_replicas=3, transport=transport,
+        n_requests=n_requests, sizes=(1, 2, 4), n_specs=6, seed=7,
+        verify=3, compile_cache_dir=cache)
+    if row["fleet_lost_requests"] or row["fleet_timeouts"]:
+        raise RuntimeError(
+            "the elastic chaos run lost requests or timed clients out — "
+            "the lifecycle plane is broken, refusing to record its row")
+    if not row.get("fleet_joins"):
+        raise RuntimeError(
+            "the autoscaler never joined a replica — the scale-up path "
+            "is broken, refusing to record its row")
+    if row.get("fleet_join_steady_compiles"):
+        raise RuntimeError(
+            "the autoscale-joined replica compiled in steady state — the "
+            "shared-cache warm join is broken, refusing to record its row")
+    if row.get("fleet_wedge_state") not in ("suspect", "wedged"):
+        raise RuntimeError(
+            "the wedged replica was never breakered — the health plane "
+            "missed it, refusing to record its row")
+    return {"config": 15,
+            "metric": "client p99 under elastic chaos (wedge + kill + "
+                      "autoscale-join, zero lost/timed-out)",
+            "value": row.get("fleet_p99_ms", 0.0), "unit": "ms", **row}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -721,7 +775,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
                     default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
-                             14])
+                             14, 15])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--nreal-scale", type=float, default=1.0,
@@ -748,7 +802,8 @@ def main():
 
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
-           11: config11, 12: config12, 13: config13, 14: config14}
+           11: config11, 12: config12, 13: config13, 14: config14,
+           15: config15}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
     # platform identity single-sourced through the tuner's fingerprint

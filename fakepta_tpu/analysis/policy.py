@@ -144,6 +144,15 @@ UNBOUNDED_QUEUE_MODULES = (
     "fakepta_tpu/parallel/pipeline.py",
 )
 
+# unbounded-thread-join allowlist: library modules whose bare ``.join()``
+# waits are bounded by an EXTERNAL invariant rather than a timeout
+# argument. Currently empty: every shutdown join in the repo carries a
+# generous bound and flight-records the leak when it expires
+# (serve/scheduler.py ``serve_close_join_timeout``, serve/health.py
+# ``health_stop_join_timeout``, serve/loadgen.py
+# ``fleet_spawn_join_timeout`` — docs/RELIABILITY.md shutdown discipline).
+UNBOUNDED_JOIN_MODULES = ()
+
 # unbounded-socket-io allowlist: library modules whose blocking socket
 # reads are bounded by an EXTERNAL invariant rather than a settimeout in
 # scope (e.g. an intentionally-blocking accept loop whose lifetime the

@@ -5,12 +5,12 @@ below, give it a fixture pair in ``tests/fixtures_analysis/`` (one seeded
 true positive, one clean file), and document it in docs/INVARIANTS.md.
 """
 
-from . import (donation, dtype, excepts, hostsync, knobs, meshaxis,
+from . import (donation, dtype, excepts, hostsync, joins, knobs, meshaxis,
                precision, queues, rng, socketio, timing, tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
                               donation, precision, timing, queues, excepts,
-                              knobs, socketio))
+                              knobs, socketio, joins))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)

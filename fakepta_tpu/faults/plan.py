@@ -29,8 +29,15 @@ site                      where it is checked
 ``serve.dispatch``        ServePool's dispatcher thread, per cohort
 ``sample.segment``        SamplingRun.run, before each segment dispatch
 ``fleet.replica``         ServeFleet's router, per dispatch to a replica
+``fleet.heartbeat``       the fleet health monitor, per replica probe
 ``ingest.append``         StreamState.append, at the top of each TOA block
 ========================  ====================================================
+
+``fleet.heartbeat`` is checked inside the monitor's probe path with
+``replica=<id>`` context, so a ``hang`` there is a probe that misses its
+deadline (the wedged-replica simulation: consecutive misses open the
+circuit breaker, docs/RELIABILITY.md "Fleet lifecycle") and a
+``transient`` is one flaky probe.
 
 ``ingest.append`` is checked BEFORE any state mutates, so a raising kind
 (``transient``/``fatal``) leaves the stream untouched and a retry of the
@@ -112,6 +119,12 @@ class FaultSpec:
     engine reaches the site under this plan); ``times`` caps total fires
     (default: one per ``at`` entry). ``hang_s`` is the sleep of a ``hang``
     fault — size it against the watchdog deadline under test.
+
+    ``match`` narrows the spec to site visits whose context carries the
+    given (key, value) pairs — e.g. ``match=(("replica", "r1"),)`` wedges
+    ONE replica's heartbeat probes while its siblings stay healthy. A
+    matched spec keeps its own hit counter over *matching* visits only, so
+    ``at`` stays deterministic no matter how the fleet interleaves probes.
     """
 
     site: str
@@ -119,12 +132,15 @@ class FaultSpec:
     at: Tuple[int, ...] = (0,)
     times: Optional[int] = None
     hang_s: float = 2.0
+    match: Tuple[Tuple[str, str], ...] = ()
 
     def __post_init__(self):
         if self.kind not in KINDS:
             raise ValueError(f"unknown fault kind {self.kind!r}; "
                              f"known: {KINDS}")
         object.__setattr__(self, "at", tuple(int(i) for i in self.at))
+        object.__setattr__(self, "match",
+                           tuple((str(k), str(v)) for k, v in self.match))
 
 
 class FaultPlan:
@@ -145,6 +161,8 @@ class FaultPlan:
         self.fired: list = []         # (site, kind, hit_index) in fire order
         self._remaining = {id(s): (len(s.at) if s.times is None else s.times)
                            for s in self.specs}
+        # matched specs count their own matching visits (FaultSpec.match)
+        self._match_hits = {id(s): 0 for s in self.specs if s.match}
 
     def sites(self) -> Tuple[str, ...]:
         return tuple(sorted({s.site for s in self.specs}))
@@ -158,32 +176,42 @@ class FaultPlan:
         idx = self.hits.get(site, 0)
         self.hits[site] = idx + 1
         for spec in self.specs:
-            if spec.site != site or idx not in spec.at:
+            if spec.site != site:
+                continue
+            if spec.match:
+                if any(str(ctx.get(k)) != v for k, v in spec.match):
+                    continue
+                spec_idx = self._match_hits[id(spec)]
+                self._match_hits[id(spec)] = spec_idx + 1
+            else:
+                spec_idx = idx
+            if spec_idx not in spec.at:
                 continue
             if self._remaining[id(spec)] <= 0:
                 continue
             self._remaining[id(spec)] -= 1
-            self.fired.append((site, spec.kind, idx))
+            self.fired.append((site, spec.kind, spec_idx))
             flightrec.note("fault_fired", site=site, kind=spec.kind,
-                           hit=idx, **{k: v for k, v in ctx.items()
-                                       if isinstance(v, (int, float, str))})
+                           hit=spec_idx,
+                           **{k: v for k, v in ctx.items()
+                              if isinstance(v, (int, float, str))})
             from ..obs import count as _count
             _count("faults.injected")
             if spec.kind == "transient":
                 raise TransientFault(f"injected transient fault at {site} "
-                                     f"(hit {idx})")
+                                     f"(hit {spec_idx})")
             if spec.kind == "fatal":
                 raise FatalFault(f"injected fatal fault at {site} "
-                                 f"(hit {idx})")
+                                 f"(hit {spec_idx})")
             if spec.kind == "degrade":
                 raise DegradeFault(f"injected pallas failure at {site} "
-                                   f"(hit {idx})")
+                                   f"(hit {spec_idx})")
             if spec.kind == "precision":
                 raise PrecisionFault(f"injected bf16 certification failure "
-                                     f"at {site} (hit {idx})")
+                                     f"at {site} (hit {spec_idx})")
             if spec.kind == "kill":
                 raise KillFault(f"injected process kill at {site} "
-                                f"(hit {idx})")
+                                f"(hit {spec_idx})")
             if spec.kind == "hang":
                 time.sleep(spec.hang_s)
                 return "hang"

@@ -121,6 +121,22 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   "fleet_requests", "fleet_kind", "fleet_transport",
                   "fleet_killed_replica", "fleet_verified",
                   "fleet_verified_failover", "fleet_solo_p50_ms",
+                  # fleet lifecycle shape facts (serve/health.py,
+                  # serve/autoscale.py, the config15 elastic chaos lane):
+                  # membership churn, probe volume, which replica the lane
+                  # wedged/joined and what state the breaker reached are
+                  # scenario description — the scripted chaos MAKES them
+                  # nonzero. The regression-bearing lifecycle metrics keep
+                  # the lower-is-better default: fleet_heartbeat_misses /
+                  # fleet_breaker_opens (unscripted misses are a fleet
+                  # degrading), fleet_timeouts, fleet_lost_requests, and
+                  # fleet_join_steady_compiles (any growth past zero is
+                  # the warm-join contract breaking)
+                  "fleet_joins", "fleet_drains", "fleet_probes",
+                  "fleet_breaker_closes", "fleet_breakered",
+                  "fleet_wedged", "fleet_wedge_state",
+                  "fleet_wedged_replica", "fleet_joined_replica",
+                  "scale_events",
                   # chaos-lane shape fact (benchmarks/suite.py config 12):
                   # how many injected faults the run recovered — the
                   # regression-bearing metrics are the recovery counters

@@ -18,6 +18,16 @@ lane), a shared persistent compile cache (replica cold-start = cache
 load), and posterior-as-a-service :class:`SamplingSession`\\ s that
 migrate between replicas at segment-boundary checkpoints.
 
+Fleet lifecycle (docs/RELIABILITY.md "Fleet lifecycle"): the
+:class:`HealthMonitor` heartbeat plane classifies replicas
+healthy/suspect/wedged/dead with a circuit breaker so a *wedged* (not
+dead) replica is drained before traffic times out into it; elastic
+membership (:meth:`ServeFleet.join` / :meth:`ServeFleet.retire` and the
+``serve replica --register`` hello/adopt handshake) grows and shrinks
+the ring live with shared-cache shard prewarm; and the
+:class:`Autoscaler` turns the fleet SLO rollups into a target replica
+count with hysteresis + cooldown.
+
 Streaming ingestion (docs/STREAMING.md): :class:`AppendRequest` /
 :class:`StreamRequest` feed named :class:`~fakepta_tpu.stream.StreamState`
 sessions through the pool's :class:`StreamManager` — O(new-block) appends
@@ -39,10 +49,12 @@ CLI: ``python -m fakepta_tpu.serve loadgen|stdin|socket|replica|fleet``
 fleet command prints the multi-replica row).
 """
 
+from .autoscale import AutoscaleConfig, Autoscaler
 from .fleet import (FleetConfig, LocalReplica, ReplicaDead,
                     SampleSessionSpec, SamplingSession, ServeFleet,
                     SocketReplica)
-from .loadgen import run_fleet_loadgen, run_loadgen
+from .health import HealthConfig, HealthMonitor
+from .loadgen import run_elastic_loadgen, run_fleet_loadgen, run_loadgen
 from .pool import PoolEntry, WarmPool
 from .router import HashRing
 from .scheduler import ServeConfig, ServePool, ServeResult
@@ -52,11 +64,13 @@ from .spec import (DEFAULT_BUCKETS, AppendRequest, ArraySpec, InferRequest,
 from .streams import StreamManager
 
 __all__ = [
-    "DEFAULT_BUCKETS", "AppendRequest", "ArraySpec", "FleetConfig",
-    "HashRing", "InferRequest", "LocalReplica", "OSRequest", "PoolEntry",
-    "ReplicaDead", "SampleSessionSpec", "SamplingSession", "ServeBusy",
-    "ServeClosed", "ServeConfig", "ServeError", "ServeFleet", "ServePool",
-    "ServeResult", "ServeTimeout", "SimRequest", "SocketReplica",
-    "StreamManager", "StreamRequest", "WarmPool", "curn_grid_spec",
-    "run_fleet_loadgen", "run_loadgen",
+    "DEFAULT_BUCKETS", "AppendRequest", "ArraySpec", "AutoscaleConfig",
+    "Autoscaler", "FleetConfig", "HashRing", "HealthConfig",
+    "HealthMonitor", "InferRequest", "LocalReplica", "OSRequest",
+    "PoolEntry", "ReplicaDead", "SampleSessionSpec", "SamplingSession",
+    "ServeBusy", "ServeClosed", "ServeConfig", "ServeError", "ServeFleet",
+    "ServePool", "ServeResult", "ServeTimeout", "SimRequest",
+    "SocketReplica", "StreamManager", "StreamRequest", "WarmPool",
+    "curn_grid_spec", "run_elastic_loadgen", "run_fleet_loadgen",
+    "run_loadgen",
 ]

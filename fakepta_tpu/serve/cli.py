@@ -48,8 +48,12 @@ Both answer ``{"id", "ok": true, "stream": {...payload...}}`` — the
 append payload carries latency/bucket/recompile counters plus the rolling
 detection statistic when the stream was opened with ``watch``.
 
-plus two fleet-protocol kinds: ``{"id", "kind": "stats"}`` answers with
-the pool's live SLO summary, and ``{"id", "kind": "sample", "steps": 64,
+plus three fleet-protocol kinds: ``{"id", "kind": "ping"}`` answers
+``{"id", "ok": true, "pong": true}`` inline on the connection thread —
+the health plane's heartbeat probe (serve/health.py): nothing queues
+behind the scheduler, so a missed pong means the process or its socket
+plumbing is stuck, not merely busy; ``{"id", "kind": "stats"}`` answers
+with the pool's live SLO summary; and ``{"id", "kind": "sample", "steps": 64,
 "seed": 7, "spec": {...}, "session": {"n_chains": 4, ...},
 "checkpoint": "/shared/ck"}`` opens a posterior-as-a-service session that
 STREAMS one line per drained segment (``{"id", "ok": true, "seg": k,
@@ -335,6 +339,12 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
             d = json.loads(raw)
             req_id = d.get("id")
             kind = d.get("kind", "sim")
+            if kind == "ping":
+                # heartbeat probe: answered inline on this connection
+                # thread, nothing dispatched — the health plane times the
+                # round-trip against its probe deadline
+                emit_line({"id": req_id, "ok": True, "pong": True})
+                continue
             if kind == "stats":
                 # fleet-protocol introspection: the router audits each
                 # replica's warm-pool health (steady compiles, retraces)
@@ -460,6 +470,34 @@ def _socket_server(pool, args, idle_timeout_s: float):
     return Server((args.host, args.port), Handler)
 
 
+def _register_with_router(register: str, replica_id: str,
+                          serving_port: int, n_devices: int, index: int,
+                          timeout_s: float = 30.0) -> None:
+    """The replica side of the join handshake (docs/RELIABILITY.md "Fleet
+    lifecycle"): dial the router's admin port, send one JSON ``hello``
+    line advertising our serving port, await the ``adopt`` reply. Bounded
+    at every step — a dead router is a loud startup failure."""
+    import socket as socket_mod
+
+    host, _, port_s = register.rpartition(":")
+    conn = socket_mod.create_connection((host or "127.0.0.1", int(port_s)),
+                                        timeout=timeout_s)
+    try:
+        conn.settimeout(timeout_s)
+        conn.sendall((json.dumps(
+            {"event": "hello", "port": int(serving_port),
+             "replica_id": replica_id, "index": int(index),
+             "n_devices": int(n_devices)}) + "\n").encode())
+        line = conn.makefile("rb").readline(MAX_REQUEST_LINE + 1)
+        reply = json.loads(line.decode("utf-8", "replace")) if line else {}
+        if reply.get("event") != "adopt":
+            raise RuntimeError(f"router rejected the join: {reply!r}")
+        flightrec.note("replica_adopted", router=register,
+                       replicas=int(reply.get("replicas", 0)))
+    finally:
+        conn.close()
+
+
 def _cmd_socket(args, banner: bool = False) -> int:
     if getattr(args, "jax_platform", None):
         # the replica endpoint must pin its backend BEFORE the pool's
@@ -492,10 +530,44 @@ def _cmd_socket(args, banner: bool = False) -> int:
         else:
             print(f"serving on {args.host}:{server.server_address[1]} "
                   f"(JSON-lines; ^C to stop)", file=sys.stderr)
+        register = getattr(args, "register", None)
+        register_failed = []
+        if register:
+            # the handshake MUST run while the server is accepting: the
+            # router's _adopt pre-warms the joiner over its serving port
+            # BEFORE sending the adopt reply, so registering from the
+            # main thread ahead of serve_forever() deadlocks — router
+            # waits on a prewarm the replica cannot serve, replica waits
+            # on an adopt the router cannot send — until the reply read
+            # times out and the replica dies with its listener's embryo
+            # connections RST. Register from a side thread instead;
+            # failure shuts the server down loudly.
+            rid = (getattr(args, "replica_id", None)
+                   or f"replica-{server.server_address[1]}")
+
+            def _register():
+                try:
+                    _register_with_router(register, rid,
+                                          server.server_address[1],
+                                          pool.n_devices,
+                                          getattr(args, "index", 0))
+                except (OSError, RuntimeError, ValueError) as exc:
+                    flightrec.note("replica_register_failed",
+                                   error=repr(exc)[:200])
+                    print(f"register with {register} failed: {exc!r}",
+                          file=sys.stderr)
+                    register_failed.append(exc)
+                    server.shutdown()
+
+            threading.Thread(target=_register, name="replica-register",
+                             daemon=True).start()
         try:
             server.serve_forever()
         except KeyboardInterrupt:
             pass
+        if register_failed:
+            pool.close()
+            return 2
     if args.report:
         rep = pool.report()
         rep.meta["process_index"] = int(getattr(args, "index", 0))
@@ -608,6 +680,14 @@ def build_parser() -> argparse.ArgumentParser:
                     help="enable jax x64 mode (a replica must match its "
                          "router's mode or scalar promotion desyncs the "
                          "bit-identity contract)")
+    rp.add_argument("--register", default=None, metavar="HOST:PORT",
+                    help="dial a running router's admin port "
+                         "(ServeFleet.listen) and join its ring via the "
+                         "hello/adopt handshake (docs/RELIABILITY.md "
+                         "'Fleet lifecycle')")
+    rp.add_argument("--replica-id", default=None,
+                    help="fleet identity to join as "
+                         "(default: replica-<port>)")
 
     fl = sub.add_parser("fleet", help="multi-replica load benchmark: one "
                                       "JSON row of fleet SLO metrics")

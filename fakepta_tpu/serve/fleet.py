@@ -55,6 +55,7 @@ import socket
 import subprocess
 import sys
 import threading
+import time
 from concurrent.futures import Future
 from pathlib import Path
 from typing import List, Optional, Sequence, Tuple
@@ -67,7 +68,7 @@ from ..obs import flightrec
 from .router import HashRing
 from .scheduler import ServeConfig, ServePool, ServeResult
 from .spec import (ArraySpec, ServeBusy, ServeClosed, ServeError,
-                   resolve_spec_hash)
+                   SimRequest, resolve_spec_hash)
 
 #: maximum protocol line a replica client will read before declaring the
 #: frame malformed (mirrors the server-side bound in serve/cli.py)
@@ -170,6 +171,13 @@ class LocalReplica:
                            data_seed=sess.data_seed,
                            compile_cache_dir=self._compile_cache_dir)
 
+    def ping(self, deadline_s: float = 1.0) -> bool:
+        """Health probe (serve/health.py): alive means the pool's
+        dispatcher thread is actually running, not just the flag."""
+        if not self.alive or not self.pool._dispatcher.is_alive():
+            raise ReplicaDead(f"replica {self.id} dispatcher is gone")
+        return True
+
     def kill(self) -> None:
         """Simulated replica death: pending work fails like a crashed
         process (the in-process analog of SIGKILL for the chaos tests)."""
@@ -193,48 +201,65 @@ class SocketReplica:
     triggers the router's mid-flight failover.
     """
 
-    def __init__(self, replica_id: str, spec_defaults: ArraySpec,
+    def __init__(self, replica_id: str, spec_defaults: Optional[ArraySpec] = None,
                  compile_cache_dir: Optional[str] = None,
                  buckets: Optional[Sequence[int]] = None, index: int = 0,
                  devices: Optional[int] = 1, jax_platform: str = "cpu",
                  startup_timeout_s: float = 120.0,
-                 io_timeout_s: float = 600.0, report_path=None):
+                 io_timeout_s: float = 600.0, report_path=None,
+                 connect: Optional[Tuple[str, int]] = None,
+                 n_devices: int = 1):
         self.id = str(replica_id)
         self.index = int(index)
         self.alive = False
         self._lock = threading.Lock()
         self._pending: dict = {}          # req id -> Future
         self._next_id = 0
-        cmd = [sys.executable, "-m", "fakepta_tpu.serve", "replica",
-               "--port", "0", "--emit", "full",
-               "--index", str(self.index),
-               "--npsr", str(spec_defaults.npsr),
-               "--ntoa", str(spec_defaults.ntoa)]
-        if jax_platform:
-            cmd += ["--jax-platform", jax_platform]
-        if devices:
-            cmd += ["--devices", str(devices)]
-        import jax
-        if jax.config.jax_enable_x64:
-            # the replica must share the router's x64 mode: scalar
-            # promotion differences would break response bit-identity
-            cmd += ["--x64"]
-        if compile_cache_dir:
-            cmd += ["--compile-cache", str(compile_cache_dir)]
-        if buckets:
-            cmd += ["--buckets"] + [str(b) for b in buckets]
-        if report_path is not None:
-            cmd += ["--report", str(report_path)]
-        # the package root on the child's import path regardless of the
-        # caller's cwd (python -m resolves from cwd)
-        pkg_root = str(Path(__file__).resolve().parents[2])
-        self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                                     stderr=subprocess.DEVNULL, text=True,
-                                     cwd=pkg_root)
-        banner = self._read_banner(startup_timeout_s)
-        self.port = int(banner["port"])
-        self.n_devices = int(banner.get("n_devices", 1))
-        self.sock = socket.create_connection(("127.0.0.1", self.port),
+        if connect is not None:
+            # attach mode (the join handshake, docs/RELIABILITY.md "Fleet
+            # lifecycle"): the replica process already exists — it dialed
+            # the router's admin port with a `hello` — so there is nothing
+            # to spawn; we connect to its advertised serving port. kill()
+            # severs the connection instead of killing a process we do
+            # not own.
+            self.proc = None
+            host, self.port = str(connect[0]), int(connect[1])
+            self.n_devices = int(n_devices)
+        else:
+            if spec_defaults is None:
+                raise ValueError("spawn mode needs spec_defaults "
+                                 "(attach mode passes connect=)")
+            cmd = [sys.executable, "-m", "fakepta_tpu.serve", "replica",
+                   "--port", "0", "--emit", "full",
+                   "--index", str(self.index),
+                   "--npsr", str(spec_defaults.npsr),
+                   "--ntoa", str(spec_defaults.ntoa)]
+            if jax_platform:
+                cmd += ["--jax-platform", jax_platform]
+            if devices:
+                cmd += ["--devices", str(devices)]
+            import jax
+            if jax.config.jax_enable_x64:
+                # the replica must share the router's x64 mode: scalar
+                # promotion differences would break response bit-identity
+                cmd += ["--x64"]
+            if compile_cache_dir:
+                cmd += ["--compile-cache", str(compile_cache_dir)]
+            if buckets:
+                cmd += ["--buckets"] + [str(b) for b in buckets]
+            if report_path is not None:
+                cmd += ["--report", str(report_path)]
+            # the package root on the child's import path regardless of the
+            # caller's cwd (python -m resolves from cwd)
+            pkg_root = str(Path(__file__).resolve().parents[2])
+            self.proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                                         stderr=subprocess.DEVNULL, text=True,
+                                         cwd=pkg_root)
+            banner = self._read_banner(startup_timeout_s)
+            self.port = int(banner["port"])
+            self.n_devices = int(banner.get("n_devices", 1))
+            host = "127.0.0.1"
+        self.sock = socket.create_connection((host, self.port),
                                              timeout=io_timeout_s)
         # the connect timeout persists as the I/O deadline: a wedged (not
         # just dead) replica surfaces as a timed-out read -> ReplicaDead
@@ -363,17 +388,58 @@ class SocketReplica:
     def retry_hint(self) -> float:
         return 0.0
 
+    def ping(self, deadline_s: float = 1.0) -> bool:
+        """Health probe over the mux'd connection (protocol kind
+        ``ping`` — answered inline by the replica's connection thread, no
+        scheduler queue behind it, so a miss means the process or its
+        socket plumbing is stuck, not merely busy). A deadline expiry
+        raises; the late pong, if it ever lands, resolves a future nobody
+        holds."""
+        import concurrent.futures
+
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        fut: Future = Future()
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+            try:
+                self.sock.sendall(
+                    (json.dumps({"id": req_id, "kind": "ping"}) + "\n")
+                    .encode())
+            except OSError as exc:
+                self._pending.pop(req_id, None)
+                self._die_locked(repr(exc))
+                raise ReplicaDead(
+                    f"replica {self.id} send failed: {exc!r}") from exc
+        try:
+            fut.result(timeout=deadline_s)
+        except concurrent.futures.TimeoutError:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
+        return True
+
     def kill(self) -> None:
         """SIGKILL the replica process (the chaos lever: in-flight
-        requests fail over through the reader thread's EOF)."""
-        self.proc.kill()
+        requests fail over through the reader thread's EOF); an adopted
+        replica (attach mode) has no process handle — severing the
+        connection is the same lever."""
+        if self.proc is not None:
+            self.proc.kill()
+        else:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
 
     def close(self) -> None:
         try:
             self.sock.close()
         except OSError:
             pass
-        if self.proc.poll() is None:
+        if self.proc is not None and self.proc.poll() is None:
             self.proc.terminate()
             try:
                 self.proc.wait(timeout=30)
@@ -386,6 +452,8 @@ def _result_from_json(d: dict):
     """A full-emit response line -> :class:`ServeResult` (the socket
     transport reconstitutes exactly what the in-process pool returns; a
     ``stats`` or stream payload passes through as a dict)."""
+    if "pong" in d and "curves" not in d:
+        return {"pong": True}
     if "stats" in d and "curves" not in d:
         return d["stats"]
     if "stream" in d and "curves" not in d:
@@ -420,6 +488,8 @@ class _FleetStats:
         self.failovers = 0
         self.spillovers = 0
         self.deaths = 0
+        self.joins = 0
+        self.drains = 0
         self.owner_served = 0
         self.per_replica = collections.Counter()
         self.t_first = None
@@ -447,6 +517,13 @@ class ServeFleet:
         self._inflight = collections.Counter()      # replica id -> count
         self._stats = _FleetStats(self.config.result_window)
         self._closed = False
+        # the served working set (spec -> buckets it ran at), LRU-bounded:
+        # what join() prewarms onto a new replica's absorbed shard
+        self._recent: "collections.OrderedDict" = collections.OrderedDict()
+        self._recent_cap = 64
+        self.health = None                 # HealthMonitor, enable_health()
+        self._admin_sock = None            # the join-handshake listener
+        self._admin_thread = None
         flightrec.note("fleet_start", replicas=len(replicas))
 
     # -- chip accounting ---------------------------------------------------
@@ -491,8 +568,11 @@ class ServeFleet:
                 {"kind": "registered", "name": req.spec})
         outer: Future = Future()
         t = obs.now()
-        inf = _Inflight(req, spec_hash, outer, t,
-                        owner_id=self.ring.owner(spec_hash))
+        # ring reads under the fleet lock: membership mutates live now
+        # (join/retire), and HashRing is not internally synchronized
+        with self._lock:
+            owner = self.ring.owner(spec_hash)
+        inf = _Inflight(req, spec_hash, outer, t, owner_id=owner)
         with self._lock:
             self._stats.submitted += 1
             if self._stats.t_first is None:
@@ -526,11 +606,18 @@ class ServeFleet:
         # ServeBusy, not a sibling (dead owners ARE skipped — failover
         # re-opens the stream, continuous via a shared checkpoint)
         affine = bool(getattr(inf.req, "stream_affine", False))
-        for rid in self.ring.preference(inf.spec_hash):
+        hm = self.health
+        with self._lock:
+            pref = list(self.ring.preference(inf.spec_hash))
+        for rid in pref:
             if rid in exclude:
                 continue
-            replica = self.replicas[rid]
-            if not replica.alive:
+            replica = self.replicas.get(rid)
+            if replica is None or not replica.alive:
+                continue
+            if hm is not None and not hm.routable(rid):
+                # breaker open (suspect/wedged): the health plane drained
+                # this replica BEFORE any request could time out into it
                 continue
             with self._lock:
                 saturated = (self._inflight[rid]
@@ -630,6 +717,17 @@ class ServeFleet:
             else:
                 res.replica = rid
                 res.failovers = inf.failovers
+                # remember the served working set: (spec, bucket) pairs a
+                # joining replica prewarms for its absorbed shard
+                if not isinstance(getattr(inf.req, "spec", None), str) \
+                        and getattr(inf.req, "spec", None) is not None:
+                    with self._lock:
+                        _spec, buckets = self._recent.setdefault(
+                            inf.spec_hash, (inf.req.spec, set()))
+                        buckets.add(int(res.bucket))
+                        self._recent.move_to_end(inf.spec_hash)
+                        while len(self._recent) > self._recent_cap:
+                            self._recent.popitem(last=False)
             t_done = obs.now()
             with self._lock:
                 st = self._stats
@@ -707,6 +805,9 @@ class ServeFleet:
                 if lat.size else 0.0,
                 "fleet_failovers": st.failovers,
                 "fleet_spillovers": st.spillovers,
+                "fleet_timeouts": st.cancelled,
+                "fleet_joins": st.joins,
+                "fleet_drains": st.drains,
                 # derived, not the router's counter: a death detected by
                 # the transport alone (reader EOF with nothing in flight)
                 # must still show up here
@@ -742,6 +843,9 @@ class ServeFleet:
         if seen:
             out["fleet_steady_compiles"] = compiles
             out["fleet_retraces"] = retraces
+        hm = self.health
+        if hm is not None:
+            out.update(hm.stats())
         return out
 
     def reset_stats(self) -> None:
@@ -749,6 +853,8 @@ class ServeFleet:
         boundary); replica pools reset theirs separately."""
         with self._lock:
             self._stats = _FleetStats(self.config.result_window)
+        if self.health is not None:
+            self.health.reset_counters()
         for r in self.replicas.values():
             if isinstance(r, LocalReplica) and r.alive:
                 r.pool.reset_stats()
@@ -783,12 +889,187 @@ class ServeFleet:
         unit on failover."""
         return SamplingSession(self, sess, checkpoint)
 
+    # -- health plane ------------------------------------------------------
+    def enable_health(self, config=None):
+        """Start the heartbeat monitor (:mod:`.health`): out-of-band
+        ``ping`` probes classify replicas healthy/suspect/wedged/dead and
+        open a circuit breaker BEFORE user traffic times out into a
+        wedged replica. Idempotent; stopped by :meth:`close`."""
+        from .health import HealthMonitor
+
+        if self.health is None:
+            self.health = HealthMonitor(self, config).start()
+        return self.health
+
+    # -- elastic membership ------------------------------------------------
+    def join(self, replica, prewarm: bool = True,
+             warm_timeout_s: float = 300.0) -> dict:
+        """Adopt ``replica`` into the ring (docs/RELIABILITY.md "Fleet
+        lifecycle"): compute the ~1/N shard the post-join ring will route
+        to it, prewarm that shard's served working set directly on the
+        replica (shared-compile-cache warm loads — 0 steady compiles),
+        then add it to the membership under the lock. Prewarm happens
+        BEFORE the ring flips so no request ever lands on a cold shard.
+        """
+        with self._lock:
+            if self._closed:
+                raise ServeClosed("fleet is closed")
+            if replica.id in self.replicas:
+                raise ValueError(
+                    f"replica {replica.id!r} is already in the fleet")
+            existing = list(self.replicas)
+            recent = [(sh, spec, tuple(sorted(buckets)))
+                      for sh, (spec, buckets) in self._recent.items()]
+        warm_loads = 0
+        if prewarm and recent:
+            tmp = HashRing(existing + [replica.id],
+                           vnodes=self.config.vnodes)
+            for sh, spec, buckets in recent:
+                if tmp.owner(sh) != replica.id:
+                    continue
+                for b in buckets:
+                    try:
+                        replica.submit(
+                            SimRequest(spec=spec, n=int(b), seed=0)
+                        ).result(timeout=warm_timeout_s)
+                        warm_loads += 1
+                    except (ServeError, OSError, RuntimeError) as exc:
+                        flightrec.note("fleet_join_prewarm_failed",
+                                       replica=replica.id,
+                                       error=repr(exc)[:160])
+        with self._lock:
+            self.replicas[replica.id] = replica
+            self.ring.add(replica.id)
+            self._stats.joins += 1
+        obs.count("fleet.joins")
+        flightrec.note("fleet_join", replica=replica.id,
+                       warm_loads=warm_loads, replicas=len(self.replicas))
+        return {"replica": replica.id, "warm_loads": warm_loads}
+
+    def retire(self, rid: str, drain_timeout_s: float = 60.0) -> None:
+        """Graceful leave: pull ``rid`` off the ring first (no new routes
+        — its shard remaps ~1/N to the survivors, whose shared-cache
+        loads keep it warm), drain its in-flight work with a bounded
+        wait, then close it. Long-running sampling/stream sessions resume
+        on the shard's new owner from their checkpoint boundaries (the
+        PR 12/14 migration machinery)."""
+        with self._lock:
+            r = self.replicas.get(rid)
+            if r is None:
+                raise ValueError(f"replica {rid!r} is not in the fleet")
+            live = [x for x in self.replicas.values() if x.alive]
+            if r.alive and len(live) <= 1:
+                raise ServeError("cannot retire the last live replica")
+            self.ring.remove(rid)
+        deadline = obs.now() + drain_timeout_s
+        drained = False
+        while obs.now() < deadline:
+            with self._lock:
+                if self._inflight[rid] <= 0:
+                    drained = True
+                    break
+            time.sleep(0.01)
+        if not drained:
+            flightrec.note("fleet_drain_timeout", replica=rid,
+                           timeout_s=drain_timeout_s)
+        with self._lock:
+            self.replicas.pop(rid, None)
+            self._stats.drains += 1
+        if self.health is not None:
+            self.health.forget(rid)
+        obs.count("fleet.drains")
+        flightrec.note("fleet_drain", replica=rid, drained=bool(drained),
+                       replicas=len(self.replicas))
+        try:
+            r.close()
+        except (ServeError, OSError, RuntimeError) as exc:
+            flightrec.note("fleet_replica_close_failed", replica=rid,
+                           error=repr(exc)[:160])
+
+    def listen(self, host: str = "127.0.0.1", port: int = 0) -> int:
+        """The replica-join handshake listener: a freshly spawned
+        ``serve replica --register HOST:PORT`` process dials this socket,
+        sends one JSON ``hello`` line (its serving port + identity), and
+        is adopted via :class:`SocketReplica` attach mode + :meth:`join`;
+        the reply line is ``adopt`` (or ``reject`` with the error).
+        Returns the bound admin port. Idempotent."""
+        if self._admin_sock is not None:
+            return self._admin_sock.getsockname()[1]
+        srv = socket.create_server((host, port))
+        srv.settimeout(0.25)       # bounded accept: close() can stop us
+        self._admin_sock = srv
+        self._admin_thread = threading.Thread(
+            target=self._admin_loop, name="fleet-admin", daemon=True)
+        self._admin_thread.start()
+        admin_port = srv.getsockname()[1]
+        flightrec.note("fleet_listen", port=admin_port)
+        return admin_port
+
+    def _admin_loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                conn, addr = self._admin_sock.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return                    # listener closed
+            try:
+                self._adopt(conn, addr)
+            except (ServeError, OSError, ValueError, RuntimeError,
+                    KeyError) as exc:
+                flightrec.note("fleet_adopt_failed",
+                               error=repr(exc)[:200])
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+    def _adopt(self, conn, addr) -> None:
+        conn.settimeout(30.0)
+        raw = conn.makefile("rb").readline(MAX_LINE_BYTES + 1)
+        hello = json.loads(raw.decode("utf-8", "replace"))
+        if hello.get("event") != "hello" or "port" not in hello:
+            conn.sendall((json.dumps(
+                {"event": "reject", "error": "bad hello"}) + "\n").encode())
+            raise ValueError(f"bad hello line: {raw[:200]!r}")
+        rid = str(hello.get("replica_id") or f"joined-{hello['port']}")
+        try:
+            rep = SocketReplica(rid,
+                                connect=(addr[0], int(hello["port"])),
+                                index=int(hello.get("index", 0)),
+                                n_devices=int(hello.get("n_devices", 1)))
+            self.join(rep)
+        except BaseException as exc:
+            conn.sendall((json.dumps(
+                {"event": "reject",
+                 "error": repr(exc)[:200]}) + "\n").encode())
+            raise
+        conn.sendall((json.dumps(
+            {"event": "adopt", "replica_id": rid,
+             "replicas": len(self.replicas)}) + "\n").encode())
+
     # -- lifecycle ---------------------------------------------------------
     def close(self) -> None:
         with self._lock:
             if self._closed:
                 return
             self._closed = True
+        if self.health is not None:
+            self.health.stop()
+        if self._admin_sock is not None:
+            try:
+                self._admin_sock.close()
+            except OSError:
+                pass
+            t = self._admin_thread
+            if t is not None:
+                t.join(5.0)
+                if t.is_alive():
+                    flightrec.note("fleet_admin_join_timeout")
         for r in self.replicas.values():
             try:
                 r.close()
@@ -876,12 +1157,16 @@ class SamplingSession:
         self.checkpoint = Path(checkpoint)
         self.session_hash = sess.session_hash()
         self.migrations = 0
-        self.replica_id = fleet.ring.owner(self.session_hash)
+        with fleet._lock:
+            self.replica_id = fleet.ring.owner(self.session_hash)
 
     def _next_replica(self, exclude):
-        for rid in self.fleet.ring.preference(self.session_hash):
-            r = self.fleet.replicas[rid]
-            if r.alive and rid not in exclude and hasattr(r, "sampling_run"):
+        with self.fleet._lock:
+            pref = list(self.fleet.ring.preference(self.session_hash))
+        for rid in pref:
+            r = self.fleet.replicas.get(rid)
+            if (r is not None and r.alive and rid not in exclude
+                    and hasattr(r, "sampling_run")):
                 return rid
         raise ServeError("no live replica can host the sampling session")
 

@@ -23,7 +23,9 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from .. import faults as faults_mod
 from .. import obs
+from ..obs import flightrec
 from .scheduler import ServeConfig, ServePool
 from .spec import ArraySpec, InferRequest, OSRequest, ServeBusy, SimRequest
 
@@ -266,7 +268,12 @@ def _build_fleet(n_replicas: int, transport: str, spec: ArraySpec,
     for t in threads:
         t.start()
     for t in threads:
-        t.join()
+        # bounded: a wedged replica spawn surfaces as a loud startup
+        # failure (its None slot below), never a hung loadgen (the
+        # unbounded-thread-join invariant, docs/INVARIANTS.md)
+        t.join(180.0)
+        if t.is_alive():
+            flightrec.note("fleet_spawn_join_timeout", timeout_s=180.0)
     if errs or any(r is None for r in out):
         for r in out:
             if r is not None:
@@ -284,6 +291,41 @@ def _submit_politely(fleet, req, futs):
             return
         except ServeBusy as busy:
             time.sleep(max(getattr(busy, "retry_after_s", 0.0), 0.002))
+
+
+def _verify_fleet_responses(reqs, results, verify: int, seed: int, mesh,
+                            compile_cache_dir) -> set:
+    """The RNG-lane contract on fleet traffic: ``verify`` sampled
+    responses PLUS every failed-over response, bit-compared against the
+    same request served alone at the same bucket shape. Returns the
+    verified index set (shared by the fleet and elastic loadgen modes)."""
+    rng = np.random.default_rng(seed + 1)
+    done = [i for i, r in enumerate(results) if r is not None]
+    picks = set(rng.choice(done, size=min(verify, len(done)),
+                           replace=False).tolist())
+    picks |= {i for i in done if results[i].failovers > 0}
+    sims: dict = {}
+    import jax
+    from ..parallel.mesh import make_mesh
+
+    solo_mesh = mesh or make_mesh(jax.devices()[:1])
+    for i in sorted(picks):
+        r, res = reqs[i], results[i]
+        sh = r.spec.spec_hash()
+        if sh not in sims:
+            sims[sh] = r.spec.build(mesh=solo_mesh,
+                                    compile_cache_dir=compile_cache_dir)
+        alone = sims[sh].run(res.bucket, chunk=res.bucket,
+                             lanes=[(r.seed, r.n)],
+                             pipeline_depth=0, **r.run_kwargs())
+        if not (np.array_equal(alone["curves"][:r.n], res.curves)
+                and np.array_equal(alone["autos"][:r.n], res.autos)):
+            raise AssertionError(
+                f"fleet response for request {i} (replica "
+                f"{res.replica}, failovers {res.failovers}) "
+                f"differs from the same request served alone — "
+                f"the RNG-lane contract is broken")
+    return picks
 
 
 def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
@@ -340,7 +382,6 @@ def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
                 flt._mark_dead(kill_rid, "loadgen chaos kill")
                 flt.replicas[kill_rid].kill()
             _submit_politely(flt, r, futs)
-        from ..obs import flightrec
         results, lost = [], 0
         for f in futs:
             try:
@@ -360,37 +401,8 @@ def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
             row["fleet_killed_replica"] = kill_rid
 
         if verify:
-            # the RNG-lane contract on fleet traffic: sampled responses
-            # PLUS every failed-over response, bit-compared against the
-            # same request served alone at the same bucket shape
-            rng = np.random.default_rng(seed + 1)
-            done = [i for i, r in enumerate(results) if r is not None]
-            picks = set(rng.choice(done, size=min(verify, len(done)),
-                                   replace=False).tolist())
-            picks |= {i for i in done if results[i].failovers > 0}
-            sims: dict = {}
-            import jax
-            from ..parallel.mesh import make_mesh
-
-            solo_mesh = mesh or make_mesh(jax.devices()[:1])
-            for i in sorted(picks):
-                r, res = reqs[i], results[i]
-                sh = r.spec.spec_hash()
-                if sh not in sims:
-                    sims[sh] = r.spec.build(
-                        mesh=solo_mesh,
-                        compile_cache_dir=compile_cache_dir)
-                alone = sims[sh].run(res.bucket, chunk=res.bucket,
-                                     lanes=[(r.seed, r.n)],
-                                     pipeline_depth=0, **r.run_kwargs())
-                if not (np.array_equal(alone["curves"][:r.n], res.curves)
-                        and np.array_equal(alone["autos"][:r.n],
-                                           res.autos)):
-                    raise AssertionError(
-                        f"fleet response for request {i} (replica "
-                        f"{res.replica}, failovers {res.failovers}) "
-                        f"differs from the same request served alone — "
-                        f"the RNG-lane contract is broken")
+            picks = _verify_fleet_responses(reqs, results, verify, seed,
+                                            mesh, compile_cache_dir)
             row["fleet_verified"] = len(picks)
             row["fleet_verified_failover"] = sum(
                 1 for i in picks if results[i].failovers > 0)
@@ -436,4 +448,175 @@ def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
         if row["fleet_solo_qps"] > 0 and row.get("fleet_qps"):
             row["fleet_speedup_x"] = round(
                 row["fleet_qps"] / row["fleet_solo_qps"], 2)
+    return row
+
+
+# ---------------------------------------------------------------------------
+# elastic chaos mode — docs/RELIABILITY.md "Fleet lifecycle"
+# ---------------------------------------------------------------------------
+
+def run_elastic_loadgen(spec: Optional[ArraySpec] = None, *,
+                        n_replicas: int = 3, transport: str = "inproc",
+                        n_requests: int = 96,
+                        sizes: Sequence[int] = (1, 2, 4),
+                        kind: str = "sim", seed: int = 0, verify: int = 3,
+                        n_specs: int = 6, wedge_at: float = 0.2,
+                        kill_at: float = 0.45, join_at: float = 0.7,
+                        config=None,
+                        compile_cache_dir: Optional[str] = None,
+                        mesh=None, health_config=None,
+                        hang_s: Optional[float] = None) -> dict:
+    """The fleet lifecycle A/B: ramp load, wedge one replica, SIGKILL
+    another, autoscale a third in — one row of acceptance evidence.
+
+    At ``wedge_at`` of submissions a ``fleet.heartbeat`` hang fault
+    (matched to one replica via :class:`~fakepta_tpu.faults.FaultSpec`'s
+    ``match``) wedges that replica's probes: the health plane must
+    breaker it — drained of new routes with ZERO client-visible timeouts,
+    because the wedge is caught out of band, never by user traffic. At
+    ``kill_at`` a different replica is killed outright (the config13
+    failover lever). At ``join_at`` the autoscaler (tiny
+    ``target_qps_per_replica``, zero cooldown — a deterministic scale-up)
+    spawns and joins a fresh replica that prewarms its absorbed shard
+    from the shared compile cache (0 steady compiles).
+
+    Acceptance, recorded in the row: ``fleet_lost_requests == 0``,
+    ``fleet_timeouts == 0``, the wedged replica breakered
+    (``fleet_wedge_state`` suspect/wedged, ``fleet_breaker_opens >= 1``),
+    ``fleet_joins >= 1`` with ``fleet_join_steady_compiles == 0``, and
+    every failed-over response bit-verified like any other
+    (:func:`_verify_fleet_responses`).
+    """
+    import dataclasses as dc
+
+    from .autoscale import AutoscaleConfig, Autoscaler
+    from .fleet import LocalReplica, SocketReplica
+    from .health import HealthConfig
+
+    base = spec or ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4, gwb_ncomp=4)
+    specs = [dc.replace(base, data_seed=100 + i) for i in range(n_specs)]
+    reqs = make_fleet_requests(specs, n_requests, sizes, kind=kind,
+                               seed=seed)
+    if config is None:
+        from ..tune import defaults as tune_defaults
+        config = ServeConfig(buckets=tune_defaults.DEFAULT_FLEET_BUCKETS)
+    warm_buckets = sorted({int(b) for b in config.buckets})
+    hc = health_config or HealthConfig(
+        period_s=0.05, probe_deadline_s=0.05, suspect_after=2,
+        wedged_after=4, close_after=2, backoff_base_s=0.05,
+        backoff_cap_s=0.2)
+    hang_s = hang_s if hang_s is not None else 4.0 * hc.probe_deadline_s
+    flt = _build_fleet(n_replicas, transport, base, config,
+                       compile_cache_dir, mesh)
+    joined_id = None
+    fault_cm = None
+    try:
+        for s in specs:
+            for b in warm_buckets:
+                flt.serve(dc.replace(reqs[0], spec=s, n=b, seed=0),
+                          timeout=600.0)
+        flt.enable_health(hc)
+        flt.reset_stats()
+
+        # victims, chosen BEFORE any membership change: the kill victim
+        # owns the first spec; the wedge victim owns some other spec (or
+        # is any other live replica when the ring gives one owner both)
+        kill_rid = flt.ring.owner(specs[0].spec_hash())
+        wedge_rid = next(
+            (flt.ring.owner(s.spec_hash()) for s in specs[1:]
+             if flt.ring.owner(s.spec_hash()) != kill_rid),
+            next(r for r in flt.replicas if r != kill_rid))
+
+        def spawn(index):
+            rid = f"scale{index}"
+            if transport == "inproc":
+                import jax
+                from ..parallel.mesh import make_mesh
+                return LocalReplica(
+                    rid, mesh=mesh or make_mesh(jax.devices()[:1]),
+                    config=config, compile_cache_dir=compile_cache_dir,
+                    index=index)
+            return SocketReplica(
+                rid, spec_defaults=base,
+                compile_cache_dir=compile_cache_dir,
+                buckets=tuple(config.buckets), index=index)
+
+        scaler = Autoscaler(flt, spawn, AutoscaleConfig(
+            min_replicas=1, max_replicas=n_replicas + 2,
+            target_qps_per_replica=1e-6, cooldown_s=0.0))
+
+        wedge_idx = int(wedge_at * len(reqs))
+        kill_idx = int(kill_at * len(reqs))
+        join_idx = int(join_at * len(reqs))
+        futs: list = []
+        for i, r in enumerate(reqs):
+            if i == wedge_idx and faults_mod.active() is None:
+                fault_cm = faults_mod.inject(faults_mod.FaultPlan([
+                    faults_mod.FaultSpec(
+                        "fleet.heartbeat", "hang", at=tuple(range(512)),
+                        times=512, hang_s=hang_s,
+                        match=(("replica", wedge_rid),))]))
+                fault_cm.__enter__()
+            if i == kill_idx:
+                flt._mark_dead(kill_rid, "elastic loadgen chaos kill")
+                flt.replicas[kill_rid].kill()
+            if i == join_idx:
+                # the scale-up must be deterministic: a window that has
+                # seen <2 completions reads fleet_qps=0.0 (span 0), which
+                # the policy would rightly call over-provisioned and
+                # scale DOWN — wait (bounded) for measurable throughput,
+                # then demand/target_qps trivially exceeds alive -> up
+                jd = obs.now() + 60.0
+                while (obs.now() < jd
+                       and flt.slo_summary().get("fleet_qps", 0.0) <= 0.0):
+                    time.sleep(0.01)
+                decision = scaler.step()
+                if decision.get("action") == "up":
+                    joined_id = decision.get("replica")
+            _submit_politely(flt, r, futs)
+        results, lost = [], 0
+        for f in futs:
+            try:
+                results.append(f.result(timeout=600.0))
+            except Exception as exc:   # noqa: BLE001 — recorded + counted
+                flightrec.note("fleet_request_lost", error=repr(exc)[:200])
+                results.append(None)
+                lost += 1
+        # the wedge is caught out of band: give the monitor a bounded
+        # window to accumulate its consecutive misses before reading the
+        # breaker state (the probes hang for ``hang_s`` each)
+        deadline = obs.now() + 20.0 * hang_s + 2.0
+        while (obs.now() < deadline
+               and flt.health.state(wedge_rid) == "healthy"):
+            time.sleep(0.02)
+        row = dict(flt.slo_summary())
+        row["fleet_kind"] = kind
+        row["fleet_transport"] = transport
+        row["fleet_lost_requests"] = lost
+        row["fleet_killed_replica"] = kill_rid
+        row["fleet_wedged_replica"] = wedge_rid
+        row["fleet_wedge_state"] = flt.health.state(wedge_rid)
+        row["scale_events"] = scaler.scale_events
+        if joined_id is not None:
+            row["fleet_joined_replica"] = joined_id
+            joined = flt.replicas.get(joined_id)
+            if joined is not None and joined.alive:
+                try:
+                    js = (joined.slo_summary()
+                          if hasattr(joined, "slo_summary")
+                          else joined.stats(timeout=60.0))
+                    row["fleet_join_steady_compiles"] = int(
+                        js.get("serve_steady_compiles", 0))
+                except (ServeBusy, OSError, RuntimeError):
+                    pass
+        if verify:
+            picks = _verify_fleet_responses(reqs, results, verify, seed,
+                                            mesh, compile_cache_dir)
+            row["fleet_verified"] = len(picks)
+            row["fleet_verified_failover"] = sum(
+                1 for i in picks if results[i].failovers > 0)
+    finally:
+        if fault_cm is not None:
+            fault_cm.__exit__(None, None, None)
+        flt.close()
     return row

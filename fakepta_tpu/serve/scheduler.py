@@ -54,6 +54,11 @@ from .spec import (DEFAULT_BUCKETS, ArraySpec, ServeBusy, ServeClosed,
 
 _STOP = object()
 
+#: shutdown join bound: generous against any legitimate drain, but finite
+#: — a wedged worker thread becomes a flight-recorder note, not a caller
+#: hung in close() forever
+_SHUTDOWN_JOIN_S = 60.0
+
 
 class _PoisonedOutput(RuntimeError):
     """A dispatch returned non-finite statistics: the executable (or its
@@ -731,9 +736,18 @@ class ServePool:
                         p.fut.set_exception(ServeClosed("pool closed"))
                         self._pending -= 1
             self._cond.notify_all()
-        self._dispatcher.join()
+        # bounded joins: a dispatcher wedged in a hung drain must surface
+        # as a loud note, never hang the caller's shutdown forever (the
+        # unbounded-thread-join invariant, docs/INVARIANTS.md)
+        self._dispatcher.join(_SHUTDOWN_JOIN_S)
+        if self._dispatcher.is_alive():
+            flightrec.note("serve_close_join_timeout", thread="dispatcher",
+                           timeout_s=_SHUTDOWN_JOIN_S)
         self._demux_q.put(_STOP)
-        self._demux_thread.join()
+        self._demux_thread.join(_SHUTDOWN_JOIN_S)
+        if self._demux_thread.is_alive():
+            flightrec.note("serve_close_join_timeout", thread="demux",
+                           timeout_s=_SHUTDOWN_JOIN_S)
         if self._stream_mgr is not None:
             self._stream_mgr.close()
 

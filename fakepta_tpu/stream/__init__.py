@@ -25,16 +25,18 @@ Layers:
   refresh: each data arrival warm-starts a new
   :class:`~fakepta_tpu.sample.SamplingRun` from the previous posterior's
   Laplace mode and final chain state, and promotes the new posterior only
-  through an R-hat gate.
+  through an R-hat gate. :class:`RefreshPolicy` +
+  :meth:`~PosteriorRefresher.maybe_refresh` schedule the cycles (refresh
+  on accumulated appends or rolling-|SNR| movement, never per-append).
 - the served surface — ``AppendRequest``/``StreamRequest``
   (:mod:`fakepta_tpu.serve.spec`), executed by the pool's
   :class:`~fakepta_tpu.serve.streams.StreamManager` and routed by the
   fleet with stream affinity to the owning replica.
 """
 
-from .refresh import PosteriorRefresher
+from .refresh import PosteriorRefresher, RefreshPolicy
 from .state import (STREAM_SCHEMA, StreamCheckpoint, StreamState,
                     default_stream_model)
 
-__all__ = ["STREAM_SCHEMA", "PosteriorRefresher", "StreamCheckpoint",
-           "StreamState", "default_stream_model"]
+__all__ = ["STREAM_SCHEMA", "PosteriorRefresher", "RefreshPolicy",
+           "StreamCheckpoint", "StreamState", "default_stream_model"]

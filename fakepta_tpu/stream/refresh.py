@@ -24,17 +24,43 @@ only when ``rhat_max <= rhat_gate``; a non-converged refresh is kept out
 (flight-recorded ``stream_refresh_reject``) while the warm state still
 advances — the Laplace mode is a deterministic fit, valid regardless of
 chain convergence.
+
+Scheduling (ROADMAP item 5): refreshing after every append wastes chains
+on a posterior that barely moved. :class:`RefreshPolicy` decides when a
+refresh is DUE — after ``every_appends`` appended blocks since the last
+refresh, or earlier when the stream's rolling ``|SNR|`` moved by at least
+``min_snr_gain`` (data arriving that *changes the answer* should not wait
+out the epoch counter). :meth:`PosteriorRefresher.maybe_refresh` applies
+the policy: not-due calls are counted (``stream.refresh_skips``) and
+flight-recorded, never sampled.
 """
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Optional
 
 import numpy as np
 
 from .. import obs
 from ..sample import SampleSpec, SamplingRun, as_spec
+from ..tune import defaults as knobs
 from .state import STREAM_SCHEMA
+
+
+@dataclasses.dataclass(frozen=True)
+class RefreshPolicy:
+    """When is a posterior refresh due? (defaults from ``tune/defaults.py``)
+
+    - ``every_appends``: refresh after this many appended TOA blocks since
+      the last refresh (the epoch-count trigger; always active).
+    - ``min_snr_gain``: refresh as soon as the stream's rolling detection
+      statistic moved this much in ``|SNR|`` since the last refresh
+      (0 disables; streams without a ``watch`` statistic never trip it).
+    """
+
+    every_appends: int = knobs.REFRESH_EVERY_APPENDS
+    min_snr_gain: float = knobs.REFRESH_MIN_SNR_GAIN
 
 
 class PosteriorRefresher:
@@ -47,7 +73,8 @@ class PosteriorRefresher:
     """
 
     def __init__(self, stream, spec=None, *, rhat_gate: float = 1.05,
-                 mesh=None, compile_cache_dir=None):
+                 mesh=None, compile_cache_dir=None,
+                 policy: Optional[RefreshPolicy] = None):
         self.stream = stream
         self.spec = (SampleSpec(model=stream.model) if spec is None
                      else as_spec(spec))
@@ -57,11 +84,23 @@ class PosteriorRefresher:
         self.rhat_gate = float(rhat_gate)
         self.mesh = mesh
         self.compile_cache_dir = compile_cache_dir
+        self.policy = policy or RefreshPolicy()
         self.posterior: Optional[dict] = None
         self.refreshes = 0
         self.promotions = 0
+        self.skips = 0
         self._warm: Optional[dict] = None
         self._last_z: Optional[np.ndarray] = None
+        # scheduling baselines: appends/SNR as of the last refresh (the
+        # construction point counts as "refreshed" — maybe_refresh measures
+        # accumulation, not absolute stream age)
+        self._mark_appends = int(getattr(stream, "appends", 0))
+        self._mark_snr = self._current_snr()
+
+    def _current_snr(self) -> Optional[float]:
+        """The stream's rolling |SNR|, or None without a watch statistic."""
+        snr = self.stream.stats().get("snr")
+        return None if snr is None else abs(float(snr))
 
     @staticmethod
     def _remap_z(z_prev, prev, new) -> np.ndarray:
@@ -107,6 +146,8 @@ class PosteriorRefresher:
                                rhat_max=rhat, gate=self.rhat_gate)
         self._warm = run.laplace_state()
         self._last_z = run.last_z
+        self._mark_appends = int(getattr(self.stream, "appends", 0))
+        self._mark_snr = self._current_snr()
         obs.count("stream.refreshes")
         info = {
             "schema": STREAM_SCHEMA, "refresh": cycle,
@@ -118,4 +159,34 @@ class PosteriorRefresher:
             "n_toas": int(self.stream._n.sum()),
             "latency_ms": round((obs.now() - t0) * 1e3, 3),
         }
+        return info
+
+    def maybe_refresh(self, n_steps: int = 200, seed: int = 0, **run_kwargs
+                      ) -> dict:
+        """Refresh only when the :class:`RefreshPolicy` says one is due.
+
+        Due → delegates to :meth:`refresh` (the returned info dict gains a
+        ``trigger`` key: ``"appends"`` or ``"snr"``). Not due → no chains
+        run; the skip is counted (``stream.refresh_skips``) and
+        flight-recorded, and a ``{"skipped": True, ...}`` dict reports how
+        far each trigger has accumulated.
+        """
+        pol = self.policy
+        since = int(getattr(self.stream, "appends", 0)) - self._mark_appends
+        snr = self._current_snr()
+        gain = (abs(snr - self._mark_snr)
+                if snr is not None and self._mark_snr is not None
+                else (snr if snr is not None else 0.0))
+        due_appends = since >= int(pol.every_appends)
+        due_snr = pol.min_snr_gain > 0 and gain >= pol.min_snr_gain
+        if not (due_appends or due_snr):
+            self.skips += 1
+            obs.count("stream.refresh_skips")
+            obs.flightrec.note("stream_refresh_skip", appends_since=since,
+                               snr_gain=round(float(gain), 6))
+            return {"schema": STREAM_SCHEMA, "skipped": True,
+                    "appends_since": since, "snr_gain": float(gain)}
+        info = self.refresh(n_steps, seed=seed, **run_kwargs)
+        info["trigger"] = "appends" if due_appends else "snr"
+        info["skipped"] = False
         return info

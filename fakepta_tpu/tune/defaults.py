@@ -47,6 +47,49 @@ BUCKET_RATIO = 2
 #: instead of exercising ladder breadth (the solo loadgen covers that)
 DEFAULT_FLEET_BUCKETS = (16, 32)
 
+# --- fleet lifecycle knobs (fakepta_tpu.serve.health / .autoscale) ---------
+
+#: heartbeat probe period per replica (seconds); the monitor probes every
+#: live replica on this cadence while it is healthy
+HEARTBEAT_PERIOD_S = 1.0
+
+#: per-probe deadline: a probe that has not answered by now is a MISS —
+#: must stay well under the period so misses accumulate quickly
+HEARTBEAT_DEADLINE_S = 0.25
+
+#: consecutive probe misses before a replica is SUSPECT (breaker opens:
+#: new routes drain away while probing continues with backoff)
+HEARTBEAT_SUSPECT_AFTER = 2
+
+#: consecutive probe misses before a suspect replica is WEDGED (still
+#: breakered, still probed — a wedged replica can come back)
+HEARTBEAT_WEDGED_AFTER = 4
+
+#: consecutive probe successes before the breaker closes again
+BREAKER_CLOSE_AFTER = 2
+
+#: suspect-probe exponential backoff: first retry delay and its cap
+BREAKER_BACKOFF_BASE_S = 0.5
+BREAKER_BACKOFF_CAP_S = 8.0
+
+#: autoscaler: per-replica throughput a healthy fleet should sustain —
+#: demand above ``alive * target`` asks for one more replica
+AUTOSCALE_TARGET_QPS_PER_REPLICA = 32.0
+
+#: autoscaler hysteresis band (fractional): scale DOWN only when demand
+#: sits below ``(1 - band)`` of the post-shrink capacity, so the policy
+#: never flaps between two counts on the same steady load
+AUTOSCALE_HYSTERESIS = 0.25
+
+#: autoscaler p99 latency trip wires (milliseconds): above the high mark
+#: scale up regardless of qps; scale down only below the low mark
+AUTOSCALE_P99_HIGH_MS = 2000.0
+AUTOSCALE_P99_LOW_MS = 500.0
+
+#: cooldown between scale actions (seconds): one membership change at a
+#: time, fully absorbed before the next decision
+AUTOSCALE_COOLDOWN_S = 30.0
+
 # --- streaming dispatch knobs (fakepta_tpu.stream) -------------------------
 
 #: append-block bucket ladder: an appended TOA block pads up to the
@@ -62,6 +105,17 @@ STREAM_BLOCK_BUCKETS = (8, 16, 32, 64, 128, 256, 512, 1024)
 #: power-of-ratio rung, so a stream that doubles its data recompiles
 #: O(log growth) times total, not O(appends)
 STREAM_GROWTH_RATIO = 2
+
+#: posterior-refresh scheduling (stream/refresh.py RefreshPolicy):
+#: refreshing after EVERY append is wasteful — one epoch barely moves the
+#: posterior (ROADMAP item 5). A refresh is due after this many appended
+#: TOA blocks since the last one...
+REFRESH_EVERY_APPENDS = 4
+
+#: ...or earlier, when the rolling detection statistic moved this much in
+#: |SNR| since the last refresh (0 disables the SNR trigger; streams
+#: without a ``watch`` statistic fall back to the epoch-count trigger)
+REFRESH_MIN_SNR_GAIN = 0.5
 
 # --- tuner constants (fakepta_tpu.tune) ------------------------------------
 

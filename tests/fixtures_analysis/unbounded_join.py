@@ -1,0 +1,15 @@
+"""Seeded true positives + near misses for the unbounded-thread-join rule."""
+import threading
+
+t = threading.Thread(target=print, daemon=True)
+t.start()
+
+t.join()                                    # line 7: bare join, blocks forever
+t.join(timeout=None)                        # line 8: explicit unbounded
+
+t.join(5.0)                                 # bounded positionally: fine
+t.join(timeout=2.5)                         # bounded by keyword: fine
+deadline = 30.0
+t.join(timeout=deadline)                    # variable bound: accepted
+parts = ", ".join(["a", "b"])               # str join takes args: fine
+allowed = t.join()  # fakepta: allow[unbounded-thread-join] interpreter exit path, nothing left to record to

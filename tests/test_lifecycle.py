@@ -1,0 +1,396 @@
+"""Fleet lifecycle (ISSUE 15): health plane, elastic membership, autoscaler.
+
+Lean by construction: the breaker and membership lanes share one
+module-scoped 2-replica in-process fleet (tiny specs, bucket 8, shared
+tmp compile cache so joins are cache loads); the wedged-vs-dead transport
+lane runs against a scripted in-test TCP pong server (attach-mode
+SocketReplica — no subprocess, nothing compiles); the autoscaler policy
+and refresh-policy lanes are pure host logic. The heavyweight end-to-end
+chaos run (ramp + wedge + kill + autoscale-join, bit-verified failovers)
+lives in the benchmark suite's elastic lane (config15), not tier-1.
+"""
+
+import dataclasses
+import json
+import socket as socket_mod
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from fakepta_tpu import faults
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.serve import (ArraySpec, AutoscaleConfig, Autoscaler,
+                               FleetConfig, HealthConfig, LocalReplica,
+                               ServeConfig, ServeFleet, SimRequest,
+                               SocketReplica)
+from fakepta_tpu.stream import PosteriorRefresher, RefreshPolicy
+
+SPEC0 = ArraySpec(npsr=4, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                  data_seed=150)
+SPEC1 = dataclasses.replace(SPEC0, data_seed=151)
+
+FAST_HEALTH = HealthConfig(period_s=0.05, probe_deadline_s=0.05,
+                           suspect_after=2, wedged_after=4, close_after=2,
+                           backoff_base_s=0.02, backoff_cap_s=0.1)
+
+
+def _wait_for(pred, timeout_s=15.0, step=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+# ---------------------------------------------------------------------------
+# wedged vs dead: the transport-level classification (no subprocess, no jax)
+# ---------------------------------------------------------------------------
+
+class _FakeFleet:
+    """The duck-typed surface HealthMonitor needs: a replica map + lock."""
+
+    def __init__(self, replicas):
+        self.replicas = replicas
+        self._lock = threading.Lock()
+
+
+def test_wedged_then_dead_transport_classification():
+    """A replica that stops ANSWERING (connection up, pongs withheld) is
+    classified suspect -> wedged and breakered; recovery closes the
+    breaker only after consecutive successes; a severed connection
+    (SIGKILL's transport signature) flips ``alive`` through reader EOF
+    well under a heartbeat period and lands in the terminal dead state."""
+    from fakepta_tpu.serve.health import HealthMonitor
+
+    answer = threading.Event()
+    answer.set()
+    sever = threading.Event()
+    srv = socket_mod.create_server(("127.0.0.1", 0))
+    port = srv.getsockname()[1]
+
+    def pong_server():
+        srv.settimeout(10.0)
+        try:
+            conn, _ = srv.accept()
+        except OSError:
+            return
+        conn.settimeout(0.02)
+        buf = b""
+        with srv, conn:
+            while not sever.is_set():
+                try:
+                    data = conn.recv(65536)
+                except socket_mod.timeout:
+                    continue
+                except OSError:
+                    return
+                if not data:
+                    return
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    if answer.is_set():
+                        req = json.loads(line)
+                        conn.sendall((json.dumps(
+                            {"id": req["id"], "ok": True, "pong": True})
+                            + "\n").encode())
+
+    threading.Thread(target=pong_server, daemon=True).start()
+    rep = SocketReplica("w0", connect=("127.0.0.1", port))
+    hm = HealthMonitor(_FakeFleet({"w0": rep}), FAST_HEALTH).start()
+    try:
+        assert _wait_for(lambda: hm.stats()["fleet_probes"] >= 2)
+        assert hm.state("w0") == "healthy" and hm.routable("w0")
+
+        # wedge: pongs stop, transport stays up -> breaker opens
+        answer.clear()
+        assert _wait_for(lambda: hm.state("w0") == "suspect")
+        assert not hm.routable("w0")
+        assert _wait_for(lambda: hm.state("w0") == "wedged")
+        assert rep.alive, "wedged is NOT dead: the connection is still up"
+        st = hm.stats()
+        assert st["fleet_breaker_opens"] == 1
+        assert st["fleet_wedged"] == 1 and st["fleet_breakered"] == 1
+
+        # recovery: consecutive successes close the breaker
+        answer.set()
+        assert _wait_for(lambda: hm.state("w0") == "healthy")
+        assert hm.routable("w0")
+        assert hm.stats()["fleet_breaker_closes"] == 1
+
+        # death: sever the connection -> reader EOF, detected fast (the
+        # reader thread, not a heartbeat) -> terminal dead
+        t0 = time.monotonic()
+        sever.set()
+        assert _wait_for(lambda: not rep.alive, timeout_s=5.0)
+        assert time.monotonic() - t0 < 2.0, "EOF death detection was slow"
+        assert _wait_for(lambda: hm.state("w0") == "dead", timeout_s=5.0)
+        assert not hm.routable("w0")
+    finally:
+        sever.set()
+        hm.stop(timeout_s=10.0)
+        rep.close()
+
+
+# ---------------------------------------------------------------------------
+# the in-process lifecycle fleet (shared by the breaker + membership lanes)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def lifecycle(tmp_path_factory):
+    import jax
+
+    cache = tmp_path_factory.mktemp("lifecycle_cache")
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=0.01)
+    replicas = [LocalReplica(f"h{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, compile_cache_dir=str(cache),
+                             index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    flt.enable_health(FAST_HEALTH)
+    yield {"fleet": flt, "cache": cache, "cfg": cfg}
+    flt.close()
+    jax.config.update("jax_compilation_cache_dir", None)
+    from jax.experimental.compilation_cache import compilation_cache
+    compilation_cache.reset_cache()
+
+
+def test_hung_replica_breakered_with_zero_client_timeouts(lifecycle):
+    """The tentpole's no-minutes-lost contract: wedge one replica's
+    heartbeats (fleet.heartbeat hang matched to it), and its traffic
+    drains to the sibling bit-identically with ZERO client-visible
+    timeouts — then the breaker closes on recovery."""
+    flt = lifecycle["fleet"]
+    victim = flt.ring.owner(SPEC0.spec_hash())
+    ref = flt.serve(SimRequest(spec=SPEC0, n=4, seed=9), timeout=600)
+    assert ref.replica == victim
+    plan = faults.FaultPlan([faults.FaultSpec(
+        "fleet.heartbeat", "hang", at=tuple(range(512)), times=512,
+        hang_s=0.2, match=(("replica", victim),))])
+    with faults.inject(plan):
+        assert _wait_for(lambda: not flt.health.routable(victim))
+        assert flt.health.state(victim) in ("suspect", "wedged")
+        # the wedged owner's spec now serves from the sibling, warm via
+        # the shared cache, without waiting out any transport timeout
+        res = flt.serve(SimRequest(spec=SPEC0, n=4, seed=9), timeout=600)
+        assert res.replica != victim
+        assert np.array_equal(res.curves, ref.curves)
+        assert np.array_equal(res.autos, ref.autos)
+    slo = flt.slo_summary()
+    assert slo["fleet_timeouts"] == 0
+    assert slo["fleet_breaker_opens"] >= 1
+    assert slo["fleet_heartbeat_misses"] >= FAST_HEALTH.suspect_after
+    # the hang plan is gone: probes succeed and the breaker closes
+    assert _wait_for(lambda: flt.health.state(victim) == "healthy")
+    assert flt.health.routable(victim)
+    assert flt.slo_summary()["fleet_breaker_closes"] >= 1
+
+
+def test_join_prewarms_recent_shard_and_retire_drains(lifecycle):
+    """Elastic membership: a joined replica absorbs its ring shard with
+    warm loads from the fleet's recent working set (shared compile
+    cache), traffic keeps verifying bit-identically, and retire() removes
+    it from the ring before closing it."""
+    import jax
+
+    flt = lifecycle["fleet"]
+    ref1 = flt.serve(SimRequest(spec=SPEC1, n=3, seed=21), timeout=600)
+    new = LocalReplica("h9", mesh=make_mesh(jax.devices()[:1]),
+                       config=lifecycle["cfg"],
+                       compile_cache_dir=str(lifecycle["cache"]), index=9)
+    joined = flt.join(new)
+    assert joined["replica"] == "h9" and "h9" in flt.replicas
+    # both served specs are in the recent set; the new replica prewarmed
+    # the subset its ring position owns (0..2 of the 2 recent entries)
+    assert 0 <= joined["warm_loads"] <= 2
+    with pytest.raises(ValueError, match="already"):
+        flt.join(new)
+    # the membership change never breaks response bit-identity
+    again = flt.serve(SimRequest(spec=SPEC1, n=3, seed=21), timeout=600)
+    assert np.array_equal(again.curves, ref1.curves)
+
+    flt.retire("h9")
+    assert "h9" not in flt.replicas
+    assert "h9" not in flt.ring.preference(SPEC1.spec_hash())
+    assert not new.alive
+    slo = flt.slo_summary()
+    assert slo["fleet_joins"] >= 1 and slo["fleet_drains"] >= 1
+    with pytest.raises(ValueError, match="not in the fleet"):
+        flt.retire("h9")
+    # post-retire traffic still verifies
+    back = flt.serve(SimRequest(spec=SPEC1, n=3, seed=21), timeout=600)
+    assert np.array_equal(back.curves, ref1.curves)
+
+
+def test_replica_register_handshake_adopts_and_serves(lifecycle):
+    """The outside-in join: `serve replica --register HOST:PORT` dials the
+    router's admin port and is adopted via SocketReplica attach mode.
+
+    Regression (found driving the package surface): the replica must be
+    ACCEPTING before it registers — _adopt pre-warms the joiner over its
+    serving port before replying `adopt`, so a replica that registered
+    from its main thread ahead of serve_forever() deadlocked against the
+    router until its reply-read timeout killed it (listener's embryo
+    connections RST, the fleet left holding a permanently-dead member).
+    The CLI now registers from a side thread while the server accepts."""
+    import os
+    import subprocess
+    import sys
+
+    flt = lifecycle["fleet"]
+    ref = flt.serve(SimRequest(spec=SPEC1, n=3, seed=33), timeout=600)
+    admin_port = flt.listen()
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "fakepta_tpu.serve", "replica",
+         "--port", "0", "--host", "127.0.0.1",
+         "--npsr", str(SPEC1.npsr), "--ntoa", str(SPEC1.ntoa),
+         "--n-red", str(SPEC1.n_red), "--n-dm", str(SPEC1.n_dm),
+         "--gwb-ncomp", str(SPEC1.gwb_ncomp), "--buckets", "8",
+         "--compile-cache", str(lifecycle["cache"]),
+         "--x64", "--jax-platform", "cpu", "--devices", "1",
+         "--register", f"127.0.0.1:{admin_port}",
+         "--replica-id", "joiner"],
+        env=dict(os.environ), stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+    try:
+        assert _wait_for(lambda: "joiner" in flt.replicas, timeout_s=120.0,
+                         step=0.1), "adopt handshake never completed"
+        rep = flt.replicas["joiner"]
+        assert rep.alive
+        # the monitor probes the adopted transport like any other member
+        assert _wait_for(lambda: flt.health.state("joiner") == "healthy")
+        assert flt.slo_summary()["fleet_joins"] >= 1
+        # traffic with the joiner in the ring still verifies bit-exactly
+        again = flt.serve(SimRequest(spec=SPEC1, n=3, seed=33), timeout=600)
+        assert np.array_equal(again.curves, ref.curves)
+
+        flt.retire("joiner")
+        assert "joiner" not in flt.replicas
+        back = flt.serve(SimRequest(spec=SPEC1, n=3, seed=33), timeout=600)
+        assert np.array_equal(back.curves, ref.curves)
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_autoscaler_step_actuates_join_then_retire(lifecycle):
+    """The actuator path: an up decision spawns + joins exactly one
+    replica, a down decision retires the newest join first, and the
+    cooldown blocks back-to-back membership changes."""
+    import jax
+
+    flt = lifecycle["fleet"]
+    flt.serve(SimRequest(spec=SPEC0, n=2, seed=5), timeout=600)  # qps > 0
+    spawned = []
+
+    def spawn(index):
+        r = LocalReplica(f"scale{index}", mesh=make_mesh(jax.devices()[:1]),
+                         config=lifecycle["cfg"],
+                         compile_cache_dir=str(lifecycle["cache"]),
+                         index=index)
+        spawned.append(r)
+        return r
+
+    up = Autoscaler(flt, spawn, AutoscaleConfig(
+        min_replicas=1, max_replicas=4, target_qps_per_replica=1e-9,
+        p99_high_ms=1e12, p99_low_ms=0.0, cooldown_s=0.0))
+    d = up.step()
+    assert d["action"] == "up" and len(spawned) == 1
+    assert spawned[0].id in flt.replicas and up.scale_events == 1
+
+    down = Autoscaler(flt, spawn, AutoscaleConfig(
+        min_replicas=1, max_replicas=4, target_qps_per_replica=1e12,
+        p99_high_ms=1e12, p99_low_ms=1e12, cooldown_s=3600.0))
+    d2 = down.step()
+    assert d2["action"] == "down" and d2["replica"] == spawned[0].id
+    assert spawned[0].id not in flt.replicas
+    d3 = down.step()                        # want 1 < alive 2, but throttled
+    assert d3["action"] == "cooldown"
+    assert len(flt.replicas) == 2
+
+
+# ---------------------------------------------------------------------------
+# pure policy units (no fleet, no threads, no clock)
+# ---------------------------------------------------------------------------
+
+def test_autoscaler_target_is_pure_policy():
+    cfg = AutoscaleConfig(min_replicas=1, max_replicas=4,
+                          target_qps_per_replica=10.0, hysteresis=0.25,
+                          p99_high_ms=100.0, p99_low_ms=20.0)
+    sc = Autoscaler(None, None, cfg)
+    base = {"fleet_replicas_alive": 2, "fleet_qps": 15.0,
+            "fleet_p99_ms": 50.0}
+    assert sc.target(base) == 2                               # in band
+    assert sc.target({**base, "fleet_qps": 25.0}) == 3        # demand trip
+    assert sc.target({**base, "fleet_p99_ms": 500.0}) == 3    # p99 trip
+    # down needs BOTH a low p99 AND demand under the hysteresis band
+    assert sc.target({**base, "fleet_qps": 5.0}) == 2         # p99 not low
+    assert sc.target({**base, "fleet_qps": 5.0,
+                      "fleet_p99_ms": 5.0}) == 1
+    # demand 0.8 vs post-shrink band (2-1)*(1-0.25)=0.75: NOT below -> hold
+    # (the flap-killer: the up and down thresholds never meet)
+    assert sc.target({**base, "fleet_qps": 8.0,
+                      "fleet_p99_ms": 5.0}) == 2
+    # clamps: never past max, never under min (a 1-replica fleet holds)
+    assert sc.target({"fleet_replicas_alive": 4, "fleet_qps": 1e6,
+                      "fleet_p99_ms": 5.0}) == 4
+    assert sc.target({"fleet_replicas_alive": 1, "fleet_qps": 0.0,
+                      "fleet_p99_ms": 0.0}) == 1
+
+
+class _FakeStream:
+    """The duck-typed surface RefreshPolicy scheduling reads: an appends
+    counter, a stats() snapshot, and the (shared) model identity."""
+
+    def __init__(self):
+        from fakepta_tpu.stream import default_stream_model
+
+        self.model = default_stream_model()
+        self.appends = 0
+        self.snr = 0.0
+
+    def stats(self):
+        return {"snr": self.snr}
+
+
+class _CountingRefresher(PosteriorRefresher):
+    """maybe_refresh()'s unit harness: refresh() advances the markers the
+    real one would, without sampling anything."""
+
+    def refresh(self, n_steps=200, seed=0, **run_kwargs):
+        self.refreshes += 1
+        self._mark_appends = int(self.stream.appends)
+        self._mark_snr = self._current_snr()
+        return {"refresh": self.refreshes - 1}
+
+
+def test_refresh_policy_gates_on_appends_and_snr():
+    s = _FakeStream()
+    r = _CountingRefresher(s, policy=RefreshPolicy(every_appends=3,
+                                                   min_snr_gain=2.0))
+    out = r.maybe_refresh()
+    assert out["skipped"] and out["appends_since"] == 0
+    assert r.skips == 1 and r.refreshes == 0
+    s.appends = 2
+    assert r.maybe_refresh()["skipped"]                # under both gates
+    s.appends = 3
+    out = r.maybe_refresh()
+    assert not out["skipped"] and out["trigger"] == "appends"
+    assert r.refreshes == 1
+    assert r.maybe_refresh()["skipped"]                # markers advanced
+    # an |SNR| jump trips the refresh BEFORE the epoch counter does
+    s.snr = -2.5
+    out = r.maybe_refresh()
+    assert not out["skipped"] and out["trigger"] == "snr"
+    assert r.refreshes == 2 and r.skips == 3
+    # defaults come from the sanctioned knob home
+    from fakepta_tpu.tune import defaults as knobs
+
+    assert RefreshPolicy() == RefreshPolicy(
+        every_appends=knobs.REFRESH_EVERY_APPENDS,
+        min_snr_gain=knobs.REFRESH_MIN_SNR_GAIN)

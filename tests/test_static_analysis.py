@@ -76,6 +76,8 @@ CASES = [
      {("unbounded-socket-io", 6), ("unbounded-socket-io", 10),
       ("unbounded-socket-io", 11), ("unbounded-socket-io", 16),
       ("unbounded-socket-io", 17)}),
+    ("unbounded_join.py", LIB,
+     {("unbounded-thread-join", 7), ("unbounded-thread-join", 8)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -133,6 +135,9 @@ def test_dtype_policy_paths_exist():
     for rel in policy.SOCKET_IO_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale SOCKET_IO_MODULES entry: {rel}"
+    for rel in policy.UNBOUNDED_JOIN_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale UNBOUNDED_JOIN_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
