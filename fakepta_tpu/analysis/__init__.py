@@ -13,9 +13,12 @@ standalone above) the offending line, or the committed baseline
 themselves.
 """
 
-from .engine import (Finding, apply_baseline, check_paths, check_source,
-                     load_baseline, save_baseline)
-from .rules import ALL_RULES, RULE_IDS
+from .engine import (Finding, apply_baseline, build_project_index,
+                     check_files, check_paths, check_source,
+                     check_source_project, load_baseline, save_baseline)
+from .rules import ALL_RULES, PROJECT_RULE_IDS, PROJECT_RULES, RULE_IDS
 
-__all__ = ["Finding", "ALL_RULES", "RULE_IDS", "apply_baseline",
-           "check_paths", "check_source", "load_baseline", "save_baseline"]
+__all__ = ["Finding", "ALL_RULES", "RULE_IDS", "PROJECT_RULES",
+           "PROJECT_RULE_IDS", "apply_baseline", "build_project_index",
+           "check_files", "check_paths", "check_source",
+           "check_source_project", "load_baseline", "save_baseline"]

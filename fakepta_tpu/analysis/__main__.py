@@ -6,6 +6,18 @@ current findings into the committed baseline; the intended steady state is
 an *empty* baseline with every sanctioned exception pragma'd in place,
 because a pragma carries its justification next to the code and a baseline
 entry does not.
+
+``--format json`` emits the stable machine schema (CI annotations,
+editors)::
+
+    {"schema": "fakepta_tpu.analysis/1",
+     "count": 2,
+     "findings": [{"path": ..., "line": ..., "col": ...,
+                   "rule": ..., "message": ...}, ...]}
+
+Findings are sorted (path, line, col, rule); the exit code is the same as
+text mode. ``graph <paths...> --dot`` prints the whole-program lock-order
+graph in DOT (cycle edges red) for docs and deadlock review.
 """
 
 from __future__ import annotations
@@ -16,7 +28,10 @@ import sys
 from pathlib import Path
 
 from . import engine
-from .rules import RULE_IDS
+from .rules import PROJECT_RULE_IDS, RULE_IDS
+
+#: bump only with a documented migration; consumers pin on this
+JSON_SCHEMA = "fakepta_tpu.analysis/1"
 
 DEFAULT_BASELINE = Path(__file__).parent / "baseline.json"
 
@@ -44,14 +59,34 @@ def build_parser() -> argparse.ArgumentParser:
                        help="directory paths are reported relative to "
                             "(default: cwd; baseline keys use these paths)")
     sub.add_parser("rules", help="list registered rule ids")
+    graph = sub.add_parser(
+        "graph", help="export the whole-program lock-order graph")
+    graph.add_argument("paths", nargs="+",
+                       help="python files or directories to index")
+    graph.add_argument("--dot", action="store_true",
+                       help="emit graphviz DOT (default: edge list)")
+    graph.add_argument("--root", type=Path, default=None)
     return parser
 
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     if args.command == "rules":
-        for rid in RULE_IDS + (engine.PRAGMA_RULE, engine.UNUSED_PRAGMA_RULE):
+        for rid in (RULE_IDS + PROJECT_RULE_IDS
+                    + (engine.PRAGMA_RULE, engine.UNUSED_PRAGMA_RULE)):
             print(rid)
+        return 0
+    if args.command == "graph":
+        from .concurrency import LockModel
+
+        index = engine.build_project_index(args.paths, root=args.root)
+        model = LockModel.of(index)
+        if args.dot:
+            sys.stdout.write(model.to_dot())
+        else:
+            for e in model.edges:
+                via = f" via {e.via}" if e.via else ""
+                print(f"{e.src} -> {e.dst}  [{e.module}:{e.line}{via}]")
         return 0
 
     findings = engine.check_paths(args.paths, root=args.root)
@@ -64,7 +99,11 @@ def main(argv=None) -> int:
             findings, engine.load_baseline(args.baseline))
 
     if args.format == "json":
-        print(json.dumps([f.__dict__ for f in findings], indent=2))
+        print(json.dumps(
+            {"schema": JSON_SCHEMA, "count": len(findings),
+             "findings": [{"path": f.path, "line": f.line, "col": f.col,
+                           "rule": f.rule, "message": f.message}
+                          for f in findings]}, indent=2))
     else:
         for f in findings:
             print(f.format())

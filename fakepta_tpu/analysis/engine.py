@@ -118,27 +118,19 @@ def all_rules():
     return ALL_RULES
 
 
-def check_source(path: str, source: str,
-                 rules: Optional[Sequence] = None) -> List[Finding]:
-    """Run every rule over one module's source; apply pragma suppression.
+def project_rules():
+    """The whole-program rule list: (rule_id, check(index) -> findings).
 
-    Returns the surviving findings (sorted), including the engine's own
-    meta-findings: unjustified pragmas (always) — a pragma with no reason is
-    tribal knowledge in the making.
-    """
-    rel = path.replace("\\", "/")
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [Finding(rel, e.lineno or 1, (e.offset or 0) + 1,
-                        "syntax-error", f"file does not parse: {e.msg}")]
-    ctx = ModuleContext(path=rel, tree=tree, source=source,
-                        dtype_policy=policy.dtype_policy_for(rel),
-                        is_library=policy.is_library(rel))
-    findings: List[Finding] = []
-    for rule_id, check in (rules if rules is not None else all_rules()):
-        findings.extend(check(ctx))
+    Imported lazily — the concurrency pass sits on top of the project
+    index, which itself reuses the per-file resolver machinery."""
+    from .rules import PROJECT_RULES
 
+    return PROJECT_RULES
+
+
+def _apply_pragmas(rel: str, source: str,
+                   findings: Sequence[Finding]) -> List[Finding]:
+    """Pragma suppression + the engine's own meta-findings for one file."""
     pragmas = parse_pragmas(source)
     by_target: Dict[int, List[Pragma]] = {}
     for p in pragmas:
@@ -170,6 +162,92 @@ def check_source(path: str, source: str,
     return sorted(kept)
 
 
+def _parse_context(path: str, source: str):
+    """(ModuleContext, None) or (None, syntax-error Finding)."""
+    rel = path.replace("\\", "/")
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return None, Finding(rel, e.lineno or 1, (e.offset or 0) + 1,
+                             "syntax-error",
+                             f"file does not parse: {e.msg}")
+    return ModuleContext(path=rel, tree=tree, source=source,
+                         dtype_policy=policy.dtype_policy_for(rel),
+                         is_library=policy.is_library(rel)), None
+
+
+def check_source(path: str, source: str,
+                 rules: Optional[Sequence] = None) -> List[Finding]:
+    """Run every per-file rule over one module's source; apply pragma
+    suppression.
+
+    Returns the surviving findings (sorted), including the engine's own
+    meta-findings: unjustified pragmas (always) — a pragma with no reason is
+    tribal knowledge in the making. The whole-program pass does NOT run
+    here (see :func:`check_files`) — per-file findings stay byte-identical
+    whatever the rest of the project looks like.
+    """
+    ctx, err = _parse_context(path, source)
+    if err is not None:
+        return [err]
+    findings: List[Finding] = []
+    for rule_id, check in (rules if rules is not None else all_rules()):
+        findings.extend(check(ctx))
+    return _apply_pragmas(ctx.path, source, findings)
+
+
+def check_files(files: Sequence[Tuple[str, str]],
+                rules: Optional[Sequence] = None,
+                project: Optional[Sequence] = None,
+                run_project: bool = True) -> List[Finding]:
+    """The two-pass analysis over ``(path, source)`` pairs.
+
+    Pass 1 runs the per-file rules on each module exactly as
+    :func:`check_source` would. Pass 2 builds one
+    :class:`~fakepta_tpu.analysis.project.ProjectIndex` over the *library*
+    modules (``policy.is_library``) and runs the whole-program rules on
+    it. Both passes' findings flow through the same per-file pragma
+    machinery — an ``allow[lock-order-inversion]`` on the witness line
+    suppresses the interprocedural finding like any other.
+    """
+    contexts: List[Tuple[ModuleContext, str]] = []
+    out: List[Finding] = []
+    per_path: Dict[str, List[Finding]] = {}
+    for path, source in files:
+        ctx, err = _parse_context(path, source)
+        if err is not None:
+            out.append(err)
+            continue
+        contexts.append((ctx, source))
+        bucket = per_path.setdefault(ctx.path, [])
+        for rule_id, check in (rules if rules is not None else all_rules()):
+            bucket.extend(check(ctx))
+
+    if run_project:
+        lib_ctxs = [ctx for ctx, _ in contexts if ctx.is_library]
+        if lib_ctxs:
+            from .project import build_index
+
+            index = build_index(lib_ctxs)
+            for rule_id, check in (project if project is not None
+                                   else project_rules()):
+                for f in check(index):
+                    if f.path in per_path:
+                        per_path[f.path].append(f)
+                    else:
+                        out.append(f)
+
+    for ctx, source in contexts:
+        out.extend(_apply_pragmas(ctx.path, source,
+                                  per_path.get(ctx.path, ())))
+    return sorted(out)
+
+
+def check_source_project(path: str, source: str) -> List[Finding]:
+    """One file through BOTH passes (fixture corpus entry point)."""
+    return check_files([(path, source)])
+
+
 def iter_python_files(paths: Sequence[str]) -> Iterable[Path]:
     """Expand path arguments: files pass through, directories walk ``*.py``
     minus the default-excluded dir names (fixture corpora, caches)."""
@@ -199,13 +277,30 @@ def _rel(p: Path, root: Optional[Path]) -> str:
 
 
 def check_paths(paths: Sequence[str], root: Optional[Path] = None,
-                rules: Optional[Sequence] = None) -> List[Finding]:
-    """Analyze every python file under ``paths``; returns sorted findings."""
-    findings: List[Finding] = []
+                rules: Optional[Sequence] = None,
+                run_project: bool = True) -> List[Finding]:
+    """Analyze every python file under ``paths``; returns sorted findings.
+
+    Runs both passes: per-file rules on every file, whole-program rules
+    over the library modules in the set."""
+    files = [(_rel(f, root), f.read_text(encoding="utf-8"))
+             for f in iter_python_files(paths)]
+    return check_files(files, rules=rules, run_project=run_project)
+
+
+def build_project_index(paths: Sequence[str],
+                        root: Optional[Path] = None):
+    """A ProjectIndex over the library modules under ``paths`` (the
+    ``graph`` CLI subcommand and tooling entry point)."""
+    from .project import build_index
+
+    contexts = []
     for f in iter_python_files(paths):
-        findings.extend(check_source(
-            _rel(f, root), f.read_text(encoding="utf-8"), rules=rules))
-    return sorted(findings)
+        ctx, err = _parse_context(_rel(f, root),
+                                  f.read_text(encoding="utf-8"))
+        if ctx is not None and ctx.is_library:
+            contexts.append(ctx)
+    return build_index(contexts)
 
 
 # ---------------------------------------------------------------------------

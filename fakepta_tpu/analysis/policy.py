@@ -188,6 +188,85 @@ DISPATCH_KNOB_MODULES = (
     "fakepta_tpu/tune/defaults.py",
 )
 
+# ---------------------------------------------------------------------------
+# whole-program concurrency policy (analysis/concurrency.py)
+# ---------------------------------------------------------------------------
+
+# Canonical lock names for acquisitions that reach another object's lock
+# through a duck-typed attribute (``self.fleet._lock`` from the health
+# monitor IS the fleet's lock). Keys are the lock name as observed at the
+# acquisition site (``<OwnerClass>.<attr path>``); values are the canonical
+# name the lock-order graph uses. Without an alias each spelling would be a
+# distinct graph node and cross-object cycles would go unseen.
+LOCK_ALIASES = {
+    "HealthMonitor.fleet._lock": "ServeFleet._lock",
+    "SamplingSession.fleet._lock": "ServeFleet._lock",
+    "LocalReplica.pool._lock": "ServePool._lock",
+    "LocalReplica.pool._cond": "ServePool._lock",
+}
+
+# The canonical lock acquisition order (docs/INVARIANTS.md "Concurrency &
+# collective discipline"). A thread may acquire a lock only while holding
+# locks that appear EARLIER in this tuple; an observed edge that runs
+# backwards is a lock-order-inversion finding even before a full cycle
+# exists in the graph. Locks not listed here are constrained only by cycle
+# detection.
+LOCK_ORDER = (
+    "SocketReplica._lock",     # transport: pending-futures map (leaf-most
+                               # holder — completion callbacks run OUTSIDE)
+    "ServePool._lock",         # scheduler: admission queues + stats
+    "StreamManager._lock",     # stream registry (per-stream locks nest
+                               # UNDER nothing — opened outside the registry)
+    "ServeFleet._lock",        # router: ring membership + SLO stats
+    "HealthMonitor._lock",     # health counters (probes run lock-free)
+    "obs/flightrec._dump_lock",  # flight-recorder dump serialization
+                                 # (leaf; module locks are keyed
+                                 # <module-short>.<name>)
+)
+
+# Duck-typed attribute -> class hints for call/lock resolution where the
+# constructor assigns a bare parameter (``self.fleet = fleet``): the index
+# cannot infer the type, so the policy declares it. Keys: (owner class,
+# attribute name).
+ATTR_CLASS_HINTS = {
+    ("HealthMonitor", "fleet"): "ServeFleet",
+    ("SamplingSession", "fleet"): "ServeFleet",
+    ("Autoscaler", "fleet"): "ServeFleet",
+}
+
+# Engine-dispatch method names that block for a device program (compile +
+# execute) — reachable under a lock they serialize every sibling behind
+# minutes of device work (the blocking-under-lock rule).
+BLOCKING_DISPATCH_METHODS = ("run", "warm_start", "prewarm", "ensure_warm")
+
+# Class constructors whose __init__ does heavy device/IO work (checkpoint
+# replay, process spawn + banner handshake): constructing one under a lock
+# is a blocking-under-lock finding just like an engine dispatch.
+BLOCKING_CONSTRUCTORS = ("StreamState", "SocketReplica", "ServePool")
+
+# Per-module exemptions for the whole-program rules (same shape as the
+# per-file allowlists above; prefer a line pragma with a justification —
+# a module-wide exemption is for modules whose DESIGN is the exception).
+BLOCKING_UNDER_LOCK_MODULES = ()
+SHARED_STATE_MODULES = ()
+COLLECTIVE_DIVERGENCE_MODULES = ()
+
+# Method names too generic for class-hierarchy call resolution: an
+# untyped receiver's ``x.get()`` must not resolve to every class in the
+# repo that happens to define ``get``. Distinctive names (``submit``,
+# ``retry_hint``, ``ping``, ``handle``) still resolve to every indexed
+# class that defines them — that over-approximation is what lets the
+# lock-order pass see a failover callback re-entering a sibling replica.
+GENERIC_METHOD_NAMES = frozenset((
+    "append", "extend", "add", "get", "put", "pop", "popleft", "items",
+    "keys", "values", "update", "copy", "clear", "close", "join", "wait",
+    "result", "set", "is_set", "count", "index", "insert", "remove",
+    "sort", "read", "write", "flush", "note", "stats", "start", "stop",
+    "run", "send", "recv", "encode", "decode", "format", "split", "strip",
+    "exists", "open", "name", "parts", "todict", "acquire", "release",
+    "mean", "sum", "std", "min", "max", "reset", "kill", "report",
+))
+
 # Library code prefix: rules with a library-only clause (literal re-seeding,
 # dtype policy) fire only under it.
 LIBRARY_PREFIXES = ("fakepta_tpu/",)

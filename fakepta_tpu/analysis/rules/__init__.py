@@ -1,12 +1,16 @@
 """Rule registry: one module per rule, registered here in report order.
 
-Adding a rule = add a module with ``RULE_ID`` and ``check(ctx)``, append it
-below, give it a fixture pair in ``tests/fixtures_analysis/`` (one seeded
-true positive, one clean file), and document it in docs/INVARIANTS.md.
+Adding a per-file rule = add a module with ``RULE_ID`` and ``check(ctx)``,
+append it below, give it a fixture pair in ``tests/fixtures_analysis/``
+(one seeded true positive, one clean file), and document it in
+docs/INVARIANTS.md. Whole-program rules take ``check(index)`` over the
+:class:`~fakepta_tpu.analysis.project.ProjectIndex` instead and register
+in ``PROJECT_RULES``.
 """
 
-from . import (donation, dtype, excepts, hostsync, joins, knobs, meshaxis,
-               precision, queues, rng, socketio, timing, tracer)
+from . import (collectives, donation, dtype, excepts, hostsync, joins,
+               knobs, meshaxis, precision, queues, rng, socketio, timing,
+               tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
@@ -14,3 +18,15 @@ ALL_RULES = tuple((mod.RULE_ID, mod.check)
                               knobs, socketio, joins))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)
+
+
+def _project_rules():
+    from .. import concurrency
+
+    return concurrency.PROJECT_RULES + (
+        (collectives.RULE_ID, collectives.check_project),)
+
+
+PROJECT_RULES = _project_rules()
+
+PROJECT_RULE_IDS = tuple(rid for rid, _ in PROJECT_RULES)

@@ -1,0 +1,191 @@
+"""collective-divergence: collectives must issue identically on every host.
+
+On a multi-host slice, ``psum``/``all_gather``/``ppermute``/``pbroadcast``
+are rendezvous points: every participating process must issue the SAME
+sequence of collectives or the whole slice hangs (no error — the fast
+hosts sit in the collective forever waiting for the host that branched the
+other way). The pre-deployment invariant is therefore *syntactic*: inside
+jit/``shard_map``-reachable code a collective may not be guarded by a
+predicate that can differ across hosts, sit inside an exception handler,
+or follow an early return taken on a data-dependent test.
+
+Uniformity heuristic (documented, deliberately syntactic): a branch test
+built only from plain names, attributes, constants, comparisons and
+boolean operators is **trace-time uniform** — inside traced code such a
+predicate is necessarily resolved at trace time from config every host
+shares. A test containing a call (other than the trace-time-static
+builtins ``len``/``isinstance``/``hasattr``/...) or a subscript can
+inspect per-host data (``jax.process_index()``, ``x[0] > 0``) and is
+treated as potentially divergent. False positives carry the usual pragma
+(``# fakepta: allow[collective-divergence] reason``) or a module entry in
+``policy.COLLECTIVE_DIVERGENCE_MODULES``.
+
+This is a whole-program rule: entry points are the per-module
+jit/``shard_map`` functions (``rules.common.jitted_functions``) plus every
+indexed function reachable from them through the project call graph.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .. import policy
+from ..engine import Finding
+from .common import NameResolver, jitted_functions, last_component
+
+RULE_ID = "collective-divergence"
+
+#: cross-host rendezvous primitives (jax.lax / jax.lax.parallel)
+COLLECTIVES = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "pshuffle", "pbroadcast", "psum_scatter",
+})
+
+#: calls that are trace-time static on shared config, hence uniform
+_UNIFORM_CALLS = frozenset({
+    "len", "isinstance", "issubclass", "hasattr", "getattr", "callable",
+    "bool", "int", "float", "str", "tuple", "list", "dict", "set",
+    "min", "max", "abs", "round", "sorted", "any", "all",
+})
+
+
+def _test_is_uniform(resolver: NameResolver, test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Call):
+            # only BARE builtin calls are trace-time static; a method
+            # call (x.any(), jax.process_index()) can inspect per-host
+            # data, whatever its name
+            if not (isinstance(node.func, ast.Name)
+                    and node.func.id in _UNIFORM_CALLS):
+                return False
+        elif isinstance(node, (ast.Subscript, ast.Await, ast.Yield,
+                               ast.YieldFrom, ast.GeneratorExp)):
+            return False
+    return True
+
+
+def _has_early_exit(if_node: ast.If) -> bool:
+    for st in if_node.body:
+        for sub in ast.walk(st):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                break
+            if isinstance(sub, (ast.Return, ast.Raise, ast.Continue,
+                                ast.Break)):
+                return True
+    return False
+
+
+def _collective_name(resolver: NameResolver,
+                     call: ast.Call) -> Optional[str]:
+    name = last_component(resolver.resolve(call.func))
+    if name in COLLECTIVES:
+        return name
+    return None
+
+
+def _scan_function(path: str, resolver: NameResolver, fn: ast.AST,
+                   findings: List[Finding],
+                   seen: Set[tuple]) -> None:
+    """Walk ``fn``'s full subtree (nested defs are traced too), tracking
+    the innermost divergence context."""
+
+    def visit_block(stmts, div: Optional[str]) -> None:
+        cur = div
+        for st in stmts:
+            visit(st, cur)
+            if isinstance(st, ast.If) and cur is None \
+                    and not _test_is_uniform(resolver, st.test) \
+                    and _has_early_exit(st):
+                cur = (f"code after a data-dependent early exit "
+                       f"(line {st.lineno})")
+
+    def visit(node: ast.AST, div: Optional[str]) -> None:
+        if isinstance(node, ast.If):
+            visit(node.test, div)
+            inner = div
+            if inner is None and not _test_is_uniform(resolver, node.test):
+                inner = f"a data-dependent branch (line {node.lineno})"
+            visit_block(node.body, inner)
+            visit_block(node.orelse, inner)
+            return
+        if isinstance(node, ast.IfExp):
+            visit(node.test, div)
+            inner = div
+            if inner is None and not _test_is_uniform(resolver, node.test):
+                inner = (f"a data-dependent conditional expression "
+                         f"(line {node.lineno})")
+            visit(node.body, inner)
+            visit(node.orelse, inner)
+            return
+        if isinstance(node, ast.While):
+            visit(node.test, div)
+            inner = div
+            if inner is None and not _test_is_uniform(resolver, node.test):
+                inner = f"a data-dependent loop (line {node.lineno})"
+            visit_block(node.body, inner)
+            visit_block(node.orelse, div)
+            return
+        if isinstance(node, ast.Try):
+            visit_block(node.body, div)
+            for h in node.handlers:
+                visit_block(h.body,
+                            div or f"an exception handler "
+                                   f"(line {h.lineno})")
+            visit_block(node.orelse, div)
+            visit_block(node.finalbody, div)
+            return
+        if isinstance(node, ast.Call):
+            name = _collective_name(resolver, node)
+            if name is not None and div is not None:
+                key = (node.lineno, node.col_offset)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(Finding(
+                        path, node.lineno, node.col_offset + 1, RULE_ID,
+                        f"collective {name}() issued under {div}: hosts "
+                        f"that branch differently deadlock the slice at "
+                        f"the rendezvous; issue the collective "
+                        f"unconditionally (mask/select the payload "
+                        f"instead) or make the predicate trace-time "
+                        f"uniform"))
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            visit_block(node.body, div)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, div)
+
+    visit_block(getattr(fn, "body", []), None)
+
+
+def check_project(index) -> List[Finding]:
+    """Project-rule entry: scan every jit/shard_map-reachable function."""
+    findings: List[Finding] = []
+    node_to_qname = {id(fi.node): q for q, fi in index.functions.items()}
+    to_scan: List[tuple] = []          # (module path, function node)
+    scanned: Set[int] = set()
+    entry_qnames: List[str] = []
+    for path in sorted(index.modules):
+        mi = index.modules[path]
+        for fn in jitted_functions(mi.tree, mi.resolver):
+            if id(fn) not in scanned:
+                scanned.add(id(fn))
+                to_scan.append((path, fn))
+            q = node_to_qname.get(id(fn))
+            if q is not None:
+                entry_qnames.append(q)
+    # closure: indexed functions reachable from indexed jit entries
+    for q in index.reachable_from(entry_qnames):
+        fi = index.functions[q]
+        if id(fi.node) not in scanned:
+            scanned.add(id(fi.node))
+            to_scan.append((fi.module, fi.node))
+    seen_by_module: dict = {}
+    for path, fn in to_scan:
+        if not policy.is_library(path) or \
+                path in policy.COLLECTIVE_DIVERGENCE_MODULES:
+            continue
+        _scan_function(path, index.modules[path].resolver, fn, findings,
+                       seen_by_module.setdefault(path, set()))
+    return findings

@@ -83,15 +83,19 @@ class HbmSampler:
         self.interval_s = float(interval_s)
         self.stats: Dict[str, int] = {}
         self.samples = 0
+        # sample() runs on both the sampler thread and the caller's
+        # (start/stop probes); the merge must be atomic between them
+        self._lock = threading.Lock()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def sample(self) -> None:
         fresh = local_device_stats(self.devices)
         if fresh:
-            self.samples += 1
-            for k, v in fresh.items():
-                self.stats[k] = max(self.stats.get(k, 0), v)
+            with self._lock:
+                self.samples += 1
+                for k, v in fresh.items():
+                    self.stats[k] = max(self.stats.get(k, 0), v)
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):
@@ -113,9 +117,10 @@ class HbmSampler:
             self._thread.join(timeout=5.0)
             self._thread = None
         self.sample()
-        out = dict(self.stats)
-        if self.samples:
-            out["hbm_samples"] = self.samples
+        with self._lock:
+            out = dict(self.stats)
+            if self.samples:
+                out["hbm_samples"] = self.samples
         return out
 
 

@@ -197,6 +197,10 @@ class ThreadWriter:
         self.backoff_s = float(backoff_s)
         self.on_retry = on_retry
         self._q: "queue.Queue" = queue.Queue()
+        # _exc crosses threads (writer sets it, dispatch thread reads and
+        # clears it); the lock makes the handoff a clean publish instead
+        # of a data race (thread-shared-state invariant)
+        self._exc_lock = threading.Lock()
         self._exc: Optional[BaseException] = None
         self._thread = threading.Thread(
             target=self._loop, name="fakepta-chunk-writer", daemon=True)
@@ -208,13 +212,16 @@ class ThreadWriter:
             if item is _STOP:
                 return
             drain, cancel = item
-            if self._exc is None:
+            with self._exc_lock:
+                failed = self._exc is not None
+            if not failed:
                 try:
                     run_drain_with_retry(drain, self.retries,
                                          self.backoff_s,
                                          on_retry=self.on_retry)
                 except BaseException as exc:   # noqa: BLE001 — re-raised
-                    self._exc = exc            # in the dispatch thread
+                    with self._exc_lock:       # in the dispatch thread
+                        self._exc = exc
                     cancel()
             else:
                 cancel()
@@ -232,8 +239,9 @@ class ThreadWriter:
         return _now() - t0
 
     def _raise_pending(self) -> None:
-        if self._exc is not None:
+        with self._exc_lock:
             exc, self._exc = self._exc, None
+        if exc is not None:
             raise exc
 
     def close(self, timeout: Optional[float] = None) -> None:
@@ -258,7 +266,8 @@ class ThreadWriter:
         """Stop the thread without re-raising (error-path cleanup)."""
         self._q.put(_STOP)
         self._thread.join(timeout=60.0)
-        self._exc = None
+        with self._exc_lock:
+            self._exc = None
 
 
 def donation_unsafe(mesh) -> bool:

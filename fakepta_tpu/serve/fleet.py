@@ -304,6 +304,7 @@ class SocketReplica:
         if not self.alive:
             raise ReplicaDead(f"replica {self.id} is dead")
         fut: Future = Future()
+        send_exc: Optional[OSError] = None
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
@@ -313,9 +314,11 @@ class SocketReplica:
                 self.sock.sendall(line.encode())
             except OSError as exc:
                 self._pending.pop(req_id, None)
-                self._die_locked(repr(exc))
-                raise ReplicaDead(
-                    f"replica {self.id} send failed: {exc!r}") from exc
+                send_exc = exc
+        if send_exc is not None:
+            self._die(repr(send_exc))
+            raise ReplicaDead(
+                f"replica {self.id} send failed: {send_exc!r}") from send_exc
         return fut
 
     def _read_loop(self):
@@ -327,11 +330,9 @@ class SocketReplica:
                         f"replica {self.id} sent an oversized frame")
                 self._on_line(json.loads(raw.decode("utf-8", "replace")))
         except (OSError, ValueError, ReplicaDead) as exc:
-            with self._lock:
-                self._die_locked(repr(exc))
+            self._die(repr(exc))
             return
-        with self._lock:
-            self._die_locked("connection closed (EOF)")
+        self._die("connection closed (EOF)")
 
     def _on_line(self, d: dict):
         with self._lock:
@@ -352,12 +353,23 @@ class SocketReplica:
             fut.set_exception(exc_cls(d.get("error", f"replica error "
                                                      f"({code})")))
 
-    def _die_locked(self, why: str):
-        if not self.alive and not self._pending:
-            return
-        self.alive = False
+    def _die(self, why: str):
+        """Mark the replica dead and fail its in-flight futures.
+
+        Two phases: state flips under ``self._lock``, futures resolve
+        OUTSIDE it. ``set_exception`` runs completion callbacks
+        synchronously — the fleet's failover callback re-submits to a
+        *sibling* replica and takes the fleet lock plus the sibling's
+        lock, so resolving under our own lock is a cross-instance ABBA
+        (two replicas dying concurrently while dispatch fails over in
+        the other direction deadlock; the lock-order-inversion rule
+        catches exactly this shape)."""
+        with self._lock:
+            if not self.alive and not self._pending:
+                return
+            self.alive = False
+            pending, self._pending = self._pending, {}
         flightrec.note("fleet_replica_lost", replica=self.id, why=why[:200])
-        pending, self._pending = self._pending, {}
         for fut in pending.values():
             if not fut.done():
                 fut.set_exception(ReplicaDead(
@@ -369,6 +381,7 @@ class SocketReplica:
         if not self.alive:
             raise ReplicaDead(f"replica {self.id} is dead")
         fut: Future = Future()
+        send_exc: Optional[OSError] = None
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
@@ -379,9 +392,11 @@ class SocketReplica:
                     .encode())
             except OSError as exc:
                 self._pending.pop(req_id, None)
-                self._die_locked(repr(exc))
-                raise ReplicaDead(
-                    f"replica {self.id} send failed: {exc!r}") from exc
+                send_exc = exc
+        if send_exc is not None:
+            self._die(repr(send_exc))
+            raise ReplicaDead(
+                f"replica {self.id} send failed: {send_exc!r}") from send_exc
         got = fut.result(timeout=timeout)
         return got if isinstance(got, dict) else {}
 
@@ -400,6 +415,7 @@ class SocketReplica:
         if not self.alive:
             raise ReplicaDead(f"replica {self.id} is dead")
         fut: Future = Future()
+        send_exc: Optional[OSError] = None
         with self._lock:
             req_id = self._next_id
             self._next_id += 1
@@ -410,9 +426,11 @@ class SocketReplica:
                     .encode())
             except OSError as exc:
                 self._pending.pop(req_id, None)
-                self._die_locked(repr(exc))
-                raise ReplicaDead(
-                    f"replica {self.id} send failed: {exc!r}") from exc
+                send_exc = exc
+        if send_exc is not None:
+            self._die(repr(send_exc))
+            raise ReplicaDead(
+                f"replica {self.id} send failed: {send_exc!r}") from send_exc
         try:
             fut.result(timeout=deadline_s)
         except concurrent.futures.TimeoutError:
@@ -445,7 +463,10 @@ class SocketReplica:
                 self.proc.wait(timeout=30)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
-        self.alive = False
+        # _die (not a bare attribute write): `alive` is read by dispatch
+        # and health threads, so the flip must happen under self._lock,
+        # and any straggler in-flight futures must fail rather than hang
+        self._die("replica closed")
 
 
 def _result_from_json(d: dict):

@@ -397,16 +397,22 @@ class ServePool:
             err = ServeError(f"serve dispatcher thread died: {exc!r}; "
                              f"queued requests failed, pool is closed")
             err.__cause__ = exc
+            # collect under the lock, resolve OUTSIDE it: set_exception
+            # fires completion callbacks synchronously (fleet failover
+            # re-enters replica/fleet locks), so failing futures under
+            # self._cond is a lock-order inversion (see the analyzer's
+            # lock-order-inversion rule and docs/INVARIANTS.md)
+            doomed = []
             with self._cond:
                 self._closed = True
-                n = 0
                 for q in self._queues.values():
                     while q:
-                        q.popleft().fut.set_exception(err)
-                        n += 1
-                self._pending -= n
-                self._stats.failed += n
+                        doomed.append(q.popleft())
+                self._pending -= len(doomed)
+                self._stats.failed += len(doomed)
                 self._cond.notify_all()
+            for p in doomed:
+                p.fut.set_exception(err)
             raise
 
     def _dispatch_loop_inner(self):
@@ -725,6 +731,7 @@ class ServePool:
         """Shut down: ``drain=True`` serves everything already admitted
         (new submissions raise ServeClosed), ``drain=False`` fails pending
         requests with ServeClosed."""
+        doomed = []
         with self._cond:
             if self._closed:
                 return
@@ -732,10 +739,13 @@ class ServePool:
             if not drain:
                 for q in self._queues.values():
                     while q:
-                        p = q.popleft()
-                        p.fut.set_exception(ServeClosed("pool closed"))
+                        doomed.append(q.popleft())
                         self._pending -= 1
             self._cond.notify_all()
+        # futures resolve outside the cond: completion callbacks run
+        # synchronously and may take other locks (lock-order-inversion)
+        for p in doomed:
+            p.fut.set_exception(ServeClosed("pool closed"))
         # bounded joins: a dispatcher wedged in a hung drain must surface
         # as a loud note, never hang the caller's shutdown forever (the
         # unbounded-thread-join invariant, docs/INVARIANTS.md)

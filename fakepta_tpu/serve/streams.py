@@ -45,40 +45,57 @@ class StreamManager:
 
     def _session(self, req):
         """The (lock, state) pair for ``req.stream``, opening it when the
-        request carries a spec."""
+        request carries a spec.
+
+        Two-phase open: the registry lock is held only for the dict
+        lookups — :class:`StreamState` construction (device allocation,
+        checkpoint REPLAY, potentially seconds of work) happens with no
+        manager lock held, so appends to every *other* stream keep
+        flowing while one stream opens (the blocking-under-lock
+        invariant). A racing open of the same name keeps the first
+        registered state and discards the loser (replay is read-only, so
+        the discarded state touched nothing)."""
         name = str(req.stream)
         if not name:
             raise ServeError("stream requests need a non-empty stream name")
         with self._lock:
             entry = self._streams.get(name)
-            if entry is not None:
-                if getattr(req, "spec", None) is not None:
-                    flightrec.note("serve_stream_reopen_ignored",
-                                   stream=name)
-                return entry
-            spec = getattr(req, "spec", None)
-            if spec is None:
-                raise ServeError(
-                    f"stream {name!r} is not open; the first append must "
-                    f"carry a spec (its array is the frozen-grid template)")
-            if not isinstance(spec, ArraySpec):
-                raise ServeError("stream templates must be declarative "
-                                 "ArraySpecs (named simulator "
-                                 "registrations have no batch to pin a "
-                                 "grid from)")
-            from ..stream import StreamState
-
-            template, _gwb = spec.parts()
-            state = StreamState(template, mesh=self.mesh,
-                                ecorr_dt=req.ecorr_dt, watch=req.watch,
-                                checkpoint=req.checkpoint)
-            entry = (threading.Lock(), state)
-            self._streams[name] = entry
-            flightrec.note("serve_stream_open", stream=name,
-                           npsr=state.npsr,
-                           replayed=int(state.appends),
-                           rolled_back=int(state.rolled_back))
+        if entry is not None:
+            if getattr(req, "spec", None) is not None:
+                flightrec.note("serve_stream_reopen_ignored",
+                               stream=name)
             return entry
+        spec = getattr(req, "spec", None)
+        if spec is None:
+            raise ServeError(
+                f"stream {name!r} is not open; the first append must "
+                f"carry a spec (its array is the frozen-grid template)")
+        if not isinstance(spec, ArraySpec):
+            raise ServeError("stream templates must be declarative "
+                             "ArraySpecs (named simulator "
+                             "registrations have no batch to pin a "
+                             "grid from)")
+        from ..stream import StreamState
+
+        template, _gwb = spec.parts()
+        state = StreamState(template, mesh=self.mesh,
+                            ecorr_dt=req.ecorr_dt, watch=req.watch,
+                            checkpoint=req.checkpoint)
+        entry = (threading.Lock(), state)
+        with self._lock:
+            raced = self._streams.get(name)
+            if raced is not None:
+                entry = None
+            else:
+                self._streams[name] = entry
+        if entry is None:
+            flightrec.note("serve_stream_open_race", stream=name)
+            return raced
+        flightrec.note("serve_stream_open", stream=name,
+                       npsr=state.npsr,
+                       replayed=int(state.appends),
+                       rolled_back=int(state.rolled_back))
+        return entry
 
     def handle(self, req) -> dict:
         """Execute one stream-affine request; returns the wire payload."""

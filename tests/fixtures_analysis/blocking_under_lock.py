@@ -1,0 +1,39 @@
+"""Seeded blocking-under-lock: unbounded waits while a lock is held,
+directly and through the call graph; the Condition.wait and bounded
+variants below are the sanctioned negative controls."""
+import queue
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._q = queue.Queue(maxsize=8)
+        self._evt = threading.Event()
+
+    def poll(self):
+        with self._lock:
+            return self._q.get()
+
+    def pump(self, item):
+        with self._lock:
+            self._q.put(item)
+
+    def gate(self):
+        with self._lock:
+            self._evt.wait()
+
+    def _helper_blocks(self):
+        self._evt.wait()
+
+    def indirect(self):
+        with self._lock:
+            self._helper_blocks()
+
+    def sanctioned(self, item):
+        with self._cond:
+            self._cond.wait()
+        with self._lock:
+            self._q.put_nowait(item)
+        return self._q.get(timeout=1.0)

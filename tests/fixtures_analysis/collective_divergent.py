@@ -1,0 +1,47 @@
+"""Seeded collective-divergence: collectives under data-dependent
+branches, an exception handler, an early return, and through a helper;
+``good`` issues the same collectives under trace-time-uniform config and
+is the negative control."""
+import jax
+from jax import lax
+
+
+@jax.jit
+def bad_branch(x, flag):
+    if flag.sum() > 0:
+        return lax.psum(x, "real")
+    return x
+
+
+@jax.jit
+def bad_handler(x):
+    try:
+        y = x * 2
+    except TypeError:
+        y = lax.all_gather(x, "real")
+    return y
+
+
+@jax.jit
+def bad_early_return(x):
+    if x.mean() > 0:
+        return x
+    return lax.ppermute(x, "real", perm=[(0, 1)])
+
+
+def _helper(x, flag):
+    if flag.any():
+        return lax.pbroadcast(x, "real")
+    return x
+
+
+@jax.jit
+def bad_via_helper(x, flag):
+    return _helper(x, flag)
+
+
+@jax.jit
+def good(x, use_sum, axis_size):
+    if use_sum and axis_size > 1:
+        return lax.psum(x, "real")
+    return lax.pmean(x, "real")

@@ -1,0 +1,24 @@
+"""Seeded lock-order-inversion: the ABBA cycle exists ONLY across the
+call graph — ``forward`` nests A->B inline, ``backward`` reaches B->A
+through a helper; no single function (or per-file pass) sees the cycle."""
+import threading
+
+
+class Worker:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.jobs = []
+
+    def forward(self):
+        with self._a:
+            with self._b:
+                self.jobs.append(1)
+
+    def backward(self):
+        with self._b:
+            self._drain()
+
+    def _drain(self):
+        with self._a:
+            self.jobs.clear()

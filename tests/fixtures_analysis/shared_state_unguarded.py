@@ -1,0 +1,25 @@
+"""Seeded thread-shared-state: ``hits`` is written by the sampler thread
+AND external callers with no common lock; ``errors`` (locked on every
+write path) is the negative control."""
+import threading
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.errors = 0
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+
+    def _loop(self):
+        while True:
+            self.hits += 1
+            with self._lock:
+                self.errors += 1
+
+    def bump(self):
+        self.hits += 2
+
+    def note(self):
+        with self._lock:
+            self.errors += 1

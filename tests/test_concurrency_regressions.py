@@ -1,0 +1,199 @@
+"""Regression pins for the whole-program concurrency findings (PR 16).
+
+Each test pins one library fix the lock-order / blocking-under-lock /
+thread-shared-state rules forced:
+
+- ``SocketReplica._die`` resolves in-flight futures OUTSIDE the replica
+  lock (the interprocedural ABBA: set_exception runs fleet failover
+  callbacks synchronously, which take the fleet lock and a *sibling*
+  replica's lock);
+- ``ServePool.close(drain=False)`` and the dispatcher death handler fail
+  queued futures outside the pool condition for the same reason;
+- ``StreamManager`` builds ``StreamState`` (checkpoint replay, device
+  allocation) with the manager lock released, so other streams keep
+  serving during a slow open;
+- ``ThreadWriter`` publishes its cross-thread exception under a lock;
+- ``HbmSampler.sample`` merges concurrently-sampled watermarks under a
+  lock.
+
+All tests are pure-threading unit tests — no subprocess replicas, no
+device work — so the pins cost milliseconds of tier-1 budget.
+"""
+
+import threading
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import pytest
+
+from fakepta_tpu.serve.fleet import ReplicaDead, SocketReplica
+from fakepta_tpu.serve.spec import ArraySpec, ServeClosed
+
+
+def _bare_socket_replica() -> SocketReplica:
+    """A SocketReplica with just the attributes _die touches — no process
+    spawn, no socket."""
+    r = SocketReplica.__new__(SocketReplica)
+    r.id = "test-replica"
+    r._lock = threading.Lock()
+    r._pending = {}
+    r.alive = True
+    return r
+
+
+def test_socket_replica_die_resolves_futures_outside_lock():
+    """set_exception fires done-callbacks synchronously; a callback must
+    be able to take the replica lock (fleet failover does exactly that).
+    Holding it across resolution was the seeded ABBA deadlock."""
+    r = _bare_socket_replica()
+    fut: Future = Future()
+    r._pending[7] = fut
+    lock_free = []
+    fut.add_done_callback(
+        lambda f: lock_free.append(r._lock.acquire(blocking=False)))
+    r._die("injected failure")
+    assert lock_free == [True], \
+        "done-callback ran while SocketReplica._lock was held"
+    r._lock.release()
+    assert r.alive is False
+    assert r._pending == {}
+    with pytest.raises(ReplicaDead):
+        fut.result(timeout=0)
+    # idempotent: a second death (reader EOF after close) is a no-op
+    r._die("again")
+
+
+def test_socket_replica_close_flips_alive_under_lock_and_fails_pending():
+    r = _bare_socket_replica()
+    r.sock = SimpleNamespace(close=lambda: None)
+    r.proc = None
+    fut: Future = Future()
+    r._pending[1] = fut
+    r.close()
+    assert r.alive is False
+    with pytest.raises(ReplicaDead):
+        fut.result(timeout=0)
+
+
+def test_pool_close_nodrain_fails_futures_outside_cond():
+    """close(drain=False) collects doomed requests under the cond and
+    resolves them after releasing it — a completion callback may take
+    pool/fleet locks."""
+    from fakepta_tpu.serve.scheduler import ServePool, _CohortQueue, \
+        _Pending, _Stats
+
+    pool = ServePool.__new__(ServePool)
+    pool._lock = threading.Lock()
+    pool._cond = threading.Condition(pool._lock)
+    pool._closed = False
+    pool._pending = 1
+    pool._stats = _Stats(window=64)
+    pool._stream_mgr = None
+    q = _CohortQueue(maxlen=4)
+    fut: Future = Future()
+    req = SimpleNamespace(n=1, kind="emit", deadline_s=None)
+    q.append(_Pending(req=req, fut=fut, spec_hash="h", cohort_key="k",
+                      t_enq=0.0, deadline=None))
+    pool._queues = {"k": q}
+    done_thread = threading.Thread(target=lambda: None)
+    done_thread.start()
+    done_thread.join()
+    pool._dispatcher = done_thread
+    pool._demux_thread = done_thread
+    import queue as queue_mod
+    pool._demux_q = queue_mod.Queue()
+
+    cond_free = []
+    fut.add_done_callback(
+        lambda f: cond_free.append(pool._cond.acquire(blocking=False)))
+    pool.close(drain=False)
+    assert cond_free == [True], \
+        "future resolved while ServePool._cond was held"
+    pool._cond.release()
+    assert pool._closed is True
+    with pytest.raises(ServeClosed):
+        fut.result(timeout=0)
+
+
+def test_stream_manager_builds_state_outside_manager_lock(monkeypatch):
+    """StreamState construction (checkpoint replay) must not serialize
+    every other stream behind StreamManager._lock."""
+    from fakepta_tpu import stream as stream_pkg
+    from fakepta_tpu.serve.streams import StreamManager
+
+    mgr = StreamManager()
+    lock_free = []
+
+    class ProbeState:
+        npsr = 3
+        appends = 0
+        rolled_back = 0
+
+        def __init__(self, template, mesh=None, ecorr_dt=None,
+                     watch=None, checkpoint=None):
+            got = mgr._lock.acquire(blocking=False)
+            lock_free.append(got)
+            if got:
+                mgr._lock.release()
+
+    class FakeSpec(ArraySpec):
+        def parts(self):
+            return None, None
+
+    monkeypatch.setattr(stream_pkg, "StreamState", ProbeState)
+    req = SimpleNamespace(stream="s0", spec=FakeSpec(), ecorr_dt=None,
+                          watch=None, checkpoint=None)
+    lock, state = mgr._session(req)
+    assert lock_free == [True], \
+        "StreamState was constructed while StreamManager._lock was held"
+    assert isinstance(state, ProbeState)
+    assert mgr.stream_names() == ["s0"]
+    # reopen with a spec reuses the live session (grid contract)
+    lock2, state2 = mgr._session(req)
+    assert state2 is state and lock2 is lock
+
+
+def test_thread_writer_exception_handoff_is_locked():
+    """The writer thread publishes _exc, the dispatch thread consumes it;
+    the handoff happens under _exc_lock and still re-raises exactly once
+    at the next submit."""
+    from fakepta_tpu.parallel.pipeline import ThreadWriter
+
+    w = ThreadWriter()
+    assert isinstance(w._exc_lock, type(threading.Lock()))
+    boom = RuntimeError("drain failed")
+    cancelled = threading.Event()
+
+    def bad_drain():
+        raise boom
+
+    w.submit(bad_drain, cancel=cancelled.set)
+    assert cancelled.wait(timeout=10.0)
+    with pytest.raises(RuntimeError, match="drain failed"):
+        for _ in range(200):
+            w.submit(lambda: None)
+    w.abort()
+    with w._exc_lock:
+        assert w._exc is None
+
+
+def test_hbm_sampler_concurrent_samples_all_counted():
+    from fakepta_tpu.obs.memwatch import HbmSampler
+
+    class FakeDev:
+        addressable = True
+
+        def memory_stats(self):
+            return {"bytes_in_use": 64, "peak_bytes_in_use": 128}
+
+    sampler = HbmSampler([FakeDev()], interval_s=0.01)
+    n_threads, n_calls = 4, 50
+    threads = [threading.Thread(
+        target=lambda: [sampler.sample() for _ in range(n_calls)])
+        for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sampler.samples == n_threads * n_calls
+    assert sampler.stats["peak_bytes_in_use"] == 128

@@ -266,6 +266,10 @@ def test_mega_bf16_certified_against_f32(batch, mega_sim):
     np.testing.assert_allclose(b16["autos"], f32["autos"], rtol=2e-2)
 
 
+@pytest.mark.slow   # ~17 s: tier-1 budget reclaim (ISSUE 16) — the two
+# axes stay tier-1-covered separately (test_mega_mesh_invariance for
+# mesh shapes, test_mega_bf16_certified_against_f32 for the cast); this
+# is their cross product
 def test_mega_bf16_mesh_invariance(batch):
     """The bf16 cast happens per shard BEFORE the gather, deterministically
     from mesh-invariant draws — bf16 streams agree across mesh shapes at

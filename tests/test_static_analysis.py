@@ -19,7 +19,8 @@ import sys
 
 import pytest
 
-from fakepta_tpu.analysis import (RULE_IDS, apply_baseline, check_source,
+from fakepta_tpu.analysis import (PROJECT_RULE_IDS, RULE_IDS, apply_baseline,
+                                  check_source, check_source_project,
                                   load_baseline, save_baseline)
 from fakepta_tpu.analysis import engine, policy
 
@@ -84,6 +85,43 @@ CASES = [
 ]
 
 
+# whole-program fixtures: two-pass analysis (per-file rules + project
+# rules over a single-module index). lock_order_abba's cycle needs the
+# call graph — `backward` holds _b and reaches _a only through _drain —
+# so it is the interprocedural-only witness.
+PROJECT_CASES = [
+    ("lock_order_abba.py",
+     {("lock-order-inversion", 15)}),
+    ("blocking_under_lock.py",
+     {("blocking-under-lock", 17), ("blocking-under-lock", 21),
+      ("blocking-under-lock", 25), ("blocking-under-lock", 32)}),
+    ("shared_state_unguarded.py",
+     {("thread-shared-state", 16)}),
+    ("collective_divergent.py",
+     {("collective-divergence", 12), ("collective-divergence", 21),
+      ("collective-divergence", 29), ("collective-divergence", 34)}),
+]
+
+
+@pytest.mark.parametrize("fname,expected",
+                         PROJECT_CASES, ids=[c[0] for c in PROJECT_CASES])
+def test_project_corpus_exact_findings(fname, expected):
+    source = (CORPUS / fname).read_text()
+    rel = LIB.format(fname.removesuffix(".py"))
+    got = {(f.rule, f.line) for f in check_source_project(rel, source)}
+    assert got == expected, (
+        f"{fname}: expected {sorted(expected)}, got {sorted(got)}")
+
+
+def test_every_project_rule_has_a_true_positive():
+    seeded = set()
+    for _, expected in PROJECT_CASES:
+        seeded |= {rule for rule, _ in expected}
+    assert set(PROJECT_RULE_IDS) == seeded, (
+        f"project rules without a seeded true positive: "
+        f"{set(PROJECT_RULE_IDS) - seeded}")
+
+
 @pytest.mark.parametrize("fname,relfmt,expected",
                          CASES, ids=[c[0] for c in CASES])
 def test_corpus_exact_findings(fname, relfmt, expected):
@@ -138,6 +176,15 @@ def test_dtype_policy_paths_exist():
     for rel in policy.UNBOUNDED_JOIN_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale UNBOUNDED_JOIN_MODULES entry: {rel}"
+    for rel in policy.BLOCKING_UNDER_LOCK_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale BLOCKING_UNDER_LOCK_MODULES entry: {rel}"
+    for rel in policy.SHARED_STATE_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale SHARED_STATE_MODULES entry: {rel}"
+    for rel in policy.COLLECTIVE_DIVERGENCE_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale COLLECTIVE_DIVERGENCE_MODULES entry: {rel}"
 
 
 def test_pragma_requires_justification_and_use():
@@ -195,7 +242,87 @@ def test_cli_rules_subcommand_lists_all_rules():
     assert proc.returncode == 0
     listed = set(proc.stdout.split())
     assert set(RULE_IDS) <= listed
+    assert set(PROJECT_RULE_IDS) <= listed
     assert engine.PRAGMA_RULE in listed
+
+
+def test_cli_json_format_schema(tmp_path, capsys):
+    """--format json is a stable machine interface: schema tag, count,
+    and per-finding path/line/col/rule/message keys; findings exit 1.
+    In-process ``main()`` — the ~2 s package import per subprocess is
+    tier-1 budget the acceptance-command test already pays once."""
+    from fakepta_tpu.analysis.__main__ import main
+
+    lib = tmp_path / "fakepta_tpu"
+    lib.mkdir()
+    (lib / "mod.py").write_text(
+        "import numpy as np\nnp.random.seed(1)\n")
+    rc = main(["check", str(lib), "--root", str(tmp_path),
+               "--no-baseline", "--format", "json"])
+    payload = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert payload["schema"] == "fakepta_tpu.analysis/1"
+    assert payload["count"] == len(payload["findings"]) == 1
+    f = payload["findings"][0]
+    assert f["path"] == "fakepta_tpu/mod.py"
+    assert f["rule"] == "rng-discipline"
+    assert set(f) == {"path", "line", "col", "rule", "message"}
+    # clean tree: exit 0, same schema, empty findings
+    (lib / "mod.py").write_text("X = 1\n")
+    rc = main(["check", str(lib), "--root", str(tmp_path),
+               "--no-baseline", "--format", "json"])
+    assert rc == 0
+    assert json.loads(capsys.readouterr().out)["count"] == 0
+
+
+def test_cli_graph_dot_export(tmp_path, capsys):
+    """`graph --dot` renders the lock-order graph with cycle edges red."""
+    from fakepta_tpu.analysis.__main__ import main
+
+    lib = tmp_path / "fakepta_tpu"
+    lib.mkdir()
+    (lib / "abba.py").write_text(
+        (CORPUS / "lock_order_abba.py").read_text())
+    rc = main(["graph", str(lib), "--root", str(tmp_path), "--dot"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "digraph lock_order" in out
+    assert "color=red" in out
+    assert "Worker._a" in out and "Worker._b" in out
+    # non-dot mode lists edges with witnesses
+    rc = main(["graph", str(lib), "--root", str(tmp_path)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "->" in out
+
+
+def test_whole_program_pass_stays_fast():
+    """The project pass (index + 4 interprocedural rules over the whole
+    repo) must add well under 10 s to the lint — it runs in CI on every
+    check. Parsing is shared with the per-file pass, so only index build
+    + project rules count against the bound."""
+    import time
+
+    from fakepta_tpu.analysis.project import build_index
+    from fakepta_tpu.analysis.rules import PROJECT_RULES
+
+    contexts = []
+    for path in engine.iter_python_files(
+            [str(REPO / "fakepta_tpu"), str(REPO / "tests"),
+             str(REPO / "examples")]):
+        rel = engine._rel(path, REPO)
+        ctx, err = engine._parse_context(rel, path.read_text())
+        if err is None and ctx.is_library:
+            contexts.append(ctx)
+    assert len(contexts) > 20, "repo walk found too few library modules"
+    t0 = time.monotonic()
+    index = build_index(contexts)
+    for _rule_id, check in PROJECT_RULES:
+        check(index)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 10.0, (
+        f"whole-program pass took {elapsed:.1f}s (budget 10s) — "
+        f"profile LockModel/collectives before shipping")
 
 
 def test_corpus_files_are_skipped_by_directory_walk():
