@@ -127,6 +127,18 @@ RunReport each ``sim.run()`` attaches):
   ``fleet_join_steady_compiles`` must stay 0: an autoscale-joined
   replica prewarms its absorbed shard from the shared compile cache
   (warm loads, not compiles);
+- ``fleet_scrapes`` / ``fleet_scrape_errors`` / ``fleet_alerts`` /
+  ``telemetry_overhead_frac`` / ``trace_flows``: the telemetry-plane lane
+  (``fakepta_tpu.obs.telemetry``, docs/OBSERVABILITY.md; rides the
+  config 15 chaos run). ``fleet_scrapes`` (exempt — scrape volume is the
+  heartbeat cadence, a shape fact) counts publisher snapshots the health
+  plane ingested over the heartbeat's mux'd connections;
+  ``fleet_scrape_errors`` and ``fleet_alerts`` keep the lower-is-better
+  default (the scripted chaos produces a known alert floor; growth past
+  it is replicas degrading unscripted); ``telemetry_overhead_frac`` is
+  the interleaved A/B qps cost of scraping on vs off (lower-better,
+  acceptance <= 0.02) and ``trace_flows`` (exempt shape fact) the number
+  of request trace-id flow chains the exported Chrome trace carries;
 - ``append_latency_ms`` / ``restage_ms`` / ``append_speedup_x`` /
   ``stream_appends`` / ``stream_toas`` / ``stream_rebuckets`` /
   ``stream_recompiles``: the streaming-ingestion lane

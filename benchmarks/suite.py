@@ -534,11 +534,13 @@ def config15():
     headline ``value`` is ``fleet_p99_ms`` UNDER the chaos — the latency
     a client actually sees while the fleet loses, wedges and grows
     replicas."""
+    import os
     import tempfile
 
     import jax
 
     from fakepta_tpu.serve import ArraySpec, run_elastic_loadgen
+    from fakepta_tpu.serve.loadgen import measure_telemetry_overhead
 
     if jax.devices()[0].platform != "cpu":
         spec = ArraySpec(npsr=40, ntoa=260, n_red=10, n_dm=10,
@@ -548,14 +550,23 @@ def config15():
         spec = ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4, gwb_ncomp=4)
         n_requests, transport = 48, "inproc"
     cache = tempfile.mkdtemp(prefix="elastic_cache_")
+    trace_path = os.path.join(cache, "elastic_trace.json")
     row = run_elastic_loadgen(
         spec=spec, n_replicas=3, transport=transport,
         n_requests=n_requests, sizes=(1, 2, 4), n_specs=6, seed=7,
-        verify=3, compile_cache_dir=cache)
+        verify=3, compile_cache_dir=cache, trace_path=trace_path)
     if row["fleet_lost_requests"] or row["fleet_timeouts"]:
         raise RuntimeError(
             "the elastic chaos run lost requests or timed clients out — "
             "the lifecycle plane is broken, refusing to record its row")
+    if transport == "inproc" and not row.get("trace_flows"):
+        # with local replicas every request's router + replica + engine
+        # spans share a trace_id; zero flow links means propagation broke
+        raise RuntimeError(
+            "the chaos run's Chrome trace has no trace-id flow links — "
+            "trace propagation is broken, refusing to record its row")
+    row.update(measure_telemetry_overhead(
+        spec=spec, compile_cache_dir=cache))
     if not row.get("fleet_joins"):
         raise RuntimeError(
             "the autoscaler never joined a replica — the scale-up path "

@@ -177,6 +177,44 @@ SWALLOWED_EXCEPT_MODULES = (
     "fakepta_tpu/obs/memwatch.py",
 )
 
+# metric-name discipline (analysis/rules/metric_names.py): every library
+# call to the obs counter/gauge/timing emitters (obs.count / obs.gauge /
+# obs.observe, the Collector methods on a ``collector`` receiver, and
+# obs.telemetry.publish) must pass a LITERAL name drawn from this registry
+# and matching METRIC_NAME_RE — renaming a metric is a schema change made
+# in obs/metrics.py, not a drive-by edit at a call site, which is what
+# keeps the Prometheus exposition names stable. Duplicated as literals here
+# because the analyzer must not import the package under analysis;
+# test_static_analysis pins this tuple == obs.metrics.METRIC_NAMES.
+METRIC_NAME_RE = r"^[a-z][a-z0-9_.]*$"
+METRIC_NAMES = (
+    "faults.degradations", "faults.injected", "faults.retries",
+    "faults.rollbacks",
+    "fleet.breaker_opens", "fleet.drains", "fleet.heartbeat_misses",
+    "fleet.joins", "fleet.scale_events",
+    "jax.backend_compile_s", "jax.lowering_s", "jax.trace_s",
+    "obs.chunks", "obs.peak_hbm_bytes", "obs.retraces", "obs.traces",
+    "pipeline.d2h_async", "pipeline.h2d_prefetch",
+    "sample.segments_done",
+    "serve.append_latency_s", "serve.stream_requests",
+    "stream.appends", "stream.compiles", "stream.detections",
+    "stream.promotions", "stream.rebuckets", "stream.recompiles",
+    "stream.refresh_gate_holds", "stream.refresh_gate_opens",
+    "stream.refresh_skips", "stream.refreshes", "stream.replays",
+    "telemetry.alerts", "telemetry.scrape_errors", "telemetry.scrapes",
+)
+
+# metric-name-discipline allowlist: library modules sanctioned to emit
+# dynamic (non-literal) metric names. obs/metrics.py defines the emitters —
+# its helpers forward caller-supplied names by construction; obs/timing.py
+# derives ``timer.<name>`` names from caller-chosen Timer labels (the
+# per-timer histogram IS the feature). Everywhere else a computed name
+# would silently mint an unregistered exposition series.
+METRIC_NAME_MODULES = (
+    "fakepta_tpu/obs/metrics.py",
+    "fakepta_tpu/obs/timing.py",
+)
+
 # hardcoded-dispatch-knob allowlist: the ONE library module where literal
 # dispatch-knob values (megakernel rt, pipeline_depth, bucket ladders) may
 # live — the hand-set defaults the autotuner A/Bs against

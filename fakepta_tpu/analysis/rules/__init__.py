@@ -9,13 +9,13 @@ in ``PROJECT_RULES``.
 """
 
 from . import (collectives, donation, dtype, excepts, hostsync, joins,
-               knobs, meshaxis, precision, queues, rng, socketio, timing,
-               tracer)
+               knobs, meshaxis, metric_names, precision, queues, rng,
+               socketio, timing, tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
                               donation, precision, timing, queues, excepts,
-                              knobs, socketio, joins))
+                              knobs, socketio, joins, metric_names))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)
 

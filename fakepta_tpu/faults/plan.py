@@ -31,6 +31,8 @@ site                      where it is checked
 ``fleet.replica``         ServeFleet's router, per dispatch to a replica
 ``fleet.heartbeat``       the fleet health monitor, per replica probe
 ``ingest.append``         StreamState.append, at the top of each TOA block
+``telemetry.scrape``      the fleet health monitor, before each telemetry
+                          scrape riding a successful probe
 ========================  ====================================================
 
 ``fleet.heartbeat`` is checked inside the monitor's probe path with
@@ -38,6 +40,13 @@ site                      where it is checked
 deadline (the wedged-replica simulation: consecutive misses open the
 circuit breaker, docs/RELIABILITY.md "Fleet lifecycle") and a
 ``transient`` is one flaky probe.
+
+``telemetry.scrape`` is checked with ``replica=<id>`` context inside the
+monitor's scrape step, AFTER the heartbeat verdict for that probe is
+already recorded — a raising kind there loses one telemetry snapshot
+(counted ``telemetry.scrape_errors``, flight-recorded) but can never
+produce a heartbeat miss: the scrape is best-effort by contract
+(docs/OBSERVABILITY.md).
 
 ``ingest.append`` is checked BEFORE any state mutates, so a raising kind
 (``transient``/``fatal``) leaves the stream untouched and a retry of the

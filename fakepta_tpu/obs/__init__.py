@@ -24,19 +24,21 @@ fakepta_tpu.obs.trace import build_trace``). The imports below are ordered
 so the function wins the attribute.
 """
 
-from . import flightrec, gate, memwatch
+from . import flightrec, gate, memwatch, promfmt, telemetry, topview
 from . import trace as tracefmt
-from .metrics import (SCHEMA, Collector, EventLog, active, collect, count,
-                      event, gauge, observe, record_span,
-                      subscribe_jax_monitoring)
+from .metrics import (METRIC_NAMES, SCHEMA, SCHEMA_V2, Collector, EventLog,
+                      active, collect, count, event, gauge, observe,
+                      record_span, subscribe_jax_monitoring)
 from .report import (RunReport, format_delta, format_summary, metric_exempt,
                      metric_higher_is_better)
 from .timing import Timer, annotation, now, span, trace
 
 __all__ = [
-    "SCHEMA", "Collector", "EventLog", "RunReport", "Timer", "annotation",
+    "METRIC_NAMES", "SCHEMA", "SCHEMA_V2", "Collector", "EventLog",
+    "RunReport", "Timer", "annotation",
     "active", "collect", "count", "event", "flightrec", "format_delta",
     "format_summary", "gate", "gauge", "memwatch", "metric_exempt",
-    "metric_higher_is_better", "now", "observe", "record_span", "span",
-    "subscribe_jax_monitoring", "trace", "tracefmt",
+    "metric_higher_is_better", "now", "observe", "promfmt", "record_span",
+    "span", "subscribe_jax_monitoring", "telemetry", "topview", "trace",
+    "tracefmt",
 ]

@@ -1,25 +1,36 @@
-"""CLI: ``python -m fakepta_tpu.obs summarize|compare|trace|gate ...``.
+"""CLI: ``python -m fakepta_tpu.obs summarize|compare|trace|gate|top|alerts``.
 
 ``summarize`` prints one report's metric table (flight-recorder dumps get a
-crash banner — spec hash, error, chunks completed); ``compare`` prints a
-per-metric delta table between two reports and flags regressions
-(throughput down, retraces/compile-time/cost-bytes up beyond the relative
-threshold); ``trace`` exports one or more report/event-log shards as Chrome
+crash banner — spec hash, error, chunks completed); given SEVERAL paths
+(or a directory) it interleaves every file's timestamped events into one
+table with a per-replica column — the post-mortem view of a fleet's
+flight-recorder dumps; ``compare`` prints a per-metric delta table between
+two reports and flags regressions (throughput down,
+retraces/compile-time/cost-bytes up beyond the relative threshold);
+``trace`` exports one or more report/event-log shards as Chrome
 trace-event JSON for Perfetto (multi-host shards merge into one trace with
-a pid lane per host); ``gate`` bands a new bench row against the
-BENCH_r*.json history (MAD over same-platform rows) and flags metrics
-outside their noise band. ``compare``/``gate`` exit 0 by default even with
-regressions flagged — they are diff tools; pass ``--fail-on-regression``
-to gate CI on them. Exit 2 on usage/IO errors, mirroring
-``fakepta_tpu.analysis``.
+a pid lane per host, request trace-ids drawn as flows); ``gate`` bands a
+new bench row against the BENCH_r*.json history (MAD over same-platform
+rows) and flags metrics outside their noise band; ``top`` renders the
+fleet telemetry rollup as a refreshing terminal table from a live replica
+socket (``host:port``, polled over the ``telemetry`` protocol kind) or a
+saved ``fakepta_tpu.obs/2`` log; ``alerts`` prints the active and
+historical threshold alerts from the same sources.
+``compare``/``gate`` exit 0 by default even with regressions flagged —
+they are diff tools; pass ``--fail-on-regression`` to gate CI on them.
+Exit 2 on usage/IO errors, mirroring ``fakepta_tpu.analysis``.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+from pathlib import Path
+from typing import List
 
+from .metrics import EventLog
 from .report import RunReport, format_delta, format_summary
 
 
@@ -31,9 +42,13 @@ def build_parser() -> argparse.ArgumentParser:
                     "report.save())")
     sub = parser.add_subparsers(dest="command", required=True)
 
-    summ = sub.add_parser("summarize", help="print one report's metrics")
-    summ.add_argument("report", help="a RunReport .jsonl file (or a "
-                                     "flightrec-*.json crash dump)")
+    summ = sub.add_parser("summarize", help="print one report's metrics, "
+                                            "or interleave several")
+    summ.add_argument("report", nargs="+",
+                      help="RunReport .jsonl file(s) or flightrec-*.json "
+                           "crash dump(s); several paths (or a directory "
+                           "of them) interleave by timestamp with a "
+                           "per-replica column")
     summ.add_argument("--format", choices=("text", "json"), default="text")
 
     comp = sub.add_parser("compare",
@@ -76,11 +91,108 @@ def build_parser() -> argparse.ArgumentParser:
     ga.add_argument("--fail-on-regression", action="store_true",
                     help="exit 1 when any metric leaves its band the "
                          "wrong way")
+
+    def _add_telemetry_source(p):
+        p.add_argument("source",
+                       help="a live replica/router socket as HOST:PORT "
+                            "(polled over the `telemetry` protocol kind) "
+                            "or a saved fakepta_tpu.obs/2 event log")
+
+    top = sub.add_parser(
+        "top", help="refreshing terminal table of the fleet telemetry "
+                    "rollup (per-replica health, qps, p50/p99, queue "
+                    "depth, cache hit rate, breaker state)")
+    _add_telemetry_source(top)
+    top.add_argument("--interval", type=float, default=1.0,
+                     help="refresh interval in seconds (default 1)")
+    top.add_argument("--iterations", type=int, default=None,
+                     help="render this many frames then exit "
+                          "(default: run until ^C; a saved log renders "
+                          "exactly one frame)")
+
+    al = sub.add_parser(
+        "alerts", help="print the telemetry plane's threshold alerts "
+                       "(active excursions + the fired-alert history)")
+    _add_telemetry_source(al)
+    al.add_argument("--format", choices=("text", "json"), default="text")
     return parser
 
 
+def _expand_report_paths(paths) -> List[str]:
+    """CLI paths -> concrete files: a directory expands to every .json /
+    .jsonl it holds (sorted — the fleet's flightrec dump convention)."""
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(str(f) for f in sorted(Path(p).iterdir())
+                       if f.suffix in (".json", ".jsonl"))
+        else:
+            out.append(str(p))
+    if not out:
+        raise ValueError("no report files found")
+    return out
+
+
+def _interleave_rows(paths: List[str]) -> List[dict]:
+    """Timestamped event rows from several artifacts, merged.
+
+    Each file contributes its flight-recorder events (``t_mono_s``),
+    timeline spans (``t0``), and telemetry/alert lines (``t``) tagged with
+    a replica label — ``meta.replica_id`` when the artifact carries one,
+    else ``p<process_index>``, else the file stem. Per-file clocks are
+    run-relative, which is what a fleet post-mortem needs: the dumps were
+    cut at the same wall moment, so lanes align at the tail.
+    """
+    rows: List[dict] = []
+    for path in paths:
+        log = EventLog.load(path)
+        meta = log.meta or {}
+        replica = str(meta.get("replica_id")
+                      or (f"p{meta['process_index']}"
+                          if "process_index" in meta else Path(path).stem))
+        for line in log.lines:
+            kind = line.get("kind")
+            t = None
+            if kind == "event":
+                t, name = line.get("t_mono_s"), line.get("name", "?")
+                detail = line.get("attrs") or {}
+            elif kind == "tl":
+                t, name = line.get("t0"), line.get("name", "?")
+                detail = {k: v for k, v in line.items()
+                          if k not in ("kind", "name", "t0")}
+            elif kind in ("telemetry", "alert"):
+                t, name = line.get("t"), kind
+                detail = {k: v for k, v in line.items()
+                          if k not in ("kind", "t")}
+            if t is None:
+                continue
+            rows.append({"t": float(t), "replica": replica, "name": name,
+                         "detail": detail})
+    rows.sort(key=lambda r: (r["t"], r["replica"]))
+    return rows
+
+
+def _summarize_many(paths: List[str], fmt: str) -> int:
+    rows = _interleave_rows(paths)
+    if fmt == "json":
+        print(json.dumps({"files": len(paths), "events": rows}, indent=2))
+        return 0
+    print(f"{len(paths)} artifact(s), {len(rows)} timestamped event(s)")
+    print(f"{'t_s':>12}  {'replica':<14} {'event':<32} detail")
+    for r in rows:
+        detail = ", ".join(
+            f"{k}={v}" for k, v in sorted(r["detail"].items())
+            if not isinstance(v, (dict, list)))[:120]
+        print(f"{r['t']:>12.6f}  {r['replica']:<14} {r['name']:<32} "
+              f"{detail}")
+    return 0
+
+
 def _cmd_summarize(args) -> int:
-    rep = RunReport.load(args.report)
+    paths = _expand_report_paths(args.report)
+    if len(paths) > 1:
+        return _summarize_many(paths, args.format)
+    rep = RunReport.load(paths[0])
     if args.format == "json":
         print(json.dumps(rep.to_json(), indent=2))
         return 0
@@ -107,6 +219,79 @@ def _cmd_trace(args) -> int:
     print(f"wrote {info['path']}: {info['events']} events "
           f"({info['spans']} spans, {info['processes']} process lane(s)); "
           f"load it at https://ui.perfetto.dev")
+    return 0
+
+
+def _telemetry_fetch(source: str):
+    """A zero-arg rollup fetcher for ``top``/``alerts``.
+
+    ``HOST:PORT`` polls a live serve socket over the ``telemetry``
+    protocol kind, feeding a CLI-local aggregator (same watermark/window
+    logic the fleet router runs); a path loads a saved
+    ``fakepta_tpu.obs/2`` log once. Returns ``(fetch, live)``.
+    """
+    from . import telemetry as telemetry_mod
+
+    host, sep, port = source.rpartition(":")
+    if sep and port.isdigit() and not os.path.exists(source):
+        import socket as socket_mod
+
+        conn = socket_mod.create_connection((host or "127.0.0.1",
+                                             int(port)), timeout=10.0)
+        conn.settimeout(10.0)
+        rfile = conn.makefile("rb")
+        agg = telemetry_mod.TelemetryAggregator()
+        state = {"id": 0}
+
+        def fetch() -> dict:
+            state["id"] += 1
+            conn.sendall((json.dumps({"id": state["id"],
+                                      "kind": "telemetry"}) + "\n")
+                         .encode())
+            line = rfile.readline(8 * 1024 * 1024)
+            if not line:
+                raise EOFError("telemetry source closed the connection")
+            reply = json.loads(line.decode("utf-8", "replace"))
+            snap = reply.get("telemetry") or {}
+            if snap:
+                agg.ingest(source, snap)
+            return agg.rollup()
+
+        return fetch, True
+
+    log = EventLog.load(source)
+
+    def fetch_file() -> dict:
+        return telemetry_mod.rollup_from_event_log(log)
+
+    return fetch_file, False
+
+
+def _cmd_top(args) -> int:
+    from . import topview
+
+    fetch, live = _telemetry_fetch(args.source)
+    iterations = args.iterations if live else 1
+    frames = topview.run_top(fetch, interval_s=args.interval,
+                             iterations=iterations)
+    return 0 if frames else 1
+
+
+def _cmd_alerts(args) -> int:
+    fetch, _live = _telemetry_fetch(args.source)
+    rollup = fetch()
+    alerts = rollup.get("alerts", [])
+    if args.format == "json":
+        print(json.dumps({"alerts": alerts}, indent=2))
+        return 0
+    if not alerts:
+        print("no alerts")
+        return 0
+    for a in alerts:
+        detail = ", ".join(f"{k}={v}" for k, v in sorted(a.items())
+                           if k not in ("rule", "replica"))
+        print(f"{a.get('rule', '?'):<28} {a.get('replica', '?'):<14} "
+              f"{detail}")
     return 0
 
 
@@ -155,6 +340,10 @@ def main(argv=None) -> int:
             return _cmd_trace(args)
         if args.command == "gate":
             return _cmd_gate(args)
+        if args.command == "top":
+            return _cmd_top(args)
+        if args.command == "alerts":
+            return _cmd_alerts(args)
         rep_a = RunReport.load(args.report_a)
         rep_b = RunReport.load(args.report_b)
     except (OSError, ValueError, KeyError) as exc:

@@ -96,6 +96,13 @@ class HbmSampler:
                 self.samples += 1
                 for k, v in fresh.items():
                     self.stats[k] = max(self.stats.get(k, 0), v)
+                peak = self.stats.get("peak_bytes_in_use", 0)
+            # live HBM watermark for the telemetry plane (scraped off the
+            # replica by the heartbeat; the hbm_watermark alert rule reads
+            # it). Published outside the merge lock; lazy import because
+            # obs/__init__ binds memwatch before telemetry.
+            from . import telemetry
+            telemetry.publish("obs.peak_hbm_bytes", int(peak))
 
     def _loop(self) -> None:
         while not self._stop.wait(self.interval_s):

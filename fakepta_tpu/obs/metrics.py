@@ -37,6 +37,56 @@ from . import flightrec
 
 SCHEMA = "fakepta_tpu.obs/1"
 
+#: schema era for logs carrying the telemetry-plane record kinds
+#: (``telemetry`` snapshot lines and ``alert`` lines — docs/OBSERVABILITY.md
+#: "Telemetry plane"). Writers that emit those kinds stamp this schema;
+#: readers accept both eras because /2 is a strict superset of /1 (every /1
+#: kind parses unchanged). Anything else still fails loudly.
+SCHEMA_V2 = "fakepta_tpu.obs/2"
+
+ACCEPTED_SCHEMAS = (SCHEMA, SCHEMA_V2)
+
+#: regex every library-emitted metric name must match (lowercase dotted
+#: words) — the ``metric-name-discipline`` analysis rule enforces it.
+METRIC_NAME_RE = r"^[a-z][a-z0-9_.]*$"
+
+#: Declared metric-name registry. Library calls to ``count``/``gauge``/
+#: ``observe`` must pass a literal name from this table (audited by the
+#: ``metric-name-discipline`` analysis rule, docs/INVARIANTS.md), so the
+#: Prometheus exposition derived from collector state keeps stable names:
+#: renaming a metric is a schema change made HERE, not a drive-by edit at a
+#: call site.
+METRIC_NAMES = frozenset({
+    # fleet lifecycle (serve/health.py, serve/fleet.py, serve/autoscale.py)
+    "fleet.scale_events", "fleet.heartbeat_misses", "fleet.breaker_opens",
+    "fleet.joins", "fleet.drains",
+    # serving plane (serve/scheduler.py)
+    "serve.stream_requests",
+    # streaming ingestion (stream/state.py, stream/refresh.py,
+    # detect/streaming.py)
+    "stream.detections", "stream.promotions", "stream.refreshes",
+    "stream.refresh_skips", "stream.recompiles", "stream.compiles",
+    "stream.rebuckets", "stream.appends", "stream.replays",
+    # retrace guard (parallel/montecarlo.py, sample/run.py)
+    "obs.traces", "obs.retraces",
+    # engine chunk accounting + async-pipeline overlap counters
+    # (parallel/montecarlo.py, sample/run.py)
+    "obs.chunks", "pipeline.d2h_async", "pipeline.h2d_prefetch",
+    # recovery ladder (stream/state.py, parallel/montecarlo.py,
+    # faults/plan.py)
+    "faults.rollbacks", "faults.injected", "faults.degradations",
+    "faults.retries",
+    # HBM watermark live gauge (obs/memwatch.py)
+    "obs.peak_hbm_bytes",
+    # jax.monitoring bridge (renamed duration events, emitted internally)
+    "jax.backend_compile_s", "jax.trace_s", "jax.lowering_s",
+    # telemetry plane (obs/telemetry.py, serve/streams.py, stream/refresh.py,
+    # sample/run.py)
+    "telemetry.scrapes", "telemetry.scrape_errors", "telemetry.alerts",
+    "serve.append_latency_s", "stream.refresh_gate_opens",
+    "stream.refresh_gate_holds", "sample.segments_done",
+})
+
 # jax.monitoring duration events forwarded into collectors, renamed to stable
 # schema keys (the raw jax event paths are an implementation detail of the
 # running jax version)
@@ -190,8 +240,12 @@ class EventLog:
     end.
     """
 
-    def __init__(self, meta: Optional[dict] = None):
+    def __init__(self, meta: Optional[dict] = None, schema: str = SCHEMA):
+        if schema not in ACCEPTED_SCHEMAS:
+            raise ValueError(f"unknown event-log schema {schema!r}; "
+                             f"accepted: {ACCEPTED_SCHEMAS}")
         self.meta = dict(meta or {})
+        self.schema = schema
         self.lines: List[dict] = []
 
     def append(self, kind: str, **fields) -> dict:
@@ -213,7 +267,7 @@ class EventLog:
             self.append("event", **ev)
 
     def to_jsonl(self, summary: Optional[dict] = None) -> str:
-        out = [json.dumps({"kind": "header", "schema": SCHEMA,
+        out = [json.dumps({"kind": "header", "schema": self.schema,
                            "meta": self.meta})]
         out += [json.dumps(line) for line in self.lines]
         if summary is not None:
@@ -236,11 +290,12 @@ class EventLog:
             if i == 0:
                 if line.get("kind") != "header":
                     raise ValueError("event log must start with a header line")
-                if line.get("schema") != SCHEMA:
+                if line.get("schema") not in ACCEPTED_SCHEMAS:
                     raise ValueError(
-                        f"event-log schema {line.get('schema')!r} != "
-                        f"{SCHEMA!r}: refusing to mix telemetry eras")
+                        f"event-log schema {line.get('schema')!r} not in "
+                        f"{ACCEPTED_SCHEMAS}: refusing to mix telemetry eras")
                 log.meta = line.get("meta", {})
+                log.schema = line["schema"]
                 continue
             log.lines.append(line)
         return log

@@ -158,10 +158,55 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # append_speedup_x — higher-better above — and
                   # append_latency_ms / stream_recompiles, lower-better
                   # defaults)
-                  "stream_appends", "stream_toas", "stream_rebuckets"}
+                  "stream_appends", "stream_toas", "stream_rebuckets",
+                  # telemetry-plane shape facts (docs/OBSERVABILITY.md):
+                  # scrape volume rides the heartbeat cadence and trace
+                  # flow counts describe the traffic, not its health (the
+                  # regression-bearing telemetry metrics keep the lower-
+                  # is-better default: fleet_scrape_errors, fleet_alerts,
+                  # telemetry_overhead_frac)
+                  "fleet_scrapes", "trace_flows"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")
+
+# non-numeric row-identity fields of the BENCH schema (bench.py docstring):
+# strings/flags that label a row rather than measure it — `compare` skips
+# non-numerics anyway; this table exists so the direction contract below
+# is total
+ROW_IDENTITY = {"metric", "unit", "platform", "fallback"}
+
+# exact names where smaller is better. Functionally this is the DEFAULT
+# direction — metric_higher_is_better() returns False for any name not in
+# the tables above — so this set changes no behavior. It exists as the
+# explicit other half of the direction contract: every metric key in the
+# bench.py schema docstring must appear in exactly one of HIGHER_IS_BETTER
+# / LOWER_IS_BETTER / EXEMPT_METRICS / ROW_IDENTITY (or match a suffix
+# rule), and the tier-1 completeness test enforces it — a new bench key
+# can no longer pick up a direction silently.
+LOWER_IS_BETTER = {"compile_s", "retraces", "cost_bytes_per_chunk",
+                   "cost_flops_per_chunk", "os_bytes_per_chunk",
+                   "lnlike_bytes_per_chunk", "pipeline_stall_s",
+                   "ckpt_wait_s", "model_bytes_per_chunk",
+                   "cost_bytes_per_chunk_fused",
+                   "cost_bytes_per_chunk_fused_bf16",
+                   "model_bytes_per_chunk_fused",
+                   "model_bytes_per_chunk_fused_bf16",
+                   "rhat_max", "serve_p50_ms", "serve_p99_ms",
+                   "pad_waste_frac", "serve_retraces",
+                   "serve_steady_compiles", "fleet_p50_ms", "fleet_p99_ms",
+                   "fleet_failovers", "fleet_lost_requests",
+                   "fleet_steady_compiles", "fleet_heartbeat_misses",
+                   "fleet_breaker_opens", "fleet_timeouts",
+                   "fleet_join_steady_compiles", "append_latency_ms",
+                   "restage_ms", "stream_recompiles", "faults_retries",
+                   "faults_degradations", "faults_rollbacks",
+                   "tune_probe_s", "peak_hbm_bytes",
+                   # telemetry plane (docs/OBSERVABILITY.md): failed
+                   # scrapes, fired alert rules, and the scrape-on vs
+                   # scrape-off qps cost are all degradations
+                   "fleet_scrape_errors", "fleet_alerts",
+                   "telemetry_overhead_frac"}
 
 
 def metric_higher_is_better(k: str) -> bool:

@@ -34,6 +34,15 @@ The emitted JSON follows the Chrome trace-event format ("JSON Object
 Format": a top-level ``traceEvents`` list of ``ph: "X"/"i"/"M"`` events
 with microsecond ``ts``/``dur``); :func:`validate_trace` checks the
 invariants the format requires and the tests pin it.
+
+**Trace propagation** (docs/OBSERVABILITY.md): serve-layer timeline spans
+carry the router-minted request ``trace_id`` in their args (cohort spans
+carry the coalesced ``trace_ids`` list). :func:`build_trace` links every
+span sharing a trace id into one Chrome **flow** (``ph: "s"/"t"/"f"``
+events with a shared numeric ``id``), so a request reads as one causal
+arrow router → replica → engine across pid lanes — and a failed-over
+request's spans on the dead and surviving replicas are joined by the
+same flow.
 """
 
 from __future__ import annotations
@@ -43,10 +52,14 @@ from typing import Dict, Iterable, List, Optional, Sequence
 
 from .report import RunReport
 
-# stable thread ids per logical lane (sort order = display order)
+# stable thread ids per logical lane (sort order = display order);
+# lanes the serve layer adds ("serve", "router") are allocated past these
+# per report, first-seen order
 TID = {"main": 0, "device": 1, "writer": 2}
 
 _VALID_PH = {"X", "i", "M"}
+#: flow phases (start/step/finish) — trace-id links across pid lanes
+_FLOW_PH = {"s", "t", "f"}
 
 
 def timeline_events(report: RunReport, pid: Optional[int] = None) -> List[dict]:
@@ -68,7 +81,16 @@ def timeline_events(report: RunReport, pid: Optional[int] = None) -> List[dict]:
                    "args": {"name": label}})
     events.append({"ph": "M", "pid": pid, "tid": 0, "name": "process_sort_index",
                    "args": {"sort_index": pid}})
-    for lane, tid in TID.items():
+    # lane table: the three engine lanes plus any serve-layer lanes this
+    # report's timeline introduces ("serve", "router"), in first-seen
+    # order — unknown lanes get their own track instead of stacking on
+    # the dispatch lane
+    lanes: Dict[str, int] = dict(TID)
+    for ev in report.timeline:
+        lane = str(ev.get("tid", "main"))
+        if lane not in lanes:
+            lanes[lane] = max(lanes.values()) + 1
+    for lane, tid in lanes.items():
         events.append({"ph": "M", "pid": pid, "tid": tid,
                        "name": "thread_name", "args": {"name": lane}})
         events.append({"ph": "M", "pid": pid, "tid": tid,
@@ -77,7 +99,7 @@ def timeline_events(report: RunReport, pid: Optional[int] = None) -> List[dict]:
 
     first_exec_t0 = None
     for ev in report.timeline:
-        tid = TID.get(str(ev.get("tid", "main")), 0)
+        tid = lanes[str(ev.get("tid", "main"))]
         name = str(ev.get("name", "?"))
         t0 = float(ev.get("t0", 0.0))
         args = {k: v for k, v in ev.items()
@@ -103,12 +125,56 @@ def timeline_events(report: RunReport, pid: Optional[int] = None) -> List[dict]:
     return events
 
 
+def flow_events(events: Sequence[dict]) -> List[dict]:
+    """Chrome flow events linking spans that share a request trace id.
+
+    Scans built span events for ``args.trace_id`` (and each entry of a
+    cohort span's ``args.trace_ids``), groups by trace id, and for every
+    id carried by two or more spans emits an ``s``/``t``.../``f`` chain
+    with a shared numeric flow ``id``, each link coincident with its
+    anchor span's start (Perfetto binds a flow event to the enclosing
+    slice on the same pid/tid). A failed-over request therefore draws one
+    arrow through the router span, the dead replica's spans, and the
+    surviving replica's spans.
+    """
+    groups: Dict[str, List[dict]] = {}
+    for ev in events:
+        if ev.get("ph") != "X":
+            continue    # flows bind to slices, not instants/metadata
+        args = ev.get("args") or {}
+        ids = []
+        if args.get("trace_id"):
+            ids.append(str(args["trace_id"]))
+        ids.extend(str(t) for t in (args.get("trace_ids") or ()))
+        for trace_id in ids:
+            groups.setdefault(trace_id, []).append(ev)
+    flows: List[dict] = []
+    flow_id = 0
+    for trace_id in sorted(groups):
+        chain = sorted(groups[trace_id],
+                       key=lambda e: (e["ts"], e["pid"], e["tid"]))
+        if len(chain) < 2:
+            continue    # nothing to link
+        flow_id += 1
+        last = len(chain) - 1
+        for k, anchor in enumerate(chain):
+            link = {"ph": "s" if k == 0 else "f" if k == last else "t",
+                    "cat": "trace", "name": f"trace:{trace_id}",
+                    "id": flow_id, "pid": anchor["pid"],
+                    "tid": anchor["tid"], "ts": anchor["ts"]}
+            if k == last:
+                link["bp"] = "e"    # bind to the enclosing slice
+            flows.append(link)
+    return flows
+
+
 def build_trace(reports: Sequence[RunReport]) -> dict:
     """One Chrome trace object merging the given reports (pid per shard).
 
     Shards sharing a ``process_index`` (or lacking one) are assigned
     distinct pids in input order, so merging N single-host artifacts never
-    silently stacks their lanes.
+    silently stacks their lanes. Spans sharing a request ``trace_id``
+    across shards are joined by flow events (:func:`flow_events`).
     """
     events: List[dict] = []
     used_pids: set = set()
@@ -118,11 +184,13 @@ def build_trace(reports: Sequence[RunReport]) -> dict:
             pid += 1
         used_pids.add(pid)
         events.extend(timeline_events(rep, pid=pid))
+    flows = flow_events(events)
+    events.extend(flows)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
         "metadata": {"tool": "fakepta_tpu.obs trace",
-                     "shards": len(reports)},
+                     "shards": len(reports), "flows": len(flows)},
     }
 
 
@@ -143,7 +211,7 @@ def validate_trace(trace: dict) -> None:
         if not isinstance(ev, dict):
             raise ValueError(f"{where}: not an object")
         ph = ev.get("ph")
-        if ph not in _VALID_PH:
+        if ph not in _VALID_PH and ph not in _FLOW_PH:
             raise ValueError(f"{where}: unknown ph {ph!r}")
         if not isinstance(ev.get("name"), str) or not ev["name"]:
             raise ValueError(f"{where}: missing name")
@@ -161,6 +229,8 @@ def validate_trace(trace: dict) -> None:
             dur = ev.get("dur")
             if not isinstance(dur, (int, float)) or dur < 0:
                 raise ValueError(f"{where}: complete event needs dur >= 0")
+        if ph in _FLOW_PH and not isinstance(ev.get("id"), (int, str)):
+            raise ValueError(f"{where}: flow event needs an id")
     json.dumps(trace)   # everything must serialize
 
 
@@ -180,7 +250,9 @@ def export(paths: Sequence, out_path) -> dict:
     spans = sum(1 for ev in trace["traceEvents"] if ev["ph"] == "X")
     pids = {ev["pid"] for ev in trace["traceEvents"]}
     return {"events": len(trace["traceEvents"]), "spans": spans,
-            "processes": len(pids), "path": str(out_path)}
+            "processes": len(pids),
+            "flows": int(trace["metadata"].get("flows", 0)),
+            "path": str(out_path)}
 
 
 def overlap_s(report: RunReport, a: str = "drain", b: str = "execute") -> float:

@@ -815,6 +815,10 @@ class SamplingRun:
             if progress is not None:
                 progress(min(done_steps, total_steps), total_steps)
             obs.flightrec.note("segment_drained", idx=idx)
+            obs.count("sample.segments_done")
+            # live progress gauge for the telemetry plane: scraped off the
+            # replica by the fleet's heartbeat (docs/OBSERVABILITY.md)
+            obs.telemetry.publish("sample.segments_done", int(idx) + 1)
 
         try:
             pipeline_mod.run_drain_with_retry(body, retries, backoff_s,

@@ -48,12 +48,18 @@ Both answer ``{"id", "ok": true, "stream": {...payload...}}`` — the
 append payload carries latency/bucket/recompile counters plus the rolling
 detection statistic when the stream was opened with ``watch``.
 
-plus three fleet-protocol kinds: ``{"id", "kind": "ping"}`` answers
+plus five fleet-protocol kinds: ``{"id", "kind": "ping"}`` answers
 ``{"id", "ok": true, "pong": true}`` inline on the connection thread —
 the health plane's heartbeat probe (serve/health.py): nothing queues
 behind the scheduler, so a missed pong means the process or its socket
 plumbing is stuck, not merely busy; ``{"id", "kind": "stats"}`` answers
-with the pool's live SLO summary; and ``{"id", "kind": "sample", "steps": 64,
+with the pool's live SLO summary plus ``health`` (ladder state),
+``pool`` (warm-pool occupancy), and ``streams`` (open-stream counts);
+``{"id", "kind": "telemetry"}`` answers with one TelemetryPublisher
+snapshot (the health plane's scrape rides this kind on the SAME mux'd
+connection as the heartbeat — zero new sockets, docs/OBSERVABILITY.md);
+``{"id", "kind": "metrics"}`` answers with Prometheus text-format
+exposition in the ``metrics`` field; and ``{"id", "kind": "sample", "steps": 64,
 "seed": 7, "spec": {...}, "session": {"n_chains": 4, ...},
 "checkpoint": "/shared/ck"}`` opens a posterior-as-a-service session that
 STREAMS one line per drained segment (``{"id", "ok": true, "seg": k,
@@ -134,9 +140,11 @@ def request_from_json(d: dict, default_spec: ArraySpec):
         deadline = d.get("deadline_ms")
         deadline_s = (float(deadline) / 1e3 if deadline is not None
                       else None)
+        trace_id = d.get("trace_id")
         if kind == "stream":
             return StreamRequest(stream=str(d["stream"]),
-                                 deadline_s=deadline_s)
+                                 deadline_s=deadline_s,
+                                 trace_id=trace_id)
         arr = lambda k: (np.asarray(d[k], dtype=np.float64)  # noqa: E731
                          if d.get(k) is not None else None)
         return AppendRequest(
@@ -147,7 +155,7 @@ def request_from_json(d: dict, default_spec: ArraySpec):
             ecorr_dt=(float(d["ecorr_dt"])
                       if d.get("ecorr_dt") is not None else None),
             watch=d.get("watch"), checkpoint=d.get("checkpoint"),
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, trace_id=trace_id)
     if spec is None:
         spec = default_spec
     elif isinstance(spec, dict):
@@ -158,13 +166,16 @@ def request_from_json(d: dict, default_spec: ArraySpec):
     seed = int(d.get("seed", 0))
     deadline = d.get("deadline_ms")
     deadline_s = float(deadline) / 1e3 if deadline is not None else None
+    trace_id = d.get("trace_id")
     if kind == "sim":
-        return SimRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s)
+        return SimRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
+                          trace_id=trace_id)
     if kind == "os":
         return OSRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
                          orf=d.get("orf", "hd"),
                          weighting=d.get("weighting", "noise"),
-                         null=bool(d.get("null", False)))
+                         null=bool(d.get("null", False)),
+                         trace_id=trace_id)
     if kind == "infer":
         if d.get("lnlike") is not None:
             # the exact form: a full infer.schema InferSpec document —
@@ -179,7 +190,7 @@ def request_from_json(d: dict, default_spec: ArraySpec):
                 gamma=tuple(grid.get("gamma", (3.0, 6.0))),
                 nbin=int(grid.get("nbin", 10)))
         return InferRequest(spec=spec, n=n, seed=seed, deadline_s=deadline_s,
-                            lnlike=lnlike)
+                            lnlike=lnlike, trace_id=trace_id)
     raise ValueError(f"unknown request kind {kind!r}")
 
 
@@ -227,6 +238,8 @@ def request_to_json(req, req_id) -> dict:
         d = {"id": req_id, "kind": req.kind, "stream": str(req.stream)}
         if req.deadline_s is not None:
             d["deadline_ms"] = req.deadline_s * 1e3
+        if getattr(req, "trace_id", None):
+            d["trace_id"] = req.trace_id
         if req.kind == "append":
             for key in ("toas", "residuals", "sigma2", "freqs",
                         "ecorr_amp", "counts"):
@@ -249,6 +262,11 @@ def request_to_json(req, req_id) -> dict:
          "seed": int(req.seed)}
     if req.deadline_s is not None:
         d["deadline_ms"] = req.deadline_s * 1e3
+    if getattr(req, "trace_id", None):
+        # the propagation contract (docs/OBSERVABILITY.md): the router's
+        # minted trace identity crosses the socket with the request, so
+        # replica-side spans join the client's causal lane
+        d["trace_id"] = req.trace_id
     if isinstance(req.spec, str):
         d["spec"] = req.spec
     elif isinstance(req.spec, ArraySpec):
@@ -347,9 +365,28 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
                 continue
             if kind == "stats":
                 # fleet-protocol introspection: the router audits each
-                # replica's warm-pool health (steady compiles, retraces)
+                # replica's warm-pool health (steady compiles, retraces).
+                # "stats" keeps its historical SLO-summary shape; the
+                # health-ladder state, warm-pool occupancy, and stream
+                # counts ride alongside under their own keys
                 emit_line({"id": req_id, "ok": True,
-                           "stats": pool.slo_summary()})
+                           "stats": pool.slo_summary(),
+                           "health": pool.health_summary(),
+                           "pool": pool.warm_summary(),
+                           "streams": pool.stream_summary()})
+                continue
+            if kind == "telemetry":
+                # the health plane's scrape: one bounded publisher
+                # snapshot, answered inline like ping — it rides the
+                # heartbeat's mux'd connection, never a new socket
+                emit_line({"id": req_id, "ok": True,
+                           "telemetry": pool.telemetry_snapshot()})
+                continue
+            if kind == "metrics":
+                # Prometheus text-format exposition of this replica's
+                # own rollup (docs/OBSERVABILITY.md metric-name table)
+                emit_line({"id": req_id, "ok": True,
+                           "metrics": pool.metrics_text()})
                 continue
             if kind == "sample":
                 _serve_sample(pool, d, req_id, emit_line, default_spec,
@@ -368,10 +405,16 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
             emit_line(error_json(req_id, exc))
             continue
 
-        def _done(f, req_id=req_id):
+        def _done(f, req_id=req_id,
+                  trace_id=getattr(req, "trace_id", None)):
             exc = f.exception()
-            emit_line(error_json(req_id, exc) if exc is not None
-                      else response_json(req_id, f.result(), emit))
+            out = (error_json(req_id, exc) if exc is not None
+                   else response_json(req_id, f.result(), emit))
+            if trace_id:
+                # echo the trace identity so the client's span and the
+                # replica's span share one causal lane in `obs trace`
+                out["trace_id"] = trace_id
+            emit_line(out)
 
         fut.add_done_callback(_done)
         futs.append(fut)

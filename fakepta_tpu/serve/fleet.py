@@ -178,6 +178,14 @@ class LocalReplica:
             raise ReplicaDead(f"replica {self.id} dispatcher is gone")
         return True
 
+    def telemetry(self, deadline_s: float = 1.0) -> dict:
+        """Telemetry scrape (serve/health.py piggyback): one publisher
+        snapshot, read in-process — the deadline is the socket
+        transport's concern."""
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        return self.pool.telemetry_snapshot()
+
     def kill(self) -> None:
         """Simulated replica death: pending work fails like a crashed
         process (the in-process analog of SIGKILL for the chaos tests)."""
@@ -439,6 +447,41 @@ class SocketReplica:
             raise
         return True
 
+    def telemetry(self, deadline_s: float = 1.0) -> dict:
+        """Telemetry scrape over the SAME mux'd connection as requests and
+        pings (protocol kind ``telemetry``) — the zero-new-connections
+        contract of the heartbeat piggyback (docs/OBSERVABILITY.md). A
+        deadline expiry raises like :meth:`ping`; the late snapshot, if it
+        lands, resolves a future nobody holds."""
+        import concurrent.futures
+
+        if not self.alive:
+            raise ReplicaDead(f"replica {self.id} is dead")
+        fut: Future = Future()
+        send_exc: Optional[OSError] = None
+        with self._lock:
+            req_id = self._next_id
+            self._next_id += 1
+            self._pending[req_id] = fut
+            try:
+                self.sock.sendall(
+                    (json.dumps({"id": req_id, "kind": "telemetry"}) + "\n")
+                    .encode())
+            except OSError as exc:
+                self._pending.pop(req_id, None)
+                send_exc = exc
+        if send_exc is not None:
+            self._die(repr(send_exc))
+            raise ReplicaDead(
+                f"replica {self.id} send failed: {send_exc!r}") from send_exc
+        try:
+            got = fut.result(timeout=deadline_s)
+        except concurrent.futures.TimeoutError:
+            with self._lock:
+                self._pending.pop(req_id, None)
+            raise
+        return got if isinstance(got, dict) else {}
+
     def kill(self) -> None:
         """SIGKILL the replica process (the chaos lever: in-flight
         requests fail over through the reader thread's EOF); an adopted
@@ -477,6 +520,10 @@ def _result_from_json(d: dict):
         return {"pong": True}
     if "stats" in d and "curves" not in d:
         return d["stats"]
+    if "telemetry" in d and "curves" not in d:
+        return d["telemetry"]
+    if "metrics" in d and "curves" not in d:
+        return d["metrics"]
     if "stream" in d and "curves" not in d:
         return d["stream"]
     res = ServeResult(
@@ -538,6 +585,20 @@ class ServeFleet:
         self._inflight = collections.Counter()      # replica id -> count
         self._stats = _FleetStats(self.config.result_window)
         self._closed = False
+        # trace propagation (docs/OBSERVABILITY.md): the router mints a
+        # trace_id per request (unless the client line carried one) and a
+        # router-lane timeline of route spans + failover markers — the
+        # fleet report becomes its own pid lane in the merged Chrome trace
+        self._t0 = obs.now()
+        self._trace_seq = 0
+        self._trace_nonce = flightrec.spec_hash(
+            {"kind": "fleet-trace", "nonce": id(self)})[:6]
+        self._timeline = collections.deque(
+            maxlen=self.config.result_window)
+        # fleet-level telemetry rollups, fed by the heartbeat scrape
+        # (serve/health.py) once enable_health() runs
+        from ..obs import telemetry as telemetry_mod
+        self.telemetry = telemetry_mod.TelemetryAggregator()
         # the served working set (spec -> buckets it ran at), LRU-bounded:
         # what join() prewarms onto a new replica's absorbed shard
         self._recent: "collections.OrderedDict" = collections.OrderedDict()
@@ -587,6 +648,17 @@ class ServeFleet:
         else:
             spec_hash = flightrec.spec_hash(
                 {"kind": "registered", "name": req.spec})
+        if getattr(req, "trace_id", None) is None:
+            # mint at the router; a client-supplied trace_id is kept so
+            # callers can stitch fleet spans into their own traces
+            with self._lock:
+                self._trace_seq += 1
+                seq = self._trace_seq
+            try:
+                req = dataclasses.replace(
+                    req, trace_id=f"t{self._trace_nonce}-{seq:06d}")
+            except TypeError:
+                pass          # non-dataclass request object: stays untraced
         outer: Future = Future()
         t = obs.now()
         # ring reads under the fleet lock: membership mutates live now
@@ -758,6 +830,14 @@ class ServeFleet:
                 st.per_replica[rid] += 1
                 if rid == inf.owner_id:
                     st.owner_served += 1
+                ev = {"name": "route", "tid": "router",
+                      "t0": inf.t_enq - self._t0,
+                      "dur": t_done - inf.t_enq, "replica": rid,
+                      "failovers": inf.failovers,
+                      "req_kind": getattr(inf.req, "kind", "?")}
+                if getattr(inf.req, "trace_id", None):
+                    ev["trace_id"] = inf.req.trace_id
+                self._timeline.append(ev)
             inf.outer.set_result(res)
             return
         verdict = faults_mod.classify_replica(exc)
@@ -767,6 +847,12 @@ class ServeFleet:
             inf.failovers += 1
             with self._lock:
                 self._stats.failovers += 1
+                ev = {"name": "fleet_failover", "tid": "router",
+                      "t0": obs.now() - self._t0,
+                      "from_replica": rid, "attempt": inf.failovers}
+                if getattr(inf.req, "trace_id", None):
+                    ev["trace_id"] = inf.req.trace_id
+                self._timeline.append(ev)
             flightrec.note("fleet_failover", spec=inf.spec_hash,
                            from_replica=rid, attempt=inf.failovers)
             # re-dispatch to the ring's next live sibling: per-request RNG
@@ -874,6 +960,8 @@ class ServeFleet:
         boundary); replica pools reset theirs separately."""
         with self._lock:
             self._stats = _FleetStats(self.config.result_window)
+            self._timeline.clear()
+            self._t0 = obs.now()
         if self.health is not None:
             self.health.reset_counters()
         for r in self.replicas.values():
@@ -892,7 +980,11 @@ class ServeFleet:
             "n_chips": self.n_chips,
             "extra_metrics": self.slo_summary(),
         }
-        return RunReport(meta=meta)
+        rep = RunReport(meta=meta)
+        with self._lock:
+            timeline = list(self._timeline)
+        rep.timeline = sorted(timeline, key=lambda e: e.get("t0", 0.0))
+        return rep
 
     def replica_reports(self) -> List:
         """Per-replica RunReports (local transports), each stamped with
@@ -919,8 +1011,22 @@ class ServeFleet:
         from .health import HealthMonitor
 
         if self.health is None:
-            self.health = HealthMonitor(self, config).start()
+            # the monitor's probe loop doubles as the telemetry scraper
+            # (same mux'd connections — docs/OBSERVABILITY.md)
+            self.health = HealthMonitor(
+                self, config, aggregator=self.telemetry).start()
         return self.health
+
+    # -- telemetry plane ---------------------------------------------------
+    def telemetry_rollup(self) -> dict:
+        """The fleet-wide windowed rollup (``obs top``'s data)."""
+        return self.telemetry.rollup()
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the fleet rollup (the router-side
+        twin of the replica ``metrics`` protocol kind)."""
+        from ..obs import promfmt
+        return promfmt.render(self.telemetry.rollup())
 
     # -- elastic membership ------------------------------------------------
     def join(self, replica, prewarm: bool = True,
@@ -998,6 +1104,9 @@ class ServeFleet:
             self._stats.drains += 1
         if self.health is not None:
             self.health.forget(rid)
+        # watermark-correct retirement: the replica's telemetry window is
+        # frozen under `retired`, not dropped
+        self.telemetry.retire(rid)
         obs.count("fleet.drains")
         flightrec.note("fleet_drain", replica=rid, drained=bool(drained),
                        replicas=len(self.replicas))

@@ -65,6 +65,10 @@ class HealthConfig:
     close_after: int = knobs.BREAKER_CLOSE_AFTER
     backoff_base_s: float = knobs.BREAKER_BACKOFF_BASE_S
     backoff_cap_s: float = knobs.BREAKER_BACKOFF_CAP_S
+    #: telemetry scrape cadence: scrape every Nth successful probe of a
+    #: replica (0 disables scraping). The scrape RIDES the heartbeat —
+    #: same mux'd connection, no new sockets (docs/OBSERVABILITY.md)
+    scrape_every: int = knobs.TELEMETRY_SCRAPE_EVERY
 
 
 class _ReplicaHealth:
@@ -88,9 +92,13 @@ class HealthMonitor:
     ``alive`` and ``ping(deadline_s)``) and ``_lock`` guarding the map.
     """
 
-    def __init__(self, fleet, config: Optional[HealthConfig] = None):
+    def __init__(self, fleet, config: Optional[HealthConfig] = None,
+                 aggregator=None):
         self.fleet = fleet
         self.config = config or HealthConfig()
+        #: fleet-level TelemetryAggregator fed by the heartbeat scrape
+        #: (None = health plane only, no telemetry)
+        self.aggregator = aggregator
         self._states: Dict[str, _ReplicaHealth] = {}
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -99,6 +107,8 @@ class HealthMonitor:
         self.breaker_opens = 0
         self.breaker_closes = 0
         self.probes = 0
+        self.scrapes = 0
+        self.scrape_errors = 0
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "HealthMonitor":
@@ -162,6 +172,8 @@ class HealthMonitor:
                 "fleet_breaker_closes": self.breaker_closes,
                 "fleet_breakered": breakered,
                 "fleet_wedged": wedged,
+                "fleet_scrapes": self.scrapes,
+                "fleet_scrape_errors": self.scrape_errors,
             }
 
     def reset_counters(self) -> None:
@@ -172,6 +184,8 @@ class HealthMonitor:
             self.breaker_opens = 0
             self.breaker_closes = 0
             self.probes = 0
+            self.scrapes = 0
+            self.scrape_errors = 0
 
     # -- the monitor thread ------------------------------------------------
     def _run(self) -> None:
@@ -234,6 +248,10 @@ class HealthMonitor:
                                      f"successes")
                 with self._lock:
                     self.breaker_closes += 1
+            # telemetry piggyback: the scrape reuses the probe's mux'd
+            # connection on the probe's cadence — by construction there is
+            # no telemetry socket, timer, or thread to add
+            self._scrape(rid, replica, st)
             st.next_probe_t = now + (cfg.period_s if st.state == "healthy"
                                      else st.backoff_s or cfg.period_s)
             return
@@ -263,6 +281,42 @@ class HealthMonitor:
         else:
             st.next_probe_t = now + cfg.period_s
         return
+
+    def _scrape(self, rid: str, replica, st: _ReplicaHealth) -> None:
+        """Scrape one replica's telemetry snapshot into the aggregator.
+
+        Best-effort by contract: a failed scrape is counted and
+        flight-recorded but is NEVER a heartbeat miss — telemetry must not
+        be able to breaker a healthy replica. Runs only after a probe
+        SUCCESS, so it adds zero traffic to a struggling replica."""
+        agg = self.aggregator
+        cfg = self.config
+        if agg is None or cfg.scrape_every <= 0:
+            return
+        if st.probes % cfg.scrape_every:
+            return
+        scrape = getattr(replica, "telemetry", None)
+        if scrape is None:
+            return
+        try:
+            # chaos site: a `transient`/`hang` here exercises exactly the
+            # scrape path, distinct from the heartbeat's own site
+            faults_mod.check("telemetry.scrape", replica=rid)
+            snap = scrape(cfg.probe_deadline_s)
+        except BaseException as exc:  # noqa: BLE001 — best-effort scrape
+            with self._lock:
+                self.scrape_errors += 1
+            obs.count("telemetry.scrape_errors")
+            flightrec.note("telemetry_scrape_failed", replica=rid,
+                           error=repr(exc)[:160])
+            return
+        if not snap:
+            return
+        agg.ingest(rid, snap, health={
+            "state": st.state, "misses": st.misses,
+            "breaker_open": st.state in ("suspect", "wedged")})
+        with self._lock:
+            self.scrapes += 1
 
     def _transition(self, rid: str, st: _ReplicaHealth, to: str,
                     why: str = "") -> None:

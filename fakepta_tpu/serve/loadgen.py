@@ -455,6 +455,91 @@ def run_fleet_loadgen(spec: Optional[ArraySpec] = None, *, fleet=3,
 # elastic chaos mode — docs/RELIABILITY.md "Fleet lifecycle"
 # ---------------------------------------------------------------------------
 
+def export_fleet_trace(flt, trace_path) -> dict:
+    """One merged, validated Chrome trace for a live fleet: the router's
+    report (pid 0: ``route`` spans + failover instants) plus every local
+    replica's report (one pid lane each, ``serve`` spans + engine chunk
+    spans). Spans sharing a request ``trace_id`` — including a failed-over
+    request's spans on the dead and surviving replicas — come out linked
+    by flow events (``obs.trace.flow_events``). Returns summary counts
+    (``flows`` is the acceptance figure the chaos lane records)."""
+    import json
+
+    from ..obs import tracefmt
+
+    reports = [flt.report()] + flt.replica_reports()
+    trace = tracefmt.build_trace(reports)
+    tracefmt.validate_trace(trace)
+    with open(trace_path, "w") as fh:
+        json.dump(trace, fh)
+    return {"path": str(trace_path), "shards": len(reports),
+            "flows": int(trace["metadata"].get("flows", 0))}
+
+
+def measure_telemetry_overhead(spec: Optional[ArraySpec] = None, *,
+                               n_replicas: int = 2, n_requests: int = 48,
+                               sizes: Sequence[int] = (1, 2), seed: int = 0,
+                               n_specs: int = 2, config=None,
+                               compile_cache_dir: Optional[str] = None,
+                               mesh=None, health_config=None,
+                               rounds: int = 3) -> dict:
+    """A/B the telemetry plane's serving cost: the same fleet workload
+    with the heartbeat scrape ON (``scrape_every=1``) vs OFF
+    (``scrape_every=0``), health plane running in both arms so the delta
+    isolates the scrape itself. The arms alternate for ``rounds`` bursts
+    and each arm reports its best round — the interleaved best-of-N
+    shape of the PR 7 engine-instrumentation A/B, because one warm burst
+    at these request counts lasts tens of milliseconds and a single
+    sample is scheduler noise, not a measurement. Returns
+    ``telemetry_qps_on`` / ``telemetry_qps_off`` /
+    ``telemetry_overhead_frac`` (the acceptance bound is 0.02 —
+    docs/OBSERVABILITY.md records the measured figure)."""
+    import dataclasses as dc
+
+    from .health import HealthConfig
+
+    base = spec or ArraySpec(npsr=8, ntoa=64, n_red=4, n_dm=4, gwb_ncomp=4)
+    specs = [dc.replace(base, data_seed=100 + i) for i in range(n_specs)]
+    reqs = make_fleet_requests(specs, n_requests, sizes, seed=seed)
+    if config is None:
+        from ..tune import defaults as tune_defaults
+        config = ServeConfig(buckets=tune_defaults.DEFAULT_FLEET_BUCKETS)
+    hc = health_config or HealthConfig(period_s=0.02,
+                                       probe_deadline_s=0.25)
+    warm_buckets = sorted({int(b) for b in config.buckets})
+    fleets = {}
+    qps = {"off": 0.0, "on": 0.0}
+    try:
+        for arm, scrape_every in (("off", 0), ("on", 1)):
+            flt = fleets[arm] = _build_fleet(n_replicas, "inproc", base,
+                                             config, compile_cache_dir, mesh)
+            for s in specs:
+                for b in warm_buckets:
+                    flt.serve(dc.replace(reqs[0], spec=s, n=b, seed=0),
+                              timeout=600.0)
+            flt.enable_health(dc.replace(hc, scrape_every=scrape_every))
+        for _ in range(max(1, int(rounds))):
+            for arm in ("off", "on"):
+                flt = fleets[arm]
+                flt.reset_stats()
+                futs: list = []
+                for r in reqs:
+                    _submit_politely(flt, r, futs)
+                for f in futs:
+                    f.result(timeout=600.0)
+                qps[arm] = max(qps[arm],
+                               float(flt.slo_summary().get("fleet_qps",
+                                                           0.0)))
+    finally:
+        for flt in fleets.values():
+            flt.close()
+    frac = (max(0.0, 1.0 - qps["on"] / qps["off"])
+            if qps["off"] > 0 else 0.0)
+    return {"telemetry_qps_on": round(qps["on"], 3),
+            "telemetry_qps_off": round(qps["off"], 3),
+            "telemetry_overhead_frac": round(frac, 4)}
+
+
 def run_elastic_loadgen(spec: Optional[ArraySpec] = None, *,
                         n_replicas: int = 3, transport: str = "inproc",
                         n_requests: int = 96,
@@ -465,7 +550,8 @@ def run_elastic_loadgen(spec: Optional[ArraySpec] = None, *,
                         config=None,
                         compile_cache_dir: Optional[str] = None,
                         mesh=None, health_config=None,
-                        hang_s: Optional[float] = None) -> dict:
+                        hang_s: Optional[float] = None,
+                        trace_path=None) -> dict:
     """The fleet lifecycle A/B: ramp load, wedge one replica, SIGKILL
     another, autoscale a third in — one row of acceptance evidence.
 
@@ -486,6 +572,14 @@ def run_elastic_loadgen(spec: Optional[ArraySpec] = None, *,
     ``fleet_joins >= 1`` with ``fleet_join_steady_compiles == 0``, and
     every failed-over response bit-verified like any other
     (:func:`_verify_fleet_responses`).
+
+    The telemetry plane rides along: the health monitor's probes double
+    as scrapes (``fleet_scrapes``/``fleet_scrape_errors`` in the row,
+    ``fleet_alerts`` from the aggregator's firing log), and
+    ``trace_path`` exports the chaos run's merged Chrome trace
+    (:func:`export_fleet_trace`) with ``row["trace_flows"]`` counting the
+    trace-id flow links — the failed-over requests' causal arrows across
+    the dead and surviving replicas' pid lanes.
     """
     import dataclasses as dc
 
@@ -609,6 +703,12 @@ def run_elastic_loadgen(spec: Optional[ArraySpec] = None, *,
                         js.get("serve_steady_compiles", 0))
                 except (ServeBusy, OSError, RuntimeError):
                     pass
+        # telemetry-plane acceptance fields: the scrape counters come in
+        # via slo_summary (health stats); alerts are the aggregator's
+        # full firing history for the measured window
+        row["fleet_alerts"] = len(flt.telemetry.alerts.log)
+        if trace_path is not None:
+            row["trace_flows"] = export_fleet_trace(flt, trace_path)["flows"]
         if verify:
             picks = _verify_fleet_responses(reqs, results, verify, seed,
                                             mesh, compile_cache_dir)

@@ -262,6 +262,19 @@ class ServePool:
             target=self._dispatch_loop, name="fakepta-serve-dispatch",
             daemon=True)
         self._dispatcher.start()
+        # telemetry plane (docs/OBSERVABILITY.md): the replica-side
+        # publisher. Costs nothing until something scrapes it — sources
+        # run only inside snapshot(), and the heartbeat scraper is the
+        # only steady-state caller
+        from ..obs import telemetry as telemetry_mod
+        self.telemetry = telemetry_mod.TelemetryPublisher()
+        self.telemetry.add_source("slo", self.slo_summary)
+        self.telemetry.add_source("pool", self.warm_summary)
+        self.telemetry.add_source("streams", self.stream_summary)
+        self.telemetry.add_source("health", self.health_summary)
+        # lazy single-replica aggregator behind the `metrics` exposition
+        # kind (metrics_text); None until the first scrape
+        self._metrics_agg = None
 
     # -- registration / admission ------------------------------------------
     def register(self, name: str, sim, prewarm: bool = True) -> str:
@@ -547,11 +560,18 @@ class ServePool:
                 # an already-warm (lane, bucket) pair paid a compile: the
                 # steady-state recompile the warm pool exists to prevent
                 st.steady_compiles += 1
-            self._timeline.append(
-                {"name": "serve_dispatch", "tid": "serve",
-                 "t0": t_d0 - self._t0, "dur": t_d1 - t_d0,
-                 "cohort": len(cohort), "bucket": int(bucket),
-                 "req_kind": p0.req.kind})
+            ev = {"name": "serve_dispatch", "tid": "serve",
+                  "t0": t_d0 - self._t0, "dur": t_d1 - t_d0,
+                  "cohort": len(cohort), "bucket": int(bucket),
+                  "req_kind": p0.req.kind}
+            # trace propagation (docs/OBSERVABILITY.md): the cohort span
+            # carries every member's trace_id, so a request's router span
+            # links to the replica dispatch that served it
+            traced = [p.req.trace_id for p in cohort
+                      if getattr(p.req, "trace_id", None)]
+            if traced:
+                ev["trace_ids"] = traced
+            self._timeline.append(ev)
         # writer-side demux: slicing/assembly happens off the dispatch
         # thread so the next cohort's device work starts immediately
         self._demux_q.put((cohort, out, entry, run_kwargs, bucket, total,
@@ -641,10 +661,12 @@ class ServePool:
                 st.latency_ms.append(result.latency_s * 1e3)
                 st.queued_ms.append(result.queued_s * 1e3)
                 st.service_ms.append(result.service_s * 1e3)
-                self._timeline.append(
-                    {"name": "request", "tid": "serve",
-                     "t0": p.t_enq - self._t0, "dur": result.latency_s,
-                     "req_kind": p.req.kind, "n": int(p.req.n)})
+                ev = {"name": "request", "tid": "serve",
+                      "t0": p.t_enq - self._t0, "dur": result.latency_s,
+                      "req_kind": p.req.kind, "n": int(p.req.n)}
+                if getattr(p.req, "trace_id", None):
+                    ev["trace_id"] = p.req.trace_id
+                self._timeline.append(ev)
 
     def reset_stats(self) -> None:
         """Zero the SLO accumulators and timeline (the load generator's
@@ -696,6 +718,67 @@ class ServePool:
                 "serve_evictions": st.evicted,
             }
         return out
+
+    def warm_summary(self) -> dict:
+        """Warm-pool occupancy: resident entries, capacity, and per-spec
+        prewarmed-executable counts (the ``pool`` telemetry source and the
+        enriched ``stats`` protocol reply)."""
+        pool = self._pool
+        try:
+            # the dispatcher mutates the LRU outside the pool lock (its
+            # own thread owns it); a scrape racing a resize retries next
+            # heartbeat rather than adding a lock to the dispatch path
+            items = list(pool._entries.items())
+        except RuntimeError:
+            items = []
+        specs = {h: {"warm_buckets": len(e.warmed),
+                     "pinned": bool(e.pinned),
+                     "warm_s": round(e.warm_s, 3)}
+                 for h, e in items}
+        return {"entries": len(items), "max_entries": pool.max_entries,
+                "builds": pool.builds, "evictions": pool.evictions,
+                "specs": specs}
+
+    def stream_summary(self) -> dict:
+        """Per-stream telemetry (append counts + latencies) from the lazy
+        StreamManager; empty when no stream was ever opened."""
+        with self._lock:
+            mgr = self._stream_mgr
+        return mgr.summary() if mgr is not None else {}
+
+    def health_summary(self) -> dict:
+        """The replica's own liveness facts (the fleet's HealthMonitor
+        owns the authoritative ladder state; this is what the replica can
+        say about itself over the ``stats``/``telemetry`` kinds)."""
+        with self._lock:
+            closed = self._closed
+        alive = self._dispatcher.is_alive() and self._demux_thread.is_alive()
+        state = "closed" if closed else ("healthy" if alive else "failed")
+        return {"state": state, "dispatcher_alive": bool(alive),
+                "closed": bool(closed)}
+
+    def telemetry_snapshot(self) -> dict:
+        """One publisher snapshot (the ``telemetry`` protocol kind and the
+        LocalReplica scrape path)."""
+        return self.telemetry.snapshot()
+
+    def metrics_text(self) -> str:
+        """Prometheus text-format exposition of this pool's own rollup
+        (the ``metrics`` protocol kind). The pool keeps a single-replica
+        aggregator alive across calls so rate-style metrics (qps) see a
+        real window between scrapes."""
+        from ..obs import promfmt
+        from ..obs import telemetry as telemetry_mod
+
+        with self._lock:
+            agg = self._metrics_agg
+            if agg is None:
+                agg = self._metrics_agg = telemetry_mod.TelemetryAggregator()
+        health = self.health_summary()
+        agg.ingest("self", self.telemetry.snapshot(),
+                   health={"state": health["state"], "misses": 0,
+                           "breaker_open": False})
+        return promfmt.render(agg.rollup())
 
     def save_report(self, path) -> str:
         """Write the pool's telemetry as a RunReport artifact: ``obs

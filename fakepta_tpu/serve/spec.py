@@ -148,6 +148,12 @@ class SimRequest:
     n: int
     seed: int = 0
     deadline_s: Optional[float] = None
+    #: request trace identity (docs/OBSERVABILITY.md "Trace propagation").
+    #: Minted by the fleet router (or accepted from the client line) and
+    #: carried through coalescing, dispatch, and failover re-dispatch, so
+    #: every span a request produces — on any replica — links back to it.
+    #: ``None`` means untraced (solo-pool submissions keep zero overhead).
+    trace_id: Optional[str] = None
 
     kind = "sim"
 
@@ -242,6 +248,7 @@ class AppendRequest:
     watch: Optional[str] = None
     checkpoint: Optional[str] = None
     deadline_s: Optional[float] = None
+    trace_id: Optional[str] = None
 
     kind = "append"
     stream_affine = True
@@ -261,6 +268,7 @@ class StreamRequest:
 
     stream: str = ""
     deadline_s: Optional[float] = None
+    trace_id: Optional[str] = None
 
     kind = "stream"
     stream_affine = True

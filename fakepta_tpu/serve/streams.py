@@ -21,9 +21,12 @@ is how a fleet failover resumes a stream on a sibling replica.
 
 from __future__ import annotations
 
+import collections
 import threading
 
+from .. import obs
 from ..obs import flightrec
+from ..tune import defaults as tune_defaults
 from .spec import ArraySpec, ServeError
 
 #: payload schema tag for stream responses (mirrors STREAM_SCHEMA's role
@@ -42,6 +45,11 @@ class StreamManager:
         self.mesh = mesh
         self._lock = threading.Lock()
         self._streams: dict = {}      # name -> (threading.Lock, StreamState)
+        # per-stream append-latency rings (telemetry plane): bounded like
+        # every other telemetry buffer, read by summary()
+        self._append_ms: dict = collections.defaultdict(
+            lambda: collections.deque(
+                maxlen=tune_defaults.TELEMETRY_RING_SIZE))
 
     def _session(self, req):
         """The (lock, state) pair for ``req.stream``, opening it when the
@@ -104,11 +112,16 @@ class StreamManager:
         if req.kind == "append":
             if req.toas is None or req.residuals is None:
                 raise ServeError("append needs toas and residuals")
+            t0 = obs.now()
             with lock:
                 info = state.append(req.toas, req.residuals,
                                     sigma2=req.sigma2, freqs=req.freqs,
                                     ecorr_amp=req.ecorr_amp,
                                     counts=req.counts)
+            dt = obs.now() - t0
+            obs.observe("serve.append_latency_s", dt)
+            with self._lock:
+                self._append_ms[name].append(dt * 1e3)
             return dict(info, kind="append", stream=name,
                         payload_schema=STREAM_PAYLOAD_SCHEMA)
         if req.kind == "stream":
@@ -121,6 +134,26 @@ class StreamManager:
     def stream_names(self):
         with self._lock:
             return sorted(self._streams)
+
+    def summary(self) -> dict:
+        """Per-stream telemetry: append totals and windowed latencies —
+        the ``streams`` source of the replica's TelemetryPublisher and
+        the enriched ``stats`` protocol reply."""
+        with self._lock:
+            entries = list(self._streams.items())
+            lat = {name: list(ring)
+                   for name, ring in self._append_ms.items()}
+        out = {}
+        for name, (_lock, state) in entries:
+            ms = lat.get(name, [])
+            row = {"appends": int(state.appends),
+                   "toas": int(state._n.sum()),
+                   "rebuckets": int(state.rebuckets)}
+            if ms:
+                row["append_mean_ms"] = round(sum(ms) / len(ms), 4)
+                row["append_last_ms"] = round(ms[-1], 4)
+            out[name] = row
+        return out
 
     def close(self) -> None:
         with self._lock:

@@ -182,10 +182,19 @@ class PosteriorRefresher:
         if not (due_appends or due_snr):
             self.skips += 1
             obs.count("stream.refresh_skips")
+            # the telemetry plane watches the gate decision stream: holds
+            # vs opens are how `obs top` shows whether refresh work is
+            # keeping pace with arrivals (docs/OBSERVABILITY.md)
+            obs.count("stream.refresh_gate_holds")
+            obs.telemetry.publish("stream.refresh_gate_holds",
+                                  int(self.skips))
             obs.flightrec.note("stream_refresh_skip", appends_since=since,
                                snr_gain=round(float(gain), 6))
             return {"schema": STREAM_SCHEMA, "skipped": True,
                     "appends_since": since, "snr_gain": float(gain)}
+        obs.count("stream.refresh_gate_opens")
+        obs.telemetry.publish("stream.refresh_gate_opens",
+                              int(self.refreshes) + 1)
         info = self.refresh(n_steps, seed=seed, **run_kwargs)
         info["trigger"] = "appends" if due_appends else "snr"
         info["skipped"] = False

@@ -117,6 +117,32 @@ REFRESH_EVERY_APPENDS = 4
 #: without a ``watch`` statistic fall back to the epoch-count trigger)
 REFRESH_MIN_SNR_GAIN = 0.5
 
+# --- telemetry-plane knobs (fakepta_tpu.obs.telemetry) ---------------------
+
+#: bounded snapshot ring per replica publisher (and per replica inside the
+#: fleet aggregator): at the heartbeat cadence this is minutes of history,
+#: and the ring bound is what keeps a scraped-but-never-drained publisher
+#: from growing without limit
+TELEMETRY_RING_SIZE = 64
+
+#: scrape every Nth successful heartbeat probe (1 = every probe). The
+#: scrape RIDES the heartbeat — same mux'd connection, no extra sockets —
+#: so this knob is the only telemetry-frequency control
+TELEMETRY_SCRAPE_EVERY = 1
+
+#: rollup window (seconds of per-replica snapshot history) used for rates
+#: (qps) and the append-latency regression baseline
+TELEMETRY_WINDOW_S = 30.0
+
+#: alert thresholds (docs/OBSERVABILITY.md "Alert rules"): p99 request
+#: latency over SLO, consecutive heartbeat misses, append-latency
+#: regression multiple over the window baseline, and the peak-HBM
+#: watermark fraction of the per-device budget
+ALERT_P99_SLO_MS = 2000.0
+ALERT_HEARTBEAT_MISS_STREAK = 3
+ALERT_APPEND_REGRESSION_X = 3.0
+ALERT_HBM_WATERMARK_FRAC = 0.9
+
 # --- tuner constants (fakepta_tpu.tune) ------------------------------------
 
 #: store schema tag + version; entries written by a different version are

@@ -1,0 +1,21 @@
+"""Seeded true positives for metric-name-discipline (computed or
+unregistered metric names), with near-misses: registered literals and
+non-emitter ``.count`` receivers are never flagged."""
+from fakepta_tpu import obs
+from fakepta_tpu.obs import count as _count
+from fakepta_tpu.obs import telemetry
+
+
+def bad(name, collector):
+    obs.count("fleet.surprise_series")             # unregistered literal
+    obs.gauge(f"gauge.{name}", 1.0)                # computed name
+    obs.observe("Bad.Name", 0.1)                   # malformed name
+    _count("another.unregistered")                 # aliased helper
+    telemetry.publish(name, 2.0)                   # computed publish
+    collector.count("fleet.surprise_series")       # collector receiver
+
+
+def ok(items):
+    obs.count("fleet.joins")                       # registered literal
+    telemetry.publish("obs.peak_hbm_bytes", 3.0)   # registered publish
+    return items.count("x")                        # list.count: no emitter

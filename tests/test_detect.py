@@ -240,6 +240,9 @@ def test_operator_weights_shared_with_host(batch):
     np.testing.assert_allclose(ops[0].sigma, host["sigma"], rtol=1e-12)
 
 
+@pytest.mark.slow   # ~10 s: tier-1 budget reclaim (ISSUE 17) — the
+# detection artifact/compare flow stays tier-1 via the obs gate and
+# compare tests; the facade smoke moves to tier-2
 def test_detection_run_facade_and_artifact(batch, tmp_path):
     """DetectionRun: one call -> null-calibrated summary; the saved artifact
     loads as a RunReport whose summary carries the detection metrics, and

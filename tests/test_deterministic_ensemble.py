@@ -7,6 +7,7 @@ oracle.
 
 import jax
 import numpy as np
+import pytest
 
 from fakepta_tpu import constants as const
 from fakepta_tpu.batch import PulsarBatch, padded_abs_toas, padded_pdist
@@ -87,6 +88,9 @@ def test_det_signals_enter_the_ensemble_statistics():
     assert np.all(np.isfinite(out_off["curves"]))
 
 
+@pytest.mark.slow   # ~12 s: tier-1 budget reclaim (ISSUE 17) — psr-shard
+# composition stays tier-1 via test_toa_sharding; the det-block sharded
+# parity re-verifies in tier-2
 def test_det_sharded_mesh_matches_single_device():
     """The deterministic block shards over 'psr' like every other (P, T) leaf."""
     psrs, ephem = _psrs(n=4, T=64)

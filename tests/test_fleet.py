@@ -257,6 +257,8 @@ def test_request_json_roundtrip_and_busy_hint_crosses_wire():
 # shared compile cache: a sibling's cold start is a load, not a compile
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # ~10 s: tier-1 budget reclaim (ISSUE 17) — warm joins
+# from the shared cache stay tier-1 via test_lifecycle's join-prewarm test
 def test_sibling_replica_cold_start_hits_shared_cache(tmp_path):
     """ISSUE 12 satellite (extends the PR 9 cache-file assertion): after
     replica A prewarms a spec, a FRESH sibling pool serving the same spec

@@ -286,6 +286,9 @@ def test_lnlike_checkpoint_resume_keeps_lanes(batch64, tmp_path):
     np.testing.assert_allclose(out["curves"], full["curves"], rtol=1e-9)
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 17) — the XLA
+# lnlike lanes stay tier-1 and the fused chunk program keeps parity
+# coverage via the megakernel oracle
 def test_lnlike_fused_pallas_matches_xla(batch64):
     """Fused-path acceptance: under use_pallas the likelihood lanes ride the
     same chunk program as the Pallas statistic kernel (interpret mode on
@@ -309,6 +312,9 @@ def test_lnlike_fused_pallas_matches_xla(batch64):
                                atol=1e-5 * scale)
 
 
+@pytest.mark.slow   # ~15 s: tier-1 budget reclaim (ISSUE 17) — the grad
+# lane keeps its own tier-1 parity; the Hessian pack/symmetry check and
+# grad-block equality re-verify in tier-2
 def test_fisher_lanes_consistent(batch64):
     """mode='fisher' packs lnL + grad + Hessian; the Hessian is symmetric
     and its grad block matches the grad-mode run exactly (same moments)."""

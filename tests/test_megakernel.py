@@ -137,6 +137,9 @@ def test_mega_f64_oracle():
     np.testing.assert_allclose(got["autos"], ref["autos"], rtol=1e-6)
 
 
+@pytest.mark.slow   # ~17 s: tier-1 budget reclaim (ISSUE 17) — det and
+# sampling lanes keep per-engine tier-1 parity (test_deterministic_ensemble,
+# the sampling suites); mega parity itself stays via test_mega_f64_oracle
 def test_mega_with_det_and_sampling(batch):
     """Deterministic delays (BayesEphem Roemer) and per-realization
     hyperparameter sampling ride the megakernel unchanged: the determin-
@@ -253,6 +256,9 @@ def test_mega_keep_corr_falls_back_to_xla(batch, mega_sim, xla_out):
 
 # ------------------------------------------- bf16-storage certification
 
+@pytest.mark.slow   # ~16 s: tier-1 budget reclaim (ISSUE 17) — the bf16
+# operand-rounding envelope stays pinned by test_montecarlo's bf16 bases
+# parity; the mega bf16 lane re-certifies in tier-2
 def test_mega_bf16_certified_against_f32(batch, mega_sim):
     """run(precision='bf16') — bf16 base/coefficient storage with f32
     accumulation — must sit within the documented ~4e-3 operand-rounding

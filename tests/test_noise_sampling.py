@@ -314,6 +314,8 @@ def _sys_batch(batch, log10_A=-13.2, gamma=2.5, n_sys=6, equal_bands=True):
         sys_mask=jnp.asarray(sys_mask))
 
 
+@pytest.mark.slow   # ~11 s: tier-1 budget reclaim (ISSUE 17) — the sys
+# lane keeps tier-1 injection/PSD coverage via the remaining sys tests
 def test_sys_zero_width_sampling_reproduces_fixed_psd_run(batch):
     """Pinned sys ranges reproduce the fixed sys_psd program: the sys
     coefficient stream (ks) is untouched by the hyperdraws, and the sampled
@@ -382,6 +384,9 @@ def test_sys_sampling_mesh_shape_invariance(batch):
         np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 17) — key-domain
+# isolation is also pinned per-stage by the white/red sampling isolation
+# tests; the sys differencing identity re-verifies in tier-2
 def test_sys_sampling_stream_isolation(batch):
     """The sys hyperdraws live in their own 0x9C/subtag-4 key domain: the
     white/red/coefficient streams are byte-identical whether or not sys

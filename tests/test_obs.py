@@ -211,6 +211,9 @@ def test_run_report_acceptance(two_runs):
     assert out1["curves"].shape[0] == 16   # telemetry never costs a result
 
 
+@pytest.mark.slow   # ~16 s: tier-1 budget reclaim (ISSUE 17) — the guard's
+# zero side rides every zero-recompile contract test (serve, stream, tune);
+# the forced-positive control moves to tier-2
 def test_retrace_guard_counts_forced_recompile():
     """Positive control: clearing jax's caches forces a same-signature
     retrace, which the guard must count (and runs before it must not)."""

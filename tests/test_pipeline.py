@@ -80,6 +80,8 @@ def test_pipelined_equals_serial_os_lane(batch, tmp_path):
                                   a["os"]["stats"]["hd"]["amp2"])
 
 
+@pytest.mark.slow   # ~13 s: tier-1 budget reclaim (ISSUE 17) — the
+# default-lane and OS-lane depth equivalences remain tier-1
 def test_pipelined_equals_serial_lnlike_lane(batch):
     from fakepta_tpu.infer import (ComponentSpec, FreeParam, InferSpec,
                                    LikelihoodSpec)
@@ -98,6 +100,9 @@ def test_pipelined_equals_serial_lnlike_lane(batch):
     np.testing.assert_array_equal(a["curves"], b["curves"])
 
 
+@pytest.mark.slow   # ~15 s: tier-1 budget reclaim (ISSUE 17) — depth
+# equivalence stays tier-1 on the single-device mesh; sharded-mesh
+# composition stays via test_toa_sharding
 def test_pipelined_equals_serial_2x2x2_mesh(batch):
     """Depth equivalence on the virtual 8-device mesh: 2-deep == 1-deep ==
     serial, bit for bit, under (real=2, psr=2, toa=2) sharding."""

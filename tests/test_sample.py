@@ -312,6 +312,9 @@ def test_diagnostics_finishers():
 # warm start: Laplace objective and fit
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # ~13 s: tier-1 budget reclaim (ISSUE 17) — the
+# finite-difference cross-check of the Laplace gradient moves to tier-2;
+# the Laplace mode itself stays driven by the warm-start tests
 def test_laplace_grad_vs_finite_differences(rng):
     batch = _small_batch()
     study = SamplingRun(batch, SampleSpec(model=_powerlaw_model(),
@@ -384,6 +387,10 @@ def test_mesh_and_pipeline_depth_bit_identity(ref_run):
     assert 0.2 < r1["summary"]["accept_rate"] <= 1.0
 
 
+@pytest.mark.slow   # ~16 s: tier-1 budget reclaim (ISSUE 17) — resume
+# bit-identity stays tier-1 via the stream append-boundary resume and
+# test_infer's lnlike checkpoint resume; the sampler variant re-runs in
+# tier-2
 def test_checkpoint_kill_resume_bit_identity(tmp_path, ref_run):
     """Mid-run kill -> resume reproduces the uninterrupted chains exactly,
     even onto a different mesh and pipeline depth; the checkpoint files are
@@ -419,6 +426,9 @@ def test_checkpoint_kill_resume_bit_identity(tmp_path, ref_run):
     assert not list(tmp_path.glob("chains.json.*"))
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 17) — warm-start
+# cache reuse stays tier-1 via the mesh/depth bit-identity test; the
+# timeline-span census moves to tier-2
 def test_timeline_has_segment_spans_only_and_warm_start_hits_cache():
     """The zero-host-round-trips acceptance, dynamic half: the run timeline
     records per-SEGMENT dispatch/execute/drain spans (counts scale with

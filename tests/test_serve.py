@@ -100,6 +100,9 @@ def test_registered_tenant_serves_identically(served):
     assert np.array_equal(served["named"].autos, served["B"].autos)
 
 
+@pytest.mark.slow   # ~11 s: tier-1 budget reclaim (ISSUE 17) — coalesced
+# OS slicing keeps tier-1 coverage via the bit-identical-to-solo pin;
+# the cohort-independence sweep moves to tier-2
 def test_os_request_is_cohort_independent(served):
     """A detection request's statistics — including its paired-null
     calibration — are re-assembled from the request's own slice: bit-equal

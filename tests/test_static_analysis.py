@@ -79,6 +79,10 @@ CASES = [
       ("unbounded-socket-io", 17)}),
     ("unbounded_join.py", LIB,
      {("unbounded-thread-join", 7), ("unbounded-thread-join", 8)}),
+    ("metric_name_bad.py", LIB,
+     {("metric-name-discipline", 10), ("metric-name-discipline", 11),
+      ("metric-name-discipline", 12), ("metric-name-discipline", 13),
+      ("metric-name-discipline", 14), ("metric-name-discipline", 15)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),
@@ -152,6 +156,19 @@ def test_mesh_axes_policy_matches_mesh_module():
     assert policy.MESH_AXES == (mesh.REAL_AXIS, mesh.PSR_AXIS, mesh.TOA_AXIS)
 
 
+def test_metric_name_policy_matches_metrics_module():
+    """The analyzer's registry copy cannot drift from obs/metrics.py."""
+    from fakepta_tpu.obs import metrics
+
+    assert set(policy.METRIC_NAMES) == set(metrics.METRIC_NAMES)
+    assert len(policy.METRIC_NAMES) == len(metrics.METRIC_NAMES)
+    assert policy.METRIC_NAME_RE == metrics.METRIC_NAME_RE
+    # the registry itself must be well-formed under its own regex
+    import re
+    for name in metrics.METRIC_NAMES:
+        assert re.match(metrics.METRIC_NAME_RE, name), name
+
+
 def test_dtype_policy_paths_exist():
     """Policy entries must point at real modules (refactors move files)."""
     for rel in policy.DTYPE_POLICY:
@@ -161,6 +178,9 @@ def test_dtype_policy_paths_exist():
             f"stale BF16_STORAGE_MODULES entry: {rel}"
     for rel in policy.TIMING_MODULES:
         assert (REPO / rel).is_file(), f"stale TIMING_MODULES entry: {rel}"
+    for rel in policy.METRIC_NAME_MODULES:
+        assert (REPO / rel).is_file(), \
+            f"stale METRIC_NAME_MODULES entry: {rel}"
     for rel in policy.UNBOUNDED_QUEUE_MODULES:
         assert (REPO / rel).is_file(), \
             f"stale UNBOUNDED_QUEUE_MODULES entry: {rel}"

@@ -315,6 +315,9 @@ def test_checkpoint_identity_mismatch_is_a_hard_error(streamed, tmp_path):
 # continuous posterior refresh
 # ---------------------------------------------------------------------------
 
+@pytest.mark.slow   # ~19 s: tier-1 budget reclaim (ISSUE 17) — refresh
+# gating stays tier-1 via test_lifecycle's pure-policy test; the streamed
+# fixture's append/recompile contracts keep their own tier-1 entries
 def test_posterior_refresh_warm_starts_and_gates(streamed):
     """Cycle 2 warm-starts from cycle 1 (Laplace mode + remapped chains)
     and converges the Laplace fit in no more iterations; promotion is

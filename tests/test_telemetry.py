@@ -1,0 +1,596 @@
+"""Telemetry plane (ISSUE 17): publisher rings, watermark aggregation,
+alert rules, Prometheus exposition, `obs top`/`alerts`, trace-id flows.
+
+Lean by construction, mirroring test_lifecycle.py: the watermark/alert/
+exposition/CLI lanes are pure host logic (no jax, no sockets); the
+zero-new-connections scrape contract runs against a scripted in-test TCP
+server (attach-mode SocketReplica — nothing compiles); the jax-backed
+lanes share one module-scoped 2-replica fleet with tiny specs and a
+shared tmp compile cache. The heavyweight chaos A/B (wedge + kill +
+autoscale, full trace export, telemetry overhead A/B) lives in the
+benchmark suite's config15 lane, not tier-1 — but the failover-flow
+acceptance (a failed-over request's spans linked by trace_id across pid
+lanes in a validated Chrome trace) is pinned here on a 2-replica kill.
+"""
+
+import ast
+import dataclasses
+import json
+import re
+import socket as socket_mod
+import threading
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from fakepta_tpu.obs import promfmt, telemetry, topview, tracefmt
+from fakepta_tpu.obs import cli as obs_cli
+from fakepta_tpu.obs import report as report_mod
+from fakepta_tpu.obs.metrics import ACCEPTED_SCHEMAS, SCHEMA_V2, EventLog
+from fakepta_tpu.obs.telemetry import (AlertRules, TelemetryAggregator,
+                                       TelemetryPublisher)
+from fakepta_tpu.serve import (ArraySpec, FleetConfig, HealthConfig,
+                               LocalReplica, ServeConfig, ServeFleet,
+                               SimRequest, SocketReplica)
+
+SPEC0 = ArraySpec(npsr=4, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                  data_seed=170)
+
+#: fast heartbeats with the scrape riding every successful probe
+SCRAPE_HEALTH = HealthConfig(period_s=0.05, probe_deadline_s=0.5,
+                             suspect_after=2, wedged_after=4,
+                             close_after=2, backoff_base_s=0.02,
+                             backoff_cap_s=0.1, scrape_every=1)
+
+
+def _wait_for(pred, timeout_s=15.0, step=0.01):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(step)
+    return pred()
+
+
+def _snap(seq, epoch="e1", t=None, p99=5.0, **extra):
+    snap = {"seq": seq, "epoch": epoch,
+            "t": float(t if t is not None else seq), "replica": "r0",
+            "slo": {"serve_requests": seq * 2, "serve_failed": 0,
+                    "serve_dispatches": seq, "qps_per_chip": 0.5,
+                    "p50_ms": 1.0, "p99_ms": p99, "queue_depth": 0}}
+    snap.update(extra)
+    return snap
+
+
+# ---------------------------------------------------------------------------
+# publisher: bounded ring, best-effort sources, live gauges, seq epochs
+# ---------------------------------------------------------------------------
+
+def test_publisher_ring_live_gauges_and_failing_source():
+    telemetry.clear_live_gauges()
+    try:
+        pub = TelemetryPublisher("r0", ring_size=4)
+        pub.add_source("slo", lambda: {"serve_requests": 7})
+        pub.add_source("broken", lambda: 1 / 0)
+        telemetry.publish("obs.peak_hbm_bytes", 123.0)
+        s = pub.snapshot()
+        assert s["seq"] == 1 and s["replica"] == "r0"
+        assert s["slo"] == {"serve_requests": 7}
+        # a failing source is skipped, never propagated — and the good
+        # sources and live gauges still land in the same snapshot
+        assert "broken" not in s
+        assert s["live"]["obs.peak_hbm_bytes"] == 123.0
+        for _ in range(6):
+            pub.snapshot()
+        ring = pub.ring()
+        assert len(ring) == 4 and ring[-1]["seq"] == 7
+        # a restarted publisher gets a fresh seq epoch, so an aggregator
+        # can tell restart (reset) from a reordered scrape (drop)
+        assert TelemetryPublisher("r0", ring_size=4).epoch != pub.epoch
+    finally:
+        telemetry.clear_live_gauges()
+
+
+# ---------------------------------------------------------------------------
+# aggregator: watermark merge, epoch reset, retire freeze, re-join
+# ---------------------------------------------------------------------------
+
+def test_aggregator_watermark_drops_stale_and_resets_on_epoch():
+    agg = TelemetryAggregator(window_s=60.0, ring_size=8)
+    assert agg.ingest("r0", _snap(1)) is True
+    assert agg.ingest("r0", _snap(2)) is True
+    # duplicate / reordered scrape: at-or-below watermark is dropped
+    assert agg.ingest("r0", _snap(2)) is False
+    assert agg.ingest("r0", _snap(1)) is False
+    assert agg.dropped_stale == 2 and agg.ingested == 2
+    row = agg.rollup()["per_replica"]["r0"]
+    assert row["snapshots"] == 2 and row["seq"] == 2
+    # window qps = counter delta over the monotonic span: (4-2)/(2-1)
+    assert row["qps"] == pytest.approx(2.0)
+    # restarted publisher: fresh epoch resets watermark + ring — seq 1
+    # (stale in the old epoch) merges cleanly, never a negative rate
+    assert agg.ingest("r0", _snap(1, epoch="e2")) is True
+    row = agg.rollup()["per_replica"]["r0"]
+    assert row["snapshots"] == 1 and row["seq"] == 1
+
+
+def test_aggregator_retire_freezes_rollup_until_rejoin():
+    agg = TelemetryAggregator(window_s=60.0, ring_size=8)
+    agg.ingest("r0", _snap(1))
+    agg.ingest("r0", _snap(2))
+    agg.retire("r0")
+    rollup = agg.rollup()
+    assert "r0" not in rollup["per_replica"]
+    assert rollup["retired"]["r0"]["snapshots"] == 2
+    # a re-join supersedes the frozen rollup
+    assert agg.ingest("r0", _snap(1, epoch="e2")) is True
+    rollup = agg.rollup()
+    assert "r0" in rollup["per_replica"] and not rollup["retired"]
+
+
+def test_rollup_event_log_round_trip(tmp_path):
+    agg = TelemetryAggregator(
+        alert_rules=AlertRules(p99_slo_ms=1.0))  # every ingest breaches
+    agg.ingest("r0", _snap(1, p99=50.0))
+    agg.ingest("r1", _snap(1, p99=50.0, t=1.5))
+    path = tmp_path / "telemetry.jsonl"
+    agg.save(path, meta={"replica_id": "router"})
+    log = EventLog.load(path)
+    assert log.schema == SCHEMA_V2
+    kinds = [line["kind"] for line in log.lines]
+    assert kinds.count("telemetry") == 2 and "alert" in kinds
+    # the summary fast-path carries the full rollup
+    rollup = telemetry.rollup_from_event_log(log)
+    assert set(rollup["per_replica"]) == {"r0", "r1"}
+    assert any(a["rule"] == "p99_over_slo" for a in rollup["alerts"])
+    # strip the summary: the rebuild path re-aggregates the raw lines
+    # through the same watermark logic
+    bare = tmp_path / "bare.jsonl"
+    bare.write_text(agg.to_event_log().to_jsonl())
+    rebuilt = telemetry.rollup_from_event_log(EventLog.load(bare))
+    assert set(rebuilt["per_replica"]) == {"r0", "r1"}
+
+
+def test_event_log_rejects_unknown_schema():
+    assert SCHEMA_V2 in ACCEPTED_SCHEMAS
+    with pytest.raises(ValueError, match="unknown event-log schema"):
+        EventLog(schema="fakepta_tpu.obs/99")
+    header = json.dumps({"kind": "header", "schema": "fakepta_tpu.obs/99",
+                         "meta": {}})
+    with pytest.raises(ValueError, match="refusing to mix"):
+        EventLog.parse(header + "\n")
+
+
+# ---------------------------------------------------------------------------
+# alert rules: thresholds, edge triggering, re-arm
+# ---------------------------------------------------------------------------
+
+def test_alert_rules_fire_once_per_excursion_and_rearm():
+    rules = AlertRules(p99_slo_ms=100.0, miss_streak=3)
+    breach = {"per_replica": {"r0": {"replica": "r0", "p99_ms": 250.0,
+                                     "t": 1.0}}}
+    fired = rules.evaluate(breach)
+    assert [a["rule"] for a in fired] == ["p99_over_slo"]
+    assert fired[0]["p99_ms"] == 250.0 and fired[0]["slo_ms"] == 100.0
+    # edge-triggered: a sustained breach fires exactly once
+    assert rules.evaluate(breach) == []
+    assert [a["rule"] for a in rules.active()] == ["p99_over_slo"]
+    # the condition clearing re-arms the rule...
+    clear = {"per_replica": {"r0": {"replica": "r0", "p99_ms": 10.0,
+                                    "t": 2.0}}}
+    assert rules.evaluate(clear) == [] and rules.active() == []
+    # ...so the next excursion fires again, as a new log entry
+    assert len(rules.evaluate(breach)) == 1
+    assert len(rules.log) == 2
+
+
+def test_alert_rules_cover_all_four_conditions():
+    rows = {
+        "miss": ({"replica": "m", "heartbeat_misses": 3, "t": 0.0},
+                 AlertRules(miss_streak=3), "heartbeat_miss_streak"),
+        "regress": ({"replica": "g", "append_baseline_ms": 1.0,
+                     "append_recent_ms": 5.0, "t": 0.0},
+                    AlertRules(regression_x=2.0),
+                    "append_latency_regression"),
+        "hbm": ({"replica": "h", "peak_hbm_bytes": 60.0, "t": 0.0},
+                AlertRules(hbm_frac=0.5, hbm_budget_bytes=100.0),
+                "hbm_watermark"),
+    }
+    for row, rules, expect in rows.values():
+        fired = rules.evaluate({"per_replica": {row["replica"]: row}})
+        assert [a["rule"] for a in fired] == [expect]
+    # under-threshold twins stay quiet
+    quiet = AlertRules(p99_slo_ms=100.0, miss_streak=3, regression_x=3.0,
+                       hbm_frac=0.9, hbm_budget_bytes=100.0)
+    row = {"replica": "q", "p99_ms": 50.0, "heartbeat_misses": 2,
+           "append_baseline_ms": 1.0, "append_recent_ms": 2.0,
+           "peak_hbm_bytes": 50.0, "t": 0.0}
+    assert quiet.evaluate({"per_replica": {"q": row}}) == []
+
+
+# ---------------------------------------------------------------------------
+# exposition: Prometheus text format with a declared name schema
+# ---------------------------------------------------------------------------
+
+def test_promfmt_renders_declared_names_only():
+    agg = TelemetryAggregator()
+    agg.ingest("r0", _snap(1, pool={"entries": 2, "max_entries": 8,
+                                    "builds": 0,
+                                    "specs": {"abc123": {"warm_buckets": 3}}},
+                           streams={"s0": {"appends": 4,
+                                           "append_mean_ms": 1.5}},
+                           live={"obs.peak_hbm_bytes": 9.0}),
+               health={"state": "healthy", "misses": 0,
+                       "breaker_open": False})
+    text = promfmt.render(agg.rollup())
+    # every used family gets HELP+TYPE, in declared-schema names
+    assert "# HELP fakepta_fleet_replicas " in text
+    assert "# TYPE fakepta_serve_qps gauge" in text
+    assert "# TYPE fakepta_serve_requests_total counter" in text
+    assert 'fakepta_up{replica="r0"} 1' in text
+    assert 'fakepta_spec_warm_buckets{replica="r0",spec="abc123"} 3' in text
+    assert 'fakepta_stream_appends_total{replica="r0",stream="s0"} 4' in text
+    assert 'fakepta_live_gauge{name="obs.peak_hbm_bytes",replica="r0"} 9' \
+        in text
+    # stable names: everything exported is fakepta_-prefixed and legal
+    for line in text.splitlines():
+        if line.startswith("#"):
+            continue
+        name = line.split("{", 1)[0].split(" ", 1)[0]
+        assert re.fullmatch(r"fakepta_[a-z0-9_]+", name), line
+        assert name in promfmt.PROM_METRICS
+    # the schema guard: undeclared names are a loud error, not a drift
+    with pytest.raises(ValueError, match="not in the declared"):
+        promfmt._sample([], "fakepta_surprise_metric", {}, 1.0)
+
+
+def test_topview_renders_rollup_and_scripted_refresh_loop():
+    import io
+
+    agg = TelemetryAggregator(alert_rules=AlertRules(p99_slo_ms=1.0))
+    agg.ingest("r0", _snap(1, p99=50.0),
+               health={"state": "healthy", "misses": 0,
+                       "breaker_open": False})
+    agg.ingest("r1", _snap(3, p99=2.0))
+    agg.retire("r1")
+    frame = topview.render_table(agg.rollup())
+    assert frame.startswith("fleet: 1 replicas")
+    assert "REPLICA" in frame and "healthy" in frame
+    assert "retired: r1" in frame
+    assert "ALERT p99_over_slo on r0" in frame
+    # the refresh loop is drivable with a scripted fetch and zero sleeps
+    fetches = iter([agg.rollup(), agg.rollup()])
+
+    def fetch():
+        try:
+            return next(fetches)
+        except StopIteration:
+            raise EOFError
+
+    out = io.StringIO()
+    frames = topview.run_top(fetch, interval_s=0.0, iterations=None,
+                             out=out)
+    assert frames == 2
+    assert out.getvalue().count("fleet: 1 replicas") == 2
+
+
+def test_obs_cli_top_and_alerts_from_saved_log(tmp_path, capsys):
+    agg = TelemetryAggregator(alert_rules=AlertRules(p99_slo_ms=1.0))
+    agg.ingest("r0", _snap(1, p99=50.0))
+    path = str(tmp_path / "fleet_telemetry.jsonl")
+    agg.save(path)
+    assert obs_cli.main(["top", path]) == 0
+    assert "fleet: 1 replicas" in capsys.readouterr().out
+    assert obs_cli.main(["alerts", path]) == 0
+    assert "p99_over_slo" in capsys.readouterr().out
+    assert obs_cli.main(["alerts", path, "--format", "json"]) == 0
+    alerts = json.loads(capsys.readouterr().out)["alerts"]
+    assert alerts[0]["rule"] == "p99_over_slo"
+    # bad source path: usage/IO exit code 2, mirroring the other verbs
+    assert obs_cli.main(["top", str(tmp_path / "missing.jsonl")]) == 2
+
+
+def test_obs_cli_summarize_interleaves_a_directory(tmp_path, capsys):
+    dump_dir = tmp_path / "dumps"
+    dump_dir.mkdir()
+    for rid, t0 in (("r0", 1.0), ("r1", 0.5)):
+        agg = TelemetryAggregator()
+        agg.ingest(rid, _snap(1, t=t0))
+        agg.ingest(rid, _snap(2, t=t0 + 1.0))
+        agg.save(dump_dir / f"{rid}.jsonl", meta={"replica_id": rid})
+    assert obs_cli.main(["summarize", str(dump_dir)]) == 0
+    out = capsys.readouterr().out
+    assert "2 artifact(s), 4 timestamped event(s)" in out
+    # the interleave is by timestamp with a per-replica column: r1's
+    # earlier snapshot sorts ahead of r0's
+    rows = [ln for ln in out.splitlines() if " telemetry " in ln]
+    assert len(rows) == 4 and " r1 " in rows[0] and " r0 " in rows[1]
+    assert obs_cli.main(["summarize", str(dump_dir), "--format",
+                         "json"]) == 0
+    data = json.loads(capsys.readouterr().out)
+    assert data["files"] == 2 and len(data["events"]) == 4
+
+
+# ---------------------------------------------------------------------------
+# trace-id flows (unit): spans sharing a trace id chain into s/t/f links
+# ---------------------------------------------------------------------------
+
+def test_flow_events_link_spans_sharing_trace_ids():
+    evs = [
+        {"ph": "X", "pid": 0, "tid": 3, "name": "route", "ts": 0.0,
+         "dur": 5.0, "args": {"trace_id": "t-1"}},
+        {"ph": "X", "pid": 1, "tid": 3, "name": "serve", "ts": 1.0,
+         "dur": 3.0, "args": {"trace_ids": ["t-1", "t-2"]}},
+        {"ph": "X", "pid": 2, "tid": 0, "name": "chunk", "ts": 2.0,
+         "dur": 1.0, "args": {"trace_id": "t-1"}},
+        {"ph": "X", "pid": 0, "tid": 3, "name": "route", "ts": 0.5,
+         "dur": 1.0, "args": {"trace_id": "t-2"}},
+        # a single-span trace id has nothing to link
+        {"ph": "X", "pid": 5, "tid": 0, "name": "lone", "ts": 9.0,
+         "dur": 1.0, "args": {"trace_id": "t-solo"}},
+        # instants carry trace ids for context but never anchor flows
+        {"ph": "i", "pid": 0, "tid": 3, "name": "fleet_failover",
+         "ts": 0.2, "args": {"trace_id": "t-1"}},
+    ]
+    flows = tracefmt.flow_events(evs)
+    assert len(flows) == 5          # 3-span t-1 chain + 2-span t-2 chain
+    t1 = [f for f in flows if f["name"] == "trace:t-1"]
+    assert [f["ph"] for f in t1] == ["s", "t", "f"]
+    assert [f["pid"] for f in t1] == [0, 1, 2]      # ts order across pids
+    assert len({f["id"] for f in t1}) == 1
+    assert t1[-1]["bp"] == "e"      # the finish binds to its slice
+    t2 = [f for f in flows if f["name"] == "trace:t-2"]
+    assert [f["ph"] for f in t2] == ["s", "f"]
+    assert {f["id"] for f in t2} != {f["id"] for f in t1}
+    assert not any(f["name"] == "trace:t-solo" for f in flows)
+
+
+# ---------------------------------------------------------------------------
+# satellite: the bench-schema direction contract is total
+# ---------------------------------------------------------------------------
+
+def test_bench_docstring_keys_all_have_declared_directions():
+    """Every metric key the bench.py schema docstring documents must be
+    classified by the obs direction tables (exactly one exact table, or a
+    suffix rule) — a new bench key can never pick a direction silently."""
+    src = (Path(__file__).resolve().parents[1] / "bench.py").read_text()
+    doc = ast.get_docstring(ast.parse(src)) or ""
+    keys = set()
+    for chunk in doc.split("\n- ")[1:]:
+        # keys live before the bullet's first "``:"; a bullet without one
+        # (the per-mode bytes rows) is scanned whole — prose references
+        # like ``obs compare`` never match the bare-key regex
+        head = chunk.split("``:", 1)[0] + "``"
+        keys.update(re.findall(r"``([a-z][a-z0-9_]*)``", head))
+    assert len(keys) >= 40, f"docstring parse collapsed: {sorted(keys)}"
+    for key in sorted(keys):
+        exact = sum((key in report_mod.HIGHER_IS_BETTER,
+                     key in report_mod.LOWER_IS_BETTER,
+                     key in report_mod.EXEMPT_METRICS,
+                     key in report_mod.ROW_IDENTITY))
+        suffixed = (key.endswith(report_mod.HIGHER_SUFFIXES)
+                    or key.endswith(report_mod.EXEMPT_SUFFIXES))
+        assert exact <= 1, f"{key!r} appears in multiple direction tables"
+        assert exact == 1 or suffixed, (
+            f"bench.py documents {key!r} but no obs/report.py direction "
+            f"table or suffix rule classifies it")
+
+
+# ---------------------------------------------------------------------------
+# the scrape rides the heartbeat: zero new connections, by count
+# ---------------------------------------------------------------------------
+
+def test_scrape_rides_heartbeat_with_zero_new_connections():
+    """The piggyback contract, asserted at the transport: a scripted
+    replica server counts accept() calls while the health plane probes
+    AND scrapes it — telemetry must add zero connections (and zero
+    sockets means the ping and the scrape share one mux'd line)."""
+    from fakepta_tpu.serve.health import HealthMonitor
+    from tests.test_lifecycle import _FakeFleet
+
+    stop = threading.Event()
+    accepts = [0]
+    seq = [0]
+    srv = socket_mod.create_server(("127.0.0.1", 0))
+    srv.settimeout(0.05)
+    port = srv.getsockname()[1]
+
+    def handle(conn):
+        conn.settimeout(0.05)
+        buf = b""
+        with conn:
+            while not stop.is_set():
+                try:
+                    data = conn.recv(65536)
+                except socket_mod.timeout:
+                    continue
+                except OSError:
+                    return
+                if not data:
+                    return
+                buf += data
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    req = json.loads(line)
+                    if req.get("kind") == "telemetry":
+                        seq[0] += 1
+                        reply = {"id": req["id"], "ok": True, "telemetry": {
+                            "seq": seq[0], "epoch": "e1",
+                            "t": time.monotonic(), "replica": "w0",
+                            "slo": {"serve_requests": seq[0] * 2,
+                                    "serve_failed": 0,
+                                    "serve_dispatches": seq[0],
+                                    "qps_per_chip": 1.0, "p50_ms": 2.0,
+                                    "p99_ms": 5.0, "queue_depth": 0},
+                            "live": {}}}
+                    else:
+                        reply = {"id": req["id"], "ok": True, "pong": True}
+                    conn.sendall((json.dumps(reply) + "\n").encode())
+
+    def server():
+        with srv:
+            while not stop.is_set():
+                try:
+                    conn, _ = srv.accept()
+                except (socket_mod.timeout, OSError):
+                    continue
+                accepts[0] += 1
+                threading.Thread(target=handle, args=(conn,),
+                                 daemon=True).start()
+
+    threading.Thread(target=server, daemon=True).start()
+    rep = SocketReplica("w0", connect=("127.0.0.1", port))
+    agg = TelemetryAggregator()
+    hm = HealthMonitor(_FakeFleet({"w0": rep}), SCRAPE_HEALTH,
+                       aggregator=agg).start()
+    try:
+        assert _wait_for(lambda: hm.stats()["fleet_scrapes"] >= 3)
+        st = hm.stats()
+        assert st["fleet_probes"] >= st["fleet_scrapes"]
+        assert st["fleet_scrape_errors"] == 0
+        row = agg.rollup()["per_replica"]["w0"]
+        assert row["snapshots"] >= 3 and row["seq"] >= 3
+        # the scraper stamps the health-ladder view it probed with
+        assert row["health"] == "healthy" and not row["breaker_open"]
+        # THE contract: probes + scrapes together opened ONE connection
+        assert accepts[0] == 1, (
+            f"telemetry opened {accepts[0] - 1} extra connection(s)")
+    finally:
+        stop.set()
+        hm.stop(timeout_s=10.0)
+        rep.close()
+
+
+# ---------------------------------------------------------------------------
+# the jax-backed fleet lanes (one module fleet, tiny specs, shared cache)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def telem_fleet(tmp_path_factory):
+    import jax
+
+    from fakepta_tpu.parallel.mesh import make_mesh
+
+    cache = tmp_path_factory.mktemp("telemetry_cache")
+    cfg = ServeConfig(buckets=(8,), coalesce_window_s=0.01)
+    replicas = [LocalReplica(f"h{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg, compile_cache_dir=str(cache),
+                             index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    flt.enable_health(SCRAPE_HEALTH)
+    yield {"fleet": flt, "cache": cache, "cfg": cfg}
+    flt.close()
+    jax.config.update("jax_compilation_cache_dir", None)
+    from jax.experimental.compilation_cache import compilation_cache
+    compilation_cache.reset_cache()
+
+
+def test_fleet_scrape_feeds_rollup_and_exposition(telem_fleet):
+    flt = telem_fleet["fleet"]
+    flt.serve(SimRequest(spec=SPEC0, n=4, seed=1), timeout=600)
+
+    def _served():
+        # the scrape ring refreshes at heartbeat cadence — wait for the
+        # post-completion snapshot to land, not just for scrape count
+        return max(r.get("requests", 0) for r in
+                   flt.telemetry_rollup()["per_replica"].values() or [{}])
+
+    assert _wait_for(lambda: _served() >= 1)
+    rollup = flt.telemetry_rollup()
+    assert rollup["schema"] == SCHEMA_V2
+    assert set(rollup["per_replica"]) == {"h0", "h1"}
+    assert rollup["fleet"]["replicas"] == 2
+    assert rollup["fleet"]["ingested"] >= 4
+    assert flt.slo_summary().get("fleet_scrapes", 0) >= 4
+    # both expositions render the declared names live
+    fleet_text = flt.metrics_text()
+    assert "fakepta_fleet_replicas 2" in fleet_text
+    assert 'fakepta_up{replica="h0"}' in fleet_text
+    pool_text = flt.replicas["h0"].pool.metrics_text()
+    assert pool_text.startswith("# HELP")
+    assert "fakepta_serve_requests_total" in pool_text
+
+
+def test_stats_protocol_reply_is_enriched(telem_fleet):
+    from fakepta_tpu.serve.cli import _serve_stream
+
+    pool = telem_fleet["fleet"].replicas["h0"].pool
+    lines = [json.dumps({"id": i, "kind": k}) for i, k in
+             enumerate(("ping", "stats", "telemetry", "metrics"))]
+    out = []
+    n = _serve_stream(pool, lines, out.append, SPEC0, "summary")
+    assert n == 0               # protocol kinds answer inline, no dispatch
+    replies = {r["id"]: r for r in map(json.loads, out)}
+    assert replies[0]["pong"] and all(r["ok"] for r in replies.values())
+    # stats keeps its historical SLO shape and gains the ladder/pool/
+    # stream views under their own keys
+    assert "serve_requests" in replies[1]["stats"]
+    assert {"health", "pool", "streams"} <= set(replies[1])
+    assert replies[1]["health"]["state"] == "healthy"
+    snap = replies[2]["telemetry"]
+    assert snap["seq"] >= 1 and {"slo", "pool", "live"} <= set(snap)
+    assert replies[3]["metrics"].startswith("# HELP fakepta_")
+
+
+def test_traced_failover_exports_linked_chrome_flow(telem_fleet, tmp_path):
+    """The tentpole acceptance on a 2-replica kill: a request that fails
+    over mid-flight exports ONE validated Chrome trace in which the
+    router's route span and the surviving replica's spans share its
+    trace_id, joined by an s/…/f flow chain across pid lanes."""
+    import jax
+
+    from fakepta_tpu.parallel.mesh import make_mesh
+    from fakepta_tpu.serve.loadgen import export_fleet_trace
+
+    # a wide coalesce window holds submissions queued long enough that
+    # the kill lands while they are in flight on the owner
+    cfg = dataclasses.replace(telem_fleet["cfg"], coalesce_window_s=0.2)
+    replicas = [LocalReplica(f"k{i}", mesh=make_mesh(jax.devices()[:1]),
+                             config=cfg,
+                             compile_cache_dir=str(telem_fleet["cache"]),
+                             index=i) for i in range(2)]
+    flt = ServeFleet(replicas, FleetConfig())
+    try:
+        ref = flt.serve(SimRequest(spec=SPEC0, n=4, seed=0), timeout=600)
+        owner = flt.ring.owner(SPEC0.spec_hash())
+        futs = [flt.submit(SimRequest(spec=SPEC0, n=4, seed=s))
+                for s in range(6)]
+        flt._mark_dead(owner, "telemetry test kill")
+        flt.replicas[owner].kill()
+        results = [f.result(timeout=600) for f in futs]
+        failed_over = [r for r in results if r.failovers > 0]
+        assert failed_over, "no request was in flight across the kill"
+        assert all(r.replica != owner for r in results)
+        # the per-request RNG-lane contract: the failed-over rerun of
+        # seed 0 is bit-identical to the pre-kill reference
+        assert np.array_equal(results[0].curves, ref.curves)
+
+        trace_path = tmp_path / "failover_trace.json"
+        info = export_fleet_trace(flt, trace_path)   # validates en route
+        assert info["flows"] >= 1 and info["shards"] >= 2
+        trace = json.loads(trace_path.read_text())
+        tracefmt.validate_trace(trace)
+        evs = trace["traceEvents"]
+        routed = [e for e in evs if e["ph"] == "X" and e["name"] == "route"
+                  and e["args"].get("failovers", 0) > 0]
+        assert routed, "no failed-over route span in the router lane"
+        trace_id = routed[0]["args"]["trace_id"]
+        linked = [e for e in evs if e["ph"] == "X" and (
+            (e.get("args") or {}).get("trace_id") == trace_id
+            or trace_id in ((e.get("args") or {}).get("trace_ids") or ()))]
+        assert len({e["pid"] for e in linked}) >= 2, (
+            "the failed-over request's spans never crossed pid lanes")
+        chain = [e for e in evs if e["ph"] in ("s", "t", "f")
+                 and e["name"] == f"trace:{trace_id}"]
+        assert chain and chain[0]["ph"] == "s" and chain[-1]["ph"] == "f"
+        assert len({e["id"] for e in chain}) == 1
+        # the failover instant marks the dead replica's lane in the
+        # router timeline, tagged with the same trace identity
+        insts = [e for e in evs if e["ph"] == "i"
+                 and e["name"] == "fleet_failover"
+                 and e["args"].get("trace_id") == trace_id]
+        assert insts and insts[0]["args"]["from_replica"] == owner
+    finally:
+        flt.close()

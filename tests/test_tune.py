@@ -161,6 +161,9 @@ def test_warm_store_zero_probes(searched):
     assert cfg2.knobs == searched["cfg"].knobs
 
 
+@pytest.mark.slow   # ~11 s: tier-1 budget reclaim (ISSUE 17) — the tuned
+# store's never-loses contract stays tier-1; the apply-and-stay-warm
+# drive moves to tier-2
 def test_run_tuned_true_applies_store_and_stays_warm(searched):
     os.environ[tune_defaults.TUNE_DIR_ENV] = \
         str(Path(searched["store"]).parent)

@@ -149,6 +149,9 @@ def test_white_sampling_mesh_shape_invariance(batch):
         np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
 
 
+@pytest.mark.slow   # ~11 s: tier-1 budget reclaim (ISSUE 17) — white
+# sampling keeps its tier-1 parity pins in this file; the stream-isolation
+# differencing re-verifies in tier-2
 def test_white_sampling_leaves_other_streams_untouched(batch):
     """Adding white sampling must not move the GP/GWB realizations: with the
     white stage excluded from the statistic inputs (red only), sampled and
