@@ -180,6 +180,29 @@ RunReport each ``sim.run()`` attaches):
   the same simulator (both higher-better; the search always probes the
   hand-set default candidate first, so the tuner can select but never
   silently lose to it);
+- ``gw_hit_rate`` / ``gw_device_s_saved`` / ``gw_p99_ms_under_quota`` /
+  ``gw_throttles`` / ``gw_cutover_ms`` / ``gw_requests`` / ``gw_tenants``
+  / ``gw_coalesced`` / ``gw_verified``: the multi-tenant gateway lane
+  (``fakepta_tpu.gateway``, docs/GATEWAY.md; ``benchmarks/suite.py``
+  config 16 — a Zipfian hot-spec tenant mix against a gateway-fronted
+  fleet). ``gw_hit_rate`` (higher-better via the ``_hit_rate`` suffix,
+  acceptance >= 0.5 at the scripted skew) is the fraction of admitted
+  requests served from the content-addressed result store or folded into
+  an in-flight identical leader; every hit is bit-verified against a solo
+  engine run on the same RNG lane before the row is recorded (the row is
+  REFUSED on any mismatch, so ``gw_verified`` — exempt shape fact — counts
+  proofs, not samples). ``gw_device_s_saved`` (higher-better) is the
+  producing runs' device-seconds not re-spent on hits;
+  ``gw_p99_ms_under_quota`` (lower-better) the admitted-request p99 across
+  tenants while the hot tenant is throttled at its fair share;
+  ``gw_cutover_ms`` (lower-better) the fence-to-swap wall clock of the
+  mid-load frozen-grid migration cutover (TOA conservation and the
+  append-equals-restage oracle enforced, 0 dropped appends or the row is
+  refused). ``gw_throttles`` / ``gw_requests`` / ``gw_tenants`` are
+  exempt traffic-shape facts (the scripted Zipf overload produces
+  throttles by design) and ``gw_coalesced`` (exempt) counts requests that
+  rode another tenant's in-flight dispatch — race-timing dependent, so a
+  shape fact, while the hits it produces still bit-verify;
 - ``peak_hbm_bytes``: the measured run's HBM watermark from the RunReport's
   memwatch lane (allocator ``peak_bytes_in_use`` max-aggregated over local
   devices and over the low-rate in-run sampler where the backend exposes

@@ -92,7 +92,7 @@ class LockModel:
         self.index = index
         self.events: Dict[str, List[Event]] = {}
         self._local_locks: Dict[str, Dict[str, int]] = {}
-        self._kw_timeout_cache: Dict[int, bool] = {}
+        self._kw_timeout_cache: Dict[int, bool] = {}  # fakepta: allow[unbounded-cache] one entry per AST call node of one analysis pass, freed with the pass
         for qname in sorted(index.functions):
             self.events[qname] = self._function_events(
                 index.functions[qname])

@@ -144,6 +144,14 @@ UNBOUNDED_QUEUE_MODULES = (
     "fakepta_tpu/parallel/pipeline.py",
 )
 
+# unbounded-cache allowlist: library modules whose cache-named dict
+# containers are bounded by an EXTERNAL invariant the AST can't see.
+# Currently empty: every cache in the repo carries its bound locally —
+# the fake_pta phase cache evicts oldest-first against a byte budget, the
+# fleet's _recent and the gateway result index are popitem-bounded LRUs,
+# and the gateway single-flight table bypasses (never inserts) at cap.
+UNBOUNDED_CACHE_MODULES = ()
+
 # unbounded-thread-join allowlist: library modules whose bare ``.join()``
 # waits are bounded by an EXTERNAL invariant rather than a timeout
 # argument. Currently empty: every shutdown join in the repo carries a
@@ -192,6 +200,11 @@ METRIC_NAMES = (
     "faults.rollbacks",
     "fleet.breaker_opens", "fleet.drains", "fleet.heartbeat_misses",
     "fleet.joins", "fleet.scale_events",
+    "gateway.auth_failures", "gateway.cache_rejects",
+    "gateway.coalesce_bypass", "gateway.coalesced",
+    "gateway.cutover_aborts", "gateway.cutovers", "gateway.hits",
+    "gateway.requests", "gateway.store_evictions", "gateway.store_puts",
+    "gateway.throttles",
     "jax.backend_compile_s", "jax.lowering_s", "jax.trace_s",
     "obs.chunks", "obs.peak_hbm_bytes", "obs.retraces", "obs.traces",
     "pipeline.d2h_async", "pipeline.h2d_prefetch",
@@ -250,6 +263,10 @@ LOCK_ALIASES = {
 # exists in the graph. Locks not listed here are constrained only by cycle
 # detection.
 LOCK_ORDER = (
+    "Gateway._lock",           # gateway tier: tenant admission + the
+                               # single-flight table (outermost — held for
+                               # bookkeeping only, released before any
+                               # fleet/store call; futures resolve outside)
     "SocketReplica._lock",     # transport: pending-futures map (leaf-most
                                # holder — completion callbacks run OUTSIDE)
     "ServePool._lock",         # scheduler: admission queues + stats
@@ -257,6 +274,12 @@ LOCK_ORDER = (
                                # UNDER nothing — opened outside the registry)
     "ServeFleet._lock",        # router: ring membership + SLO stats
     "HealthMonitor._lock",     # health counters (probes run lock-free)
+    "ResultStore._io_lock",    # gateway index-file writes: serializes
+                               # write_atomic's fixed staged tmp name;
+                               # nests OVER _lock (flush re-snapshots)
+    "ResultStore._lock",       # gateway result store: index + payload LRU
+                               # (leaf under _io_lock — payload IO happens
+                               # outside, index mutation is bookkeeping)
     "obs/flightrec._dump_lock",  # flight-recorder dump serialization
                                  # (leaf; module locks are keyed
                                  # <module-short>.<name>)

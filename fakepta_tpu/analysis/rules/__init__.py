@@ -8,14 +8,14 @@ docs/INVARIANTS.md. Whole-program rules take ``check(index)`` over the
 in ``PROJECT_RULES``.
 """
 
-from . import (collectives, donation, dtype, excepts, hostsync, joins,
-               knobs, meshaxis, metric_names, precision, queues, rng,
+from . import (caches, collectives, donation, dtype, excepts, hostsync,
+               joins, knobs, meshaxis, metric_names, precision, queues, rng,
                socketio, timing, tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
-                              donation, precision, timing, queues, excepts,
-                              knobs, socketio, joins, metric_names))
+                              donation, precision, timing, queues, caches,
+                              excepts, knobs, socketio, joins, metric_names))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)
 

@@ -33,6 +33,11 @@ site                      where it is checked
 ``ingest.append``         StreamState.append, at the top of each TOA block
 ``telemetry.scrape``      the fleet health monitor, before each telemetry
                           scrape riding a successful probe
+``gateway.admit``         Gateway.submit, after auth and before any quota
+                          or cache state moves
+``gateway.cutover``       StreamManager.cutover, twice per operation: at
+                          the fence (``stage='restage'``) and again before
+                          the atomic swap (``stage='swap'``)
 ========================  ====================================================
 
 ``fleet.heartbeat`` is checked inside the monitor's probe path with

@@ -1,0 +1,32 @@
+"""fakepta_tpu.gateway — multi-tenant gateway + content-addressed results.
+
+The tier that turns the serve fleet into a *service* (docs/GATEWAY.md):
+per-tenant auth/quota/fair-share admission with per-tenant 429 retry
+hints, single-flight coalescing of identical concurrent requests (sound
+under the serve layer's bit-identical-per-RNG-lane contract), a
+content-addressed result store keyed by
+``spec_hash x lane token x (seed, n) x engine fingerprint`` with the tune
+store's atomic-write/CRC/schema-bump lifecycle, and the frozen-grid
+migration cutover as a gateway-managed operation.
+
+Embeddable surface::
+
+    from fakepta_tpu.gateway import Gateway, Tenant
+    from fakepta_tpu.serve import ArraySpec, LocalReplica, ServeFleet,
+        SimRequest
+
+    fleet = ServeFleet([LocalReplica("r0")])
+    gw = Gateway(fleet, [Tenant("acme", token="tok-acme", weight=2)])
+    res = gw.serve(SimRequest(spec=ArraySpec(npsr=20), n=32, seed=7),
+                   token="tok-acme")     # repeat = cache hit, 0 device-s
+"""
+
+from .core import Gateway
+from .cutover import cutover_stream
+from .store import ResultStore, default_gateway_dir, request_key
+from .tenants import GatewayAuthError, GatewayBusy, Tenant, TenantTable
+
+__all__ = [
+    "Gateway", "GatewayAuthError", "GatewayBusy", "ResultStore", "Tenant",
+    "TenantTable", "cutover_stream", "default_gateway_dir", "request_key",
+]

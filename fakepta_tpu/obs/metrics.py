@@ -85,6 +85,11 @@ METRIC_NAMES = frozenset({
     "telemetry.scrapes", "telemetry.scrape_errors", "telemetry.alerts",
     "serve.append_latency_s", "stream.refresh_gate_opens",
     "stream.refresh_gate_holds", "sample.segments_done",
+    # gateway tier (gateway/core.py, gateway/store.py, gateway/cutover.py)
+    "gateway.requests", "gateway.hits", "gateway.coalesced",
+    "gateway.throttles", "gateway.auth_failures", "gateway.cache_rejects",
+    "gateway.store_puts", "gateway.store_evictions",
+    "gateway.coalesce_bypass", "gateway.cutovers", "gateway.cutover_aborts",
 })
 
 # jax.monitoring duration events forwarded into collectors, renamed to stable

@@ -62,6 +62,28 @@ PROM_METRICS: Dict[str, Tuple[str, str]] = {
         ("gauge", "worst per-replica p99 (milliseconds)"),
     "fakepta_alert_active":
         ("gauge", "1 per currently-firing alert rule"),
+    "fakepta_gateway_tenant_qps":
+        ("gauge", "windowed completed requests/s per tenant"),
+    "fakepta_gateway_tenant_requests_total":
+        ("counter", "requests admitted to the gateway per tenant"),
+    "fakepta_gateway_tenant_throttles_total":
+        ("counter", "429s (quota/fair-share rejections) per tenant"),
+    "fakepta_gateway_tenant_hit_rate":
+        ("gauge", "fraction of a tenant's requests served from the "
+                  "result store"),
+    "fakepta_gateway_tenant_queue_share":
+        ("gauge", "a tenant's share of the gateway's in-flight slots"),
+    "fakepta_gateway_cache_hits_total":
+        ("counter", "requests served from the content-addressed store"),
+    "fakepta_gateway_cache_rejects_total":
+        ("counter", "store entries refused on integrity grounds "
+                    "(CRC/schema/fingerprint mismatch)"),
+    "fakepta_gateway_coalesced_total":
+        ("counter", "requests folded into an in-flight identical leader"),
+    "fakepta_gateway_device_seconds_saved":
+        ("gauge", "device-seconds not spent thanks to cache hits"),
+    "fakepta_gateway_cutovers_total":
+        ("counter", "frozen-grid migration cutovers completed"),
 }
 
 
@@ -134,6 +156,27 @@ def render(rollup: dict) -> str:
             if isinstance(value, (int, float)) and not isinstance(
                     value, bool):
                 emit("fakepta_live_gauge", dict(lab, name=name), value)
+
+    gw = rollup.get("gateway")
+    if gw:
+        emit("fakepta_gateway_cache_hits_total", {}, gw.get("hits", 0))
+        emit("fakepta_gateway_cache_rejects_total", {},
+             gw.get("cache_rejects", 0))
+        emit("fakepta_gateway_coalesced_total", {}, gw.get("coalesced", 0))
+        emit("fakepta_gateway_device_seconds_saved", {},
+             gw.get("device_s_saved", 0.0))
+        emit("fakepta_gateway_cutovers_total", {}, gw.get("cutovers", 0))
+    for tid, row in sorted(rollup.get("tenants", {}).items()):
+        lab = {"tenant": tid}
+        emit("fakepta_gateway_tenant_qps", lab, row.get("qps", 0.0))
+        emit("fakepta_gateway_tenant_requests_total", lab,
+             row.get("requests", 0))
+        emit("fakepta_gateway_tenant_throttles_total", lab,
+             row.get("throttles", 0))
+        emit("fakepta_gateway_tenant_hit_rate", lab,
+             row.get("hit_rate", 0.0))
+        emit("fakepta_gateway_tenant_queue_share", lab,
+             row.get("queue_share", 0.0))
 
     for alert in rollup.get("alerts", []):
         emit("fakepta_alert_active",

@@ -57,6 +57,13 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # the _hit_rate suffix
                     "fleet_qps", "fleet_qps_per_chip", "fleet_speedup_x",
                     "fleet_solo_qps",
+                    # the gateway tier (fakepta_tpu.gateway,
+                    # docs/GATEWAY.md): device-seconds the content-
+                    # addressed result store did not re-spend — the
+                    # cache's whole point; gw_hit_rate rides the
+                    # _hit_rate suffix and gw_p99_ms_under_quota /
+                    # gw_cutover_ms keep the lower-is-better default
+                    "gw_device_s_saved",
                     # the autotuner (fakepta_tpu.tune, docs/TUNING.md):
                     # tuned-vs-hand-set throughput multiple — dropping
                     # below its band means the tuner stopped finding (or
@@ -165,7 +172,20 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # regression-bearing telemetry metrics keep the lower-
                   # is-better default: fleet_scrape_errors, fleet_alerts,
                   # telemetry_overhead_frac)
-                  "fleet_scrapes", "trace_flows"}
+                  "fleet_scrapes", "trace_flows",
+                  # gateway-lane shape facts (fakepta_tpu.gateway,
+                  # docs/GATEWAY.md, the config16 Zipf tenant mix):
+                  # traffic volume, tenant count, bit-verification tallies,
+                  # throttle counts (the scripted overload MAKES the hot
+                  # tenant throttle — per-tenant 429s are the isolation
+                  # mechanism working, not a regression) and coalesce
+                  # counts (race-timing dependent). The regression-bearing
+                  # gateway metrics are gw_hit_rate (higher, via the
+                  # _hit_rate suffix), gw_device_s_saved (higher above)
+                  # and gw_p99_ms_under_quota / gw_cutover_ms
+                  # (lower-better below)
+                  "gw_requests", "gw_tenants", "gw_verified",
+                  "gw_throttles", "gw_coalesced"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")
@@ -202,6 +222,10 @@ LOWER_IS_BETTER = {"compile_s", "retraces", "cost_bytes_per_chunk",
                    "restage_ms", "stream_recompiles", "faults_retries",
                    "faults_degradations", "faults_rollbacks",
                    "tune_probe_s", "peak_hbm_bytes",
+                   # gateway lane (docs/GATEWAY.md): admitted-request p99
+                   # while the hot tenant rides its fair-share quota, and
+                   # the fence-to-swap cost of a managed migration cutover
+                   "gw_p99_ms_under_quota", "gw_cutover_ms",
                    # telemetry plane (docs/OBSERVABILITY.md): failed
                    # scrapes, fired alert rules, and the scrape-on vs
                    # scrape-off qps cost are all degradations

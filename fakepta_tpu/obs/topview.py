@@ -63,6 +63,30 @@ def render_table(rollup: dict) -> str:
                  if k.startswith(("stream.refresh", "sample."))}
         for name, value in sorted(gates.items()):
             lines.append(f"    {name} = {value}")
+    gw = rollup.get("gateway")
+    if gw:
+        lines.append(
+            f"gateway: requests={gw.get('requests', 0)}  "
+            f"hits={gw.get('hits', 0)} "
+            f"({100.0 * gw.get('hit_rate', 0.0):.0f}%)  "
+            f"coalesced={gw.get('coalesced', 0)}  "
+            f"throttles={gw.get('throttles', 0)}  "
+            f"saved={gw.get('device_s_saved', 0.0):.2f}s")
+    tenants = rollup.get("tenants", {})
+    if tenants:
+        twidths = (10, 8, 8, 6, 6, 6, 8)
+        lines.append("  ".join(c.rjust(w) for c, w in zip(
+            ("TENANT", "QPS", "REQS", "429s", "HIT%", "SHARE", "P99ms"),
+            twidths)))
+        for tid, row in sorted(tenants.items()):
+            cells = (
+                tid, row.get("qps", 0.0), row.get("requests", 0),
+                row.get("throttles", 0),
+                f"{100.0 * row.get('hit_rate', 0.0):.0f}",
+                f"{100.0 * row.get('queue_share', 0.0):.0f}%",
+                row.get("p99_ms", 0.0))
+            lines.append("  ".join(_fmt(c, w)
+                                   for c, w in zip(cells, twidths)))
     for rid in sorted(rollup.get("retired", {})):
         lines.append(f"  retired: {rid}")
     for alert in rollup.get("alerts", []):

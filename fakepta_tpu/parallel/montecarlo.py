@@ -1580,14 +1580,14 @@ class EnsembleSimulator:
         # empty OS-weight stack for the plain fused step (the fused builders
         # share one signature so the n_os=0 path stays byte-compatible)
         self._w_os_empty = jnp.zeros((0, batch.npsr, batch.npsr), dtype)
-        self._step_os_cache: dict = {}
-        self._step_fused_os_cache: dict = {}
+        self._step_os_cache: dict = {}  # fakepta: allow[unbounded-cache] keyed by the bf16 flag, 2 entries max
+        self._step_fused_os_cache: dict = {}  # fakepta: allow[unbounded-cache] keyed by (bf16, n_os) over the fixed OS-weight set
         # lnlike lane (fakepta_tpu.infer): compiled models and step variants,
         # keyed by the (hashable) LikelihoodSpec + mode + path
-        self._lnlike_compiled_cache: dict = {}
-        self._step_lnlike_cache: dict = {}
-        self._step_xla_cache: dict = {}
-        self._step_mega_cache: dict = {}
+        self._lnlike_compiled_cache: dict = {}  # fakepta: allow[unbounded-cache] one entry per LikelihoodSpec this simulator serves — caller-enumerated, not request-keyed
+        self._step_lnlike_cache: dict = {}  # fakepta: allow[unbounded-cache] keyed by (bf16, LikelihoodSpec) over the same enumerated set
+        self._step_xla_cache: dict = {}  # fakepta: allow[unbounded-cache] keyed by the bf16 flag, 2 entries max
+        self._step_mega_cache: dict = {}  # fakepta: allow[unbounded-cache] keyed by the bf16 flag, 2 entries max
         self._mega_tables = None
         self._step = self._build_step(self._stats_bf16)
         self._step_xla_cache[self._stats_bf16] = self._step

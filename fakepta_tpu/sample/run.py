@@ -271,7 +271,7 @@ class SamplingRun:
         self._mom_dev = tuple(
             jax.device_put(np.asarray(m, dtype=self._dtype), psr_sh)
             for m in self._mom64)
-        self._prog_cache: dict = {}
+        self._prog_cache: dict = {}  # fakepta: allow[unbounded-cache] one compiled program per (segment shape, precision) — the run plan enumerates both
         self._trace_counts: dict = {}
         self.retraces = 0
         self.last_report = None

@@ -54,7 +54,8 @@ from .fleet import (FleetConfig, LocalReplica, ReplicaDead,
                     SampleSessionSpec, SamplingSession, ServeFleet,
                     SocketReplica)
 from .health import HealthConfig, HealthMonitor
-from .loadgen import run_elastic_loadgen, run_fleet_loadgen, run_loadgen
+from .loadgen import (run_elastic_loadgen, run_fleet_loadgen,
+                      run_gateway_loadgen, run_loadgen)
 from .pool import PoolEntry, WarmPool
 from .router import HashRing
 from .scheduler import ServeConfig, ServePool, ServeResult
@@ -72,5 +73,5 @@ __all__ = [
     "ServePool", "ServeResult", "ServeTimeout", "SimRequest",
     "SocketReplica", "StreamManager", "StreamRequest", "WarmPool",
     "curn_grid_spec", "run_elastic_loadgen", "run_fleet_loadgen",
-    "run_loadgen",
+    "run_gateway_loadgen", "run_loadgen",
 ]

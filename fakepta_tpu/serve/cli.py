@@ -369,11 +369,17 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
                 # "stats" keeps its historical SLO-summary shape; the
                 # health-ladder state, warm-pool occupancy, and stream
                 # counts ride alongside under their own keys
-                emit_line({"id": req_id, "ok": True,
-                           "stats": pool.slo_summary(),
-                           "health": pool.health_summary(),
-                           "pool": pool.warm_summary(),
-                           "streams": pool.stream_summary()})
+                out = {"id": req_id, "ok": True,
+                       "stats": pool.slo_summary(),
+                       "health": pool.health_summary(),
+                       "pool": pool.warm_summary(),
+                       "streams": pool.stream_summary()}
+                # a gateway front (fakepta_tpu.gateway) adds its tenant
+                # table — per-tenant qps/429s/queue-share/hit-rate rows
+                tenants = getattr(pool, "tenant_summary", None)
+                if tenants is not None:
+                    out["tenants"] = tenants()
+                emit_line(out)
                 continue
             if kind == "telemetry":
                 # the health plane's scrape: one bounded publisher
@@ -391,6 +397,23 @@ def _serve_stream(pool, lines, write, default_spec, emit: str) -> int:
             if kind == "sample":
                 _serve_sample(pool, d, req_id, emit_line, default_spec,
                               emit)
+                continue
+            if kind == "cutover":
+                # frozen-grid migration (docs/STREAMING.md "Migration
+                # cutover"): synchronous by design — the reply IS the
+                # fence release, so the driver knows the swap landed
+                spec = d.get("spec")
+                if not isinstance(spec, dict):
+                    raise ValueError("cutover needs a spec object (the "
+                                     "wider template)")
+                try:
+                    info = pool.cutover_stream(
+                        str(d["stream"]), ArraySpec(**spec),
+                        checkpoint=d.get("checkpoint"))
+                except Exception as exc:   # abort -> error line, old
+                    emit_line(error_json(req_id, exc))   # state installed
+                else:
+                    emit_line({"id": req_id, "ok": True, "cutover": info})
                 continue
             req = request_from_json(d, default_spec)
         except (ValueError, KeyError, TypeError, AttributeError) as exc:

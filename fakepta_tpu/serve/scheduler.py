@@ -746,6 +746,17 @@ class ServePool:
             mgr = self._stream_mgr
         return mgr.summary() if mgr is not None else {}
 
+    def cutover_stream(self, name: str, spec, checkpoint=None) -> dict:
+        """Frozen-grid migration cutover for one of this pool's streams
+        (the ``cutover`` protocol kind; the gateway's managed operation —
+        :meth:`~fakepta_tpu.serve.streams.StreamManager.cutover`)."""
+        with self._lock:
+            mgr = self._stream_mgr
+        if mgr is None:
+            raise ServeError(f"stream {name!r} is not open on this pool; "
+                             f"nothing to cut over")
+        return mgr.cutover(name, spec, checkpoint=checkpoint)
+
     def health_summary(self) -> dict:
         """The replica's own liveness facts (the fleet's HealthMonitor
         owns the authoritative ladder state; this is what the replica can
@@ -762,12 +773,13 @@ class ServePool:
         LocalReplica scrape path)."""
         return self.telemetry.snapshot()
 
-    def metrics_text(self) -> str:
-        """Prometheus text-format exposition of this pool's own rollup
-        (the ``metrics`` protocol kind). The pool keeps a single-replica
-        aggregator alive across calls so rate-style metrics (qps) see a
-        real window between scrapes."""
-        from ..obs import promfmt
+    def telemetry_rollup(self) -> dict:
+        """This pool's own single-replica aggregator rollup — the same
+        shape ``ServeFleet.telemetry_rollup`` produces, so a fronting
+        :class:`~fakepta_tpu.gateway.Gateway` (or ``obs top``) consumes a
+        bare pool and a fleet identically. The pool keeps the aggregator
+        alive across calls so rate-style metrics (qps) see a real window
+        between scrapes."""
         from ..obs import telemetry as telemetry_mod
 
         with self._lock:
@@ -778,7 +790,14 @@ class ServePool:
         agg.ingest("self", self.telemetry.snapshot(),
                    health={"state": health["state"], "misses": 0,
                            "breaker_open": False})
-        return promfmt.render(agg.rollup())
+        return agg.rollup()
+
+    def metrics_text(self) -> str:
+        """Prometheus text-format exposition of this pool's own rollup
+        (the ``metrics`` protocol kind)."""
+        from ..obs import promfmt
+
+        return promfmt.render(self.telemetry_rollup())
 
     def save_report(self, path) -> str:
         """Write the pool's telemetry as a RunReport artifact: ``obs

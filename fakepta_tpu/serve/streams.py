@@ -24,7 +24,9 @@ from __future__ import annotations
 import collections
 import threading
 
-from .. import obs
+import numpy as np
+
+from .. import faults, obs
 from ..obs import flightrec
 from ..tune import defaults as tune_defaults
 from .spec import ArraySpec, ServeError
@@ -33,6 +35,22 @@ from .spec import ArraySpec, ServeError
 #: for on-disk artifacts; versioned separately because the wire payload is
 #: a serve-layer contract)
 STREAM_PAYLOAD_SCHEMA = "fakepta_tpu.serve-stream/1"
+
+
+class _StreamSlot:
+    """One registered stream: its per-stream lock plus the CURRENT state.
+
+    ``state`` is only read or replaced while holding ``lock`` — that is
+    the migration-cutover fence: an appender that was waiting on the lock
+    while :meth:`StreamManager.cutover` swapped the state lands its block
+    on the NEW template, never on the retired one (zero dropped appends,
+    docs/STREAMING.md "Migration cutover")."""
+
+    __slots__ = ("lock", "state")
+
+    def __init__(self, state):
+        self.lock = threading.Lock()
+        self.state = state
 
 
 class StreamManager:
@@ -44,16 +62,16 @@ class StreamManager:
     def __init__(self, mesh=None):
         self.mesh = mesh
         self._lock = threading.Lock()
-        self._streams: dict = {}      # name -> (threading.Lock, StreamState)
+        self._streams: dict = {}      # name -> _StreamSlot
         # per-stream append-latency rings (telemetry plane): bounded like
         # every other telemetry buffer, read by summary()
         self._append_ms: dict = collections.defaultdict(
             lambda: collections.deque(
                 maxlen=tune_defaults.TELEMETRY_RING_SIZE))
 
-    def _session(self, req):
-        """The (lock, state) pair for ``req.stream``, opening it when the
-        request carries a spec.
+    def _session(self, req) -> "_StreamSlot":
+        """The :class:`_StreamSlot` for ``req.stream``, opening it when
+        the request carries a spec.
 
         Two-phase open: the registry lock is held only for the dict
         lookups — :class:`StreamState` construction (device allocation,
@@ -89,7 +107,7 @@ class StreamManager:
         state = StreamState(template, mesh=self.mesh,
                             ecorr_dt=req.ecorr_dt, watch=req.watch,
                             checkpoint=req.checkpoint)
-        entry = (threading.Lock(), state)
+        entry = _StreamSlot(state)
         with self._lock:
             raced = self._streams.get(name)
             if raced is not None:
@@ -107,17 +125,20 @@ class StreamManager:
 
     def handle(self, req) -> dict:
         """Execute one stream-affine request; returns the wire payload."""
-        lock, state = self._session(req)
+        slot = self._session(req)
         name = str(req.stream)
         if req.kind == "append":
             if req.toas is None or req.residuals is None:
                 raise ServeError("append needs toas and residuals")
             t0 = obs.now()
-            with lock:
-                info = state.append(req.toas, req.residuals,
-                                    sigma2=req.sigma2, freqs=req.freqs,
-                                    ecorr_amp=req.ecorr_amp,
-                                    counts=req.counts)
+            with slot.lock:
+                # state re-read UNDER the lock: a cutover that swapped the
+                # slot while this append queued lands it on the new state
+                info = slot.state.append(req.toas, req.residuals,
+                                         sigma2=req.sigma2,
+                                         freqs=req.freqs,
+                                         ecorr_amp=req.ecorr_amp,
+                                         counts=req.counts)
             dt = obs.now() - t0
             obs.observe("serve.append_latency_s", dt)
             with self._lock:
@@ -125,11 +146,97 @@ class StreamManager:
             return dict(info, kind="append", stream=name,
                         payload_schema=STREAM_PAYLOAD_SCHEMA)
         if req.kind == "stream":
-            with lock:
-                stats = state.stats()
+            with slot.lock:
+                stats = slot.state.stats()
             return dict(stats, kind="stream", stream=name,
                         payload_schema=STREAM_PAYLOAD_SCHEMA)
         raise ServeError(f"unknown stream request kind {req.kind!r}")
+
+    # ------------------------------------------------------------------
+    # migration cutover (docs/STREAMING.md "Migration cutover")
+    # ------------------------------------------------------------------
+    def cutover(self, name: str, spec, *, checkpoint=None,
+                rtol=None) -> dict:
+        """Re-stage one stream onto a wider frozen-grid template behind a
+        checkpoint fence and atomically swap — zero dropped appends.
+
+        Protocol (the gateway's managed operation drives this):
+
+        1. the NEW :class:`~fakepta_tpu.stream.StreamState` is built
+           *outside* any lock (device allocation + template staging must
+           not stall sibling streams — the blocking-under-lock invariant);
+        2. the per-stream lock is taken: the **fence**. In-flight appends
+           that already hold it finish on the old state; later ones queue;
+        3. the old state's raw store (absolute TOAs — why the store keeps
+           them) replays onto the new template as one bulk append;
+        4. the swap is refused unless the TOA count is conserved AND the
+           append≡restage oracle holds on the new state (its accumulated
+           moments match a fresh restage within ``rtol``) — on refusal the
+           old state stays installed, untouched;
+        5. the slot's state pointer swaps; queued appends land on the new
+           template. ``gateway.cutover`` chaos-site checks fire before the
+           restage and before the swap.
+        """
+        name = str(name)
+        with self._lock:
+            slot = self._streams.get(name)
+        if slot is None:
+            raise ServeError(f"stream {name!r} is not open; nothing to "
+                             f"cut over")
+        if not isinstance(spec, ArraySpec):
+            raise ServeError("cutover templates must be declarative "
+                             "ArraySpecs")
+        if rtol is None:
+            rtol = tune_defaults.GATEWAY_CUTOVER_RTOL
+        from ..stream import StreamState
+
+        t0 = obs.now()
+        template, _gwb = spec.parts()
+        peek = slot.state          # open-time options carry over
+        fresh = StreamState(template, mesh=self.mesh,
+                            ecorr_dt=peek.ecorr_dt,
+                            watch=peek._watch_orf, checkpoint=checkpoint)
+        with slot.lock:            # -- the fence: appends queue here -----
+            old = slot.state
+            faults.check("gateway.cutover", stream=name, stage="restage")
+            raw = old.raw_data()
+            n_before = int(raw["counts"].sum())
+            if n_before:
+                kwargs = dict(sigma2=raw["sigma2"], freqs=raw["freqs"],
+                              counts=raw["counts"])
+                if old.ecorr_dt is not None:
+                    kwargs["ecorr_amp"] = raw["ecorr"]
+                fresh.append(raw["t"], raw["r"], **kwargs)
+            n_after = int(fresh._n.sum())
+            if n_after != n_before:
+                flightrec.note("gateway_cutover_abort", stream=name,
+                               reason="toa_conservation",
+                               before=n_before, after=n_after)
+                raise ServeError(
+                    f"cutover of {name!r} aborted: restage carried "
+                    f"{n_after} TOAs, expected {n_before}; old state "
+                    f"stays installed")
+            got = [np.asarray(x) for x in fresh.moments()]
+            want = [np.asarray(x) for x in fresh.restage_moments()]
+            for g, w in zip(got, want):
+                if not np.allclose(g, w, rtol=rtol, atol=1e-12):
+                    flightrec.note("gateway_cutover_abort", stream=name,
+                                   reason="oracle",
+                                   max_rel=float(np.max(np.abs(g - w))))
+                    raise ServeError(
+                        f"cutover of {name!r} aborted: append/restage "
+                        f"oracle failed on the new template; old state "
+                        f"stays installed")
+            faults.check("gateway.cutover", stream=name, stage="swap")
+            slot.state = fresh     # -- the atomic swap -------------------
+        info = {"stream": name, "toas": n_after,
+                "appends_replayed": int(old.appends),
+                "old_tspan_s": float(old.tspan),
+                "new_tspan_s": float(fresh.tspan),
+                "new_capacity": int(fresh._cap),
+                "cutover_ms": round((obs.now() - t0) * 1e3, 3)}
+        flightrec.note("gateway_cutover", **info)
+        return info
 
     def stream_names(self):
         with self._lock:
@@ -144,7 +251,8 @@ class StreamManager:
             lat = {name: list(ring)
                    for name, ring in self._append_ms.items()}
         out = {}
-        for name, (_lock, state) in entries:
+        for name, slot in entries:
+            state = slot.state
             ms = lat.get(name, [])
             row = {"appends": int(state.appends),
                    "toas": int(state._n.sum()),

@@ -709,6 +709,32 @@ class StreamState:
         with self._ctx():
             return fin(fixed, res)
 
+    @property
+    def tspan(self) -> float:
+        """The frozen common-grid span (seconds) this stream is pinned to
+        — the quantity a migration cutover widens."""
+        return self._tspan
+
+    def raw_data(self) -> dict:
+        """The host raw store, trimmed to capacity, plus per-pulsar counts
+        — the migration-cutover export (docs/STREAMING.md). Absolute TOAs
+        by design: the block is replayable onto ANY wider frozen-grid
+        template via one bulk :meth:`append`, which is what makes the
+        gateway's cutover protocol a restage rather than a reinterpret."""
+        cap = self._cap
+        if cap == 0:
+            z = np.zeros((self.npsr, 0), dtype=np.float64)
+            return {"t": z, "r": z.copy(), "sigma2": z.copy(),
+                    "freqs": z.copy(), "ecorr": z.copy(),
+                    "counts": np.zeros(self.npsr, dtype=np.int64)}
+        st = self._store
+        return {"t": st["t"][:, :cap].copy(),
+                "r": st["r"][:, :cap].copy(),
+                "sigma2": st["sigma2"][:, :cap].copy(),
+                "freqs": st["freqs"][:, :cap].copy(),
+                "ecorr": st["ecorr"][:, :cap].copy(),
+                "counts": self._n.copy()}
+
     def batch_view(self):
         """The accumulated data as a PulsarBatch on the FROZEN grids — the
         posterior-refresh input (``fakepta_tpu.sample`` consumes it).

@@ -1,0 +1,49 @@
+"""Seeded true positives + near misses for the unbounded-cache rule."""
+import collections
+import functools
+from collections import OrderedDict
+
+
+@functools.cache                        # line 7: no bounded form exists
+def bad_cached(x):
+    return x * 2
+
+
+@functools.lru_cache(maxsize=None)      # line 12: explicitly unbounded
+def bad_lru(x):
+    return x + 1
+
+
+class Worker:
+    def __init__(self):
+        self._spec_cache = {}           # line 19: no eviction anywhere
+        self.memo = dict()              # line 20: no eviction anywhere
+        self.os_caches = OrderedDict()  # line 21: no eviction anywhere
+
+
+@functools.lru_cache(maxsize=128)       # bounded: fine
+def ok_lru(x):
+    return x - 1
+
+
+@functools.lru_cache(maxsize=cap)       # variable bound: accepted
+def ok_var(x):
+    return x
+
+
+class Bounded:
+    def __init__(self):
+        self._hit_cache = collections.OrderedDict()   # evicted below: fine
+        self.memory = {}                # 'memory' is not a cache token
+        self.recent = {}                # not cache-named
+        self.byte_memo = {}             # del-evicted below: fine
+
+    def put(self, key, value):
+        self._hit_cache[key] = value
+        while len(self._hit_cache) > 64:
+            self._hit_cache.popitem(last=False)
+        if key in self.byte_memo:
+            del self.byte_memo[key]
+
+
+allowed_cache = {}  # fakepta: allow[unbounded-cache] keyed by the 3 fixed statistic paths, bounded by enum

@@ -143,14 +143,14 @@ def test_stream_manager_builds_state_outside_manager_lock(monkeypatch):
     monkeypatch.setattr(stream_pkg, "StreamState", ProbeState)
     req = SimpleNamespace(stream="s0", spec=FakeSpec(), ecorr_dt=None,
                           watch=None, checkpoint=None)
-    lock, state = mgr._session(req)
+    slot = mgr._session(req)
     assert lock_free == [True], \
         "StreamState was constructed while StreamManager._lock was held"
-    assert isinstance(state, ProbeState)
+    assert isinstance(slot.state, ProbeState)
     assert mgr.stream_names() == ["s0"]
     # reopen with a spec reuses the live session (grid contract)
-    lock2, state2 = mgr._session(req)
-    assert state2 is state and lock2 is lock
+    slot2 = mgr._session(req)
+    assert slot2 is slot and slot2.state is slot.state
 
 
 def test_thread_writer_exception_handoff_is_locked():

@@ -1,0 +1,371 @@
+"""Gateway tier: tenant auth, weighted fair-share 429s, single-flight
+coalescing, the content-addressed result store's lifecycle (fingerprint /
+schema / corruption rejects, LRU bounds), and the gateway-managed
+frozen-grid cutover. docs/GATEWAY.md pins the contracts; the multi-tenant
+loadgen row (benchmarks/suite.py config 16) exercises the same paths
+under Zipfian load with bit-verification against solo runs."""
+
+import dataclasses
+import json
+import threading
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from fakepta_tpu.gateway import (Gateway, GatewayAuthError, GatewayBusy,
+                                 ResultStore, Tenant, TenantTable)
+from fakepta_tpu.gateway.store import request_key
+from fakepta_tpu.obs import flightrec, promfmt, topview
+from fakepta_tpu.parallel.mesh import make_mesh
+from fakepta_tpu.serve import (AppendRequest, ArraySpec, ServeBusy,
+                               ServePool, SimRequest, StreamRequest)
+from fakepta_tpu.serve.scheduler import ServeResult
+from fakepta_tpu.tune import defaults as tune_defaults
+from fakepta_tpu.tune.fingerprint import fingerprint
+
+SPEC = ArraySpec(npsr=3, ntoa=16)
+
+
+class _FakeFleet:
+    """Duck-typed fleet: deterministic ServeResults per (seed, n) so the
+    gateway's admission / caching / coalescing paths run without a real
+    pool. ``auto=False`` parks dispatches until ``release_all`` — the
+    window the coalescing and fair-share tests need to hold open."""
+
+    def __init__(self):
+        self.dispatches = 0
+        self.auto = True
+        self.busy_exc = None
+        self._pending = []
+        self._lock = threading.Lock()
+
+    @staticmethod
+    def result_for(req):
+        rng = np.random.default_rng((int(req.seed), int(req.n)))
+        return ServeResult(curves=rng.standard_normal((req.n, 5)),
+                           autos=rng.standard_normal(req.n),
+                           bin_centers=np.linspace(0.0, 1.0, 5),
+                           service_s=0.25, bucket=int(req.n),
+                           replica="fake-0")
+
+    def submit(self, req):
+        if self.busy_exc is not None:
+            raise self.busy_exc
+        fut: Future = Future()
+        with self._lock:
+            self.dispatches += 1
+            auto = self.auto
+            if not auto:
+                self._pending.append((req, fut))
+        if auto:
+            fut.set_result(self.result_for(req))
+        return fut
+
+    def release_all(self):
+        with self._lock:
+            pending, self._pending = self._pending, []
+        for req, fut in pending:
+            fut.set_result(self.result_for(req))
+
+    def slo_summary(self):
+        return {}
+
+    def telemetry_rollup(self):
+        return {}
+
+    def reset_stats(self):
+        pass
+
+    def close(self):
+        pass
+
+
+def _gw(tmp_path, **kw):
+    tenants = [Tenant("alice", "tok-alice", weight=2.0),
+               Tenant("bob", "tok-bob", weight=1.0)]
+    fleet = _FakeFleet()
+    gw = Gateway(fleet, tenants, store=ResultStore(tmp_path / "gw"), **kw)
+    return gw, fleet
+
+
+# -- auth -------------------------------------------------------------------
+def test_auth_rejects_unknown_token(tmp_path):
+    gw, fleet = _gw(tmp_path)
+    req = SimRequest(spec=SPEC, n=4, seed=7)
+    with pytest.raises(GatewayAuthError):
+        gw.submit(req, token=None)
+    with pytest.raises(GatewayAuthError):
+        gw.submit(req, token="tok-mallory")
+    res = gw.serve(req, token="tok-alice", timeout=30)
+    assert np.array_equal(res.curves, fleet.result_for(req).curves)
+    assert gw.gateway_summary()["requests"] == 1   # rejects never admit
+
+
+def test_tenant_table_validation():
+    with pytest.raises(ValueError):
+        TenantTable([])
+    with pytest.raises(ValueError):
+        TenantTable([Tenant("a", "t1"), Tenant("a", "t2")])
+    with pytest.raises(ValueError):
+        TenantTable([Tenant("a", "t1"), Tenant("b", "t1")])
+    with pytest.raises(ValueError):
+        TenantTable([Tenant("a", "t1", weight=0.0)])
+
+
+# -- fair-share admission ---------------------------------------------------
+def test_fair_share_throttles_hot_tenant_without_starving_cold(tmp_path):
+    # max_inflight=4, weights 2:1 -> alice holds 2 slots, bob 1
+    gw, fleet = _gw(tmp_path, max_inflight=4)
+    fleet.auto = False
+    futs = [gw.submit(SimRequest(spec=SPEC, n=4, seed=s),
+                      token="tok-alice") for s in (1, 2)]
+    with pytest.raises(GatewayBusy) as ei:
+        gw.submit(SimRequest(spec=SPEC, n=4, seed=3), token="tok-alice")
+    assert ei.value.tenant == "alice"
+    assert ei.value.retry_after_s >= tune_defaults.GATEWAY_RETRY_MIN_S
+    # alice's backlog does not occupy bob's slot
+    futs.append(gw.submit(SimRequest(spec=SPEC, n=4, seed=4),
+                          token="tok-bob"))
+    with pytest.raises(GatewayBusy) as ei:
+        gw.submit(SimRequest(spec=SPEC, n=4, seed=5), token="tok-bob")
+    assert ei.value.tenant == "bob"
+    fleet.release_all()
+    for f in futs:
+        assert f.result(timeout=30).replica == "fake-0"
+    s = gw.gateway_summary()
+    assert s["throttles"] == 2 and s["inflight"] == 0
+    ts = gw.tenant_summary()
+    assert ts["alice"]["throttles"] == 1 and ts["bob"]["throttles"] == 1
+    assert ts["alice"]["share_slots"] == 2 and ts["bob"]["share_slots"] == 1
+    assert ts["alice"]["completed"] == 2 and "p99_ms" in ts["alice"]
+
+
+def test_fleet_busy_surfaces_as_this_tenants_429(tmp_path):
+    gw, fleet = _gw(tmp_path)
+    fleet.busy_exc = ServeBusy("fleet full", retry_after_s=0.7)
+    with pytest.raises(GatewayBusy) as ei:
+        gw.submit(SimRequest(spec=SPEC, n=4, seed=1), token="tok-bob")
+    assert ei.value.tenant == "bob"
+    assert ei.value.retry_after_s == pytest.approx(0.7)
+    s = gw.gateway_summary()
+    assert s["throttles"] == 1 and s["inflight"] == 0
+
+
+# -- single-flight + result store -------------------------------------------
+def test_single_flight_coalesces_then_store_serves_hits(tmp_path):
+    gw, fleet = _gw(tmp_path)
+    fleet.auto = False
+    req = SimRequest(spec=SPEC, n=4, seed=7)
+    lead = gw.submit(req, token="tok-alice")
+    follow = gw.submit(SimRequest(spec=SPEC, n=4, seed=7), token="tok-bob")
+    assert fleet.dispatches == 1          # identical keys share a flight
+    fleet.release_all()
+    assert lead.result(timeout=30) is follow.result(timeout=30)
+    s = gw.gateway_summary()
+    assert s["coalesced"] == 1 and s["dispatched"] == 1 and s["hits"] == 0
+    # the flight's response is now content-addressed: a repeat request is
+    # a store hit -- zero dispatches, producer's service_s credited
+    hit = gw.serve(req, token="tok-alice", timeout=30)
+    assert fleet.dispatches == 1
+    assert hit.replica == "gateway-cache"
+    assert np.array_equal(hit.curves, lead.result().curves)
+    assert np.array_equal(hit.autos, lead.result().autos)
+    s = gw.gateway_summary()
+    assert s["hits"] == 1 and s["device_s_saved"] == pytest.approx(0.25)
+    assert gw.tenant_summary()["alice"]["hits"] == 1
+
+
+def test_singleflight_table_is_bounded_with_bypass(tmp_path):
+    gw, fleet = _gw(tmp_path, singleflight_cap=1)
+    fleet.auto = False
+    f1 = gw.submit(SimRequest(spec=SPEC, n=4, seed=1), token="tok-alice")
+    f2 = gw.submit(SimRequest(spec=SPEC, n=4, seed=2), token="tok-alice")
+    assert fleet.dispatches == 2          # table full: dispatch, don't grow
+    assert gw.gateway_summary()["coalesce_bypass"] == 1
+    assert gw.gateway_summary()["flights_open"] == 1
+    fleet.release_all()
+    assert f1.result(timeout=30) is not f2.result(timeout=30)
+
+
+def test_corrupt_cached_payload_is_loud_miss_and_recompute(tmp_path):
+    gw, fleet = _gw(tmp_path)
+    req = SimRequest(spec=SPEC, n=4, seed=9)
+    first = gw.serve(req, token="tok-alice", timeout=30)
+    assert fleet.dispatches == 1
+    [payload] = list((tmp_path / "gw").glob("*.npz"))
+    payload.write_bytes(payload.read_bytes()[:-3] + b"xyz")
+    gw.store._mem.clear()                 # force the disk read path
+    flightrec.clear()
+    with pytest.warns(RuntimeWarning, match="torn gateway result"):
+        again = gw.serve(req, token="tok-alice", timeout=30)
+    assert fleet.dispatches == 2          # recomputed, not served stale
+    assert np.array_equal(again.curves, first.curves)
+    assert gw.gateway_summary()["cache_rejects"] >= 1
+    assert "gateway_store_corrupt_entry" in \
+        [e["name"] for e in flightrec.snapshot()]
+    # the recompute re-cached it: clean hit again, no third dispatch
+    assert gw.serve(req, token="tok-alice",
+                    timeout=30).replica == "gateway-cache"
+    assert fleet.dispatches == 2
+
+
+# -- ResultStore lifecycle (mirrors the tune store's contract) --------------
+def _put(store, spec_hash, fp, seed=3, n=8):
+    key = request_key(spec_hash, ("lane", spec_hash), seed, n, fp)
+    store.put(key, {"spec_hash": spec_hash, "fp": fp.hash,
+                    "service_s": 0.1, "bucket": n},
+              {"curves": np.full((n, 5), float(seed))})
+    return key
+
+
+def test_store_fingerprint_mismatch_is_loud_miss(tmp_path):
+    fp = fingerprint()
+    store = ResultStore(tmp_path / "s")
+    _put(store, "spec123", fp)
+    foreign = dataclasses.replace(fp, platform="tpu",
+                                  device_kind="TPU v5e")
+    flightrec.clear()
+    foreign_key = request_key("spec123", ("lane", "spec123"), 3, 8,
+                              foreign)
+    assert store.get(foreign_key, foreign, "spec123") is None
+    assert store.rejects == 1
+    assert "gateway_fingerprint_mismatch" in \
+        [e["name"] for e in flightrec.snapshot()]
+
+
+def test_store_schema_version_bump_is_ignored(tmp_path):
+    fp = fingerprint()
+    store = ResultStore(tmp_path / "s")
+    key = _put(store, "spec123", fp)
+    idx = tmp_path / "s" / tune_defaults.GATEWAY_INDEX_FILENAME
+    raw = json.loads(idx.read_text())
+    raw["entries"][key]["version"] = \
+        tune_defaults.GATEWAY_STORE_VERSION + 1
+    idx.write_text(json.dumps(raw))
+    fresh = ResultStore(tmp_path / "s")
+    flightrec.clear()
+    assert fresh.get(key, fp, "spec123") is None
+    assert "gateway_entry_schema_mismatch" in \
+        [e["name"] for e in flightrec.snapshot()]
+    # file-level bump: the whole index is ignored, loudly
+    raw["version"] = tune_defaults.GATEWAY_STORE_VERSION + 1
+    idx.write_text(json.dumps(raw))
+    with pytest.warns(RuntimeWarning, match="schema"):
+        assert len(ResultStore(tmp_path / "s")) == 0
+
+
+def test_store_index_corruption_empties_loudly(tmp_path):
+    fp = fingerprint()
+    store = ResultStore(tmp_path / "s")
+    _put(store, "spec123", fp)
+    (tmp_path / "s" / tune_defaults.GATEWAY_INDEX_FILENAME).write_text(
+        "not json {")
+    with pytest.warns(RuntimeWarning, match="corrupt gateway"):
+        assert len(ResultStore(tmp_path / "s")) == 0
+
+
+def test_store_and_decoded_cache_are_lru_bounded(tmp_path):
+    fp = fingerprint()
+    store = ResultStore(tmp_path / "s", cache_cap=2, store_cap=3)
+    keys = [_put(store, f"spec{i}", fp) for i in range(5)]
+    assert len(store) == 3 and len(store._mem) <= 2
+    for key in keys[:2]:                  # oldest evicted, payloads gone
+        assert store._payload_path(key).exists() is False
+        assert store.get(key, fp, key.split("/")[1]) is None
+    survivor = store.get(keys[-1], fp, "spec4")
+    assert survivor is not None
+    assert float(survivor[1]["curves"][0, 0]) == 3.0
+
+
+# -- observability surfaces -------------------------------------------------
+def test_promfmt_and_topview_render_gateway_sections(tmp_path):
+    gw, fleet = _gw(tmp_path)
+    req = SimRequest(spec=SPEC, n=4, seed=5)
+    gw.serve(req, token="tok-alice", timeout=30)
+    gw.serve(req, token="tok-bob", timeout=30)     # store hit
+    text = promfmt.render(gw.telemetry_rollup())
+    assert "fakepta_gateway_cache_hits_total 1" in text
+    assert 'fakepta_gateway_tenant_requests_total{tenant="alice"} 1' \
+        in text
+    assert 'fakepta_gateway_tenant_hit_rate{tenant="bob"} 1' in text
+    for name in ("fakepta_gateway_device_seconds_saved",
+                 "fakepta_gateway_cutovers_total",
+                 "fakepta_gateway_cache_rejects_total"):
+        assert name in promfmt.PROM_METRICS and name in text
+    table = topview.render_table(gw.telemetry_rollup())
+    assert "TENANT" in table and "alice" in table and "bob" in table
+    assert "gateway: requests=2" in table
+
+
+# -- gateway-managed cutover ------------------------------------------------
+STREAM_SPEC = ArraySpec(npsr=4, ntoa=40, tspan_years=3.0, n_red=3, n_dm=3,
+                        gwb_ncomp=3)
+
+
+def _append_req(seed, spec=None):
+    from fakepta_tpu import constants as const
+
+    tspan_s = 3.0 * const.yr
+    rng = np.random.default_rng(seed)
+    t = np.sort(rng.uniform(0.0, 0.9 * tspan_s, (4, 4)), axis=1)
+    return AppendRequest(stream="gw-cut", toas=t,
+                         residuals=rng.normal(0.0, 1e-7, (4, 4)),
+                         spec=spec)
+
+
+def test_gateway_cutover_conserves_toas_under_concurrent_appends(tmp_path):
+    """The fence protocol end-to-end: appends racing a cutover either land
+    on the old state (and are replayed) or queue behind the fence — the
+    stream's TOA count afterwards accounts for every accepted block."""
+    pool = ServePool(mesh=make_mesh(jax.devices()[:1]))
+    gw = Gateway(pool, [Tenant("alice", "tok-a")],
+                 store=ResultStore(tmp_path / "gw"))
+    try:
+        r1 = gw.serve(_append_req(9, spec=STREAM_SPEC), token="tok-a",
+                      timeout=300)
+        assert r1["kind"] == "append" and r1["n_toas"] == 16
+        n_blocks = [1]
+        errs = []
+
+        def racer():
+            try:
+                for seed in (20, 21, 22):
+                    gw.serve(_append_req(seed), token="tok-a",
+                             timeout=300)
+                    n_blocks[0] += 1
+            except Exception as exc:      # noqa: BLE001 — surfaced below
+                errs.append(exc)
+
+        th = threading.Thread(target=racer)
+        th.start()
+        wider = dataclasses.replace(STREAM_SPEC, tspan_years=6.0)
+        info = gw.cutover("gw-cut", wider)
+        th.join(timeout=300)
+        assert not errs, errs
+        assert info["stream"] == "gw-cut" and info["managed_ms"] > 0
+        assert info["new_tspan_s"] > info["old_tspan_s"]
+        stats = gw.serve(StreamRequest(stream="gw-cut"), token="tok-a",
+                         timeout=300)
+        assert stats["n_toas"] == 16 * n_blocks[0]   # zero dropped
+        # post-swap appends land on the NEW template
+        post = gw.serve(_append_req(30), token="tok-a", timeout=300)
+        assert post["n_toas"] == stats["n_toas"] + 16
+        assert gw.gateway_summary()["cutovers"] == 1
+        # a bare-ServePool gateway must still render metrics (the pool's
+        # single-replica rollup + the gateway/tenant sections)
+        text = gw.metrics_text()
+        assert "fakepta_gateway_cutovers_total 1" in text
+        assert 'fakepta_gateway_tenant_requests_total{tenant="alice"}' in text
+    finally:
+        gw.close()
+
+
+def test_cutover_of_unopened_stream_is_an_error(tmp_path):
+    gw, _fleet = _gw(tmp_path)
+    from fakepta_tpu.serve import ServeError
+
+    with pytest.raises(ServeError):
+        gw.cutover("nope", STREAM_SPEC)
+    assert gw.gateway_summary()["cutovers"] == 0

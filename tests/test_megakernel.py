@@ -168,6 +168,9 @@ def test_mega_with_det_and_sampling(batch):
 
 # -------------------------------------------------------- mesh invariance
 
+@pytest.mark.slow   # ~18 s: tier-1 budget reclaim (ISSUE 18) — mega↔f64
+# parity stays tier-1 via test_mega_f64_oracle, and engine mesh
+# invariance stays via the unmarked test_toa_sharding lanes
 def test_mega_mesh_invariance(batch, mega_sim):
     """Global-pulsar-index key folding + the kernel's per-shard recompute:
     1x1x1, 2x2x2 and the extreme one-pulsar-per-shard mesh draw identical
@@ -320,6 +323,9 @@ def test_precision_validation_and_other_paths(batch, mega_sim):
 
 # ---------------------------------------- pipeline / checkpoint compat
 
+@pytest.mark.slow   # ~16 s: tier-1 budget reclaim (ISSUE 18) — depth
+# bit-identity stays tier-1 via test_pipeline's pipelined≡serial lane
+# and test_sample's mesh/pipeline-depth bit-identity
 def test_mega_pipeline_depths_bit_identical(batch, mega_sim):
     """PR-5 compatibility: the megakernel step donates/recycles the packed
     scratch like every other step — serial (depth 0) and pipelined

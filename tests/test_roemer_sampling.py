@@ -63,6 +63,10 @@ def test_sampled_roemer_variance_matches_linear_response():
     np.testing.assert_allclose(got, want, rtol=0.15)
 
 
+@pytest.mark.slow   # ~12 s: tier-1 budget reclaim (ISSUE 18) — the
+# sampled-roemer path stays tier-1 via
+# test_sampled_roemer_fused_path_matches_xla; realization-key mesh
+# invariance stays via the unmarked test_toa_sharding lanes
 def test_sampled_roemer_mesh_shape_independent():
     """The nuisance draw folds only the realization key, so any mesh produces
     the same realizations (f32 reduction tolerance)."""

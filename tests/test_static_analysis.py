@@ -67,6 +67,10 @@ CASES = [
      {("unbounded-queue", 7), ("unbounded-queue", 8),
       ("unbounded-queue", 9), ("unbounded-queue", 10),
       ("unbounded-queue", 11), ("unbounded-queue", 12)}),
+    ("unbounded_cache.py", LIB,
+     {("unbounded-cache", 7), ("unbounded-cache", 12),
+      ("unbounded-cache", 19), ("unbounded-cache", 20),
+      ("unbounded-cache", 21)}),
     ("swallowed_exception.py", LIB,
      {("swallowed-exception", 9), ("swallowed-exception", 16),
       ("swallowed-exception", 23), ("swallowed-exception", 30)}),

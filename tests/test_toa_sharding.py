@@ -62,6 +62,9 @@ def test_toa_sharded_streams_match_unsharded(batch):
         np.testing.assert_allclose(got["autos"], ref["autos"], rtol=5e-5)
 
 
+@pytest.mark.slow   # ~13 s: tier-1 budget reclaim (ISSUE 18) — each axis
+# stays individually pinned tier-1 (toa via the ecorr-straddling lane
+# here, psr/real via the engine suites); only the 2x2x2 composition moves
 def test_toa_and_psr_sharding_compose(batch):
     """A (real=2, psr=2, toa=2) mesh — all three axes active — reproduces the
     single-device realizations."""
