@@ -212,11 +212,28 @@ RunReport each ``sim.run()`` attaches):
 - ``fallback``: present when the accelerator was unreachable (CPU stand-in).
   ``benchmarks/suite.py`` rows carry the same ``platform``/``fallback``
   pair, so CPU stand-in rounds are distinguishable across the whole
-  trajectory.
+  trajectory;
+- ``scenario`` / ``scn_real_per_s_per_chip`` / ``scn_ess_per_s_per_chip``
+  / ``scn_peak_hbm_bytes`` / ``scn_append_p99_ms``: the scenario
+  golden-run lane (``fakepta_tpu.scenarios``, docs/SCENARIOS.md; emitted
+  by ``python -m fakepta_tpu.scenarios run`` and ``benchmarks/suite.py``
+  config 17). ``scenario`` is the registered scenario name — row-identity
+  like ``platform``, never banded, and ``obs gate`` groups history by it
+  so an ``ng15`` golden row only bands against ``ng15`` history.
+  ``scn_real_per_s_per_chip`` and ``scn_ess_per_s_per_chip`` (both
+  higher-better via the ``_per_s_per_chip`` suffix) are the scenario
+  ensemble's steady simulation throughput and the sampler lane's ESS
+  rate on the scenario's array; ``scn_peak_hbm_bytes`` (lower-better) the
+  scenario run's HBM watermark, and ``scn_append_p99_ms`` (lower-better)
+  the p99 append latency under the scenario's telescope-cadence
+  ``AppendRequest`` schedule (zero-recompile contract enforced, same as
+  the main stream lane).
 
 A new row is gated against this history with ``python -m fakepta_tpu.obs
-gate row.json`` — MAD noise bands over same-``platform`` rows, so the CPU
-stand-in rounds never band an accelerator round (docs/OBSERVABILITY.md).
+gate row.json`` — MAD noise bands over same-``platform`` (and, for
+scenario golden rows, same-``scenario``) rows, so the CPU stand-in rounds
+never band an accelerator round and no scenario bands another
+(docs/OBSERVABILITY.md).
 
 Backend selection: the dead-tunnel probe verdict is cached to a temp file
 scoped to this process tree, and ``FAKEPTA_TPU_BENCH_BACKEND=cpu`` (or any
@@ -252,10 +269,12 @@ def main():
     from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+    from fakepta_tpu.scenarios import registry as scn_registry
 
     n_devices = len(jax.devices())
-    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    # registry-sourced flagship (bit-identical to the historical literal;
+    # the unregistered-scenario rule keeps ad-hoc copies out)
+    batch = scn_registry.flagship_batch()
     tspan = float(batch.tspan_common)
     f = np.arange(1, 31) / tspan
     psd = np.asarray(spectrum_lib.powerlaw(f, log10_A=np.log10(2e-15), gamma=13 / 3))
@@ -432,8 +451,7 @@ def main():
     # reduced one — rows disambiguate by `platform`, as everywhere.
     from fakepta_tpu.serve import ArraySpec, ServeConfig, run_loadgen
     if platform != "cpu":
-        serve_spec = ArraySpec(npsr=100, ntoa=780, n_red=30, n_dm=100,
-                               gwb_ncomp=30)
+        serve_spec = scn_registry.get("flagship_100").serve_spec()
         serve_requests, serve_sizes = 128, (8, 16, 32, 64)
         serve_buckets = tuple(b for b in (64, 128, 256, 512)
                               if b % n_devices == 0)

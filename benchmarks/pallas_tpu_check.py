@@ -123,8 +123,8 @@ def main():
     if not ok:
         print(json.dumps({"check": "flagship", "skipped": "parity failed"}))
         sys.exit(1)
-    flag = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
-                                 toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    from fakepta_tpu.scenarios.registry import flagship_batch
+    flag = flagship_batch()
     cfg = gwb(flag, ncomp=30, log10_A=np.log10(2e-15))
     nreal, chunk = 10_000, 10_000
     results = {}

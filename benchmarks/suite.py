@@ -5,7 +5,15 @@ with a per-realization sampled CW source, 10: the 256-pulsar scale-out,
 11: the flagship with per-realization white-noise sampling, 12: the chaos
 lane, 13: the multi-replica serve fleet A/B with mid-load replica kill,
 14: the streaming-ingestion A/B — single-epoch incremental append vs full
-restage, docs/STREAMING.md).
+restage, docs/STREAMING.md, 15: the elastic chaos lane, 16: the multi-tenant
+gateway lane, 17: the scenario golden smoke — the ``fakepta_tpu.scenarios``
+golden-run harness as a first-class config).
+
+``--scenario NAME`` points the chaos lanes (12, 15) and the golden smoke
+(17) at a registered scenario from ``fakepta_tpu.scenarios`` instead of
+their ad-hoc arrays; their rows then carry a ``scenario`` column (part of
+the ``obs`` row identity — ``obs gate`` only bands same-scenario
+same-platform rows, docs/SCENARIOS.md).
 
 Prints one JSON line per config. The reference publishes no numbers
 (SURVEY.md §6), so these are the framework's own measured results; run with
@@ -66,6 +74,26 @@ def _flagship_toas_abs(batch):
 # quantity with more timer noise. Rows carry the scale so BASELINE.md entries
 # are self-describing.
 _NREAL_SCALE = 1.0
+
+# --scenario NAME: the chaos lanes (12, 15) and the golden smoke (17) run
+# against this registered scenario (fakepta_tpu.scenarios) instead of their
+# ad-hoc arrays; None keeps the historical configs byte-for-byte
+_SCENARIO = None
+
+
+def _scenario():
+    """The ``--scenario`` selection, reduced to the platform's scale
+    (CPU stand-ins run the deterministic ``Scenario.reduced()`` variant —
+    same spec family, unit-test sizes), or None when unset."""
+    if _SCENARIO is None:
+        return None
+    import jax
+
+    from fakepta_tpu.scenarios import registry as scn_registry
+    scn = scn_registry.get(_SCENARIO)
+    if jax.devices()[0].platform == "cpu":
+        scn = scn.reduced()
+    return scn
 
 
 def _scaled(nreal, chunk):
@@ -282,14 +310,13 @@ def config8():
     config 5's fixed-PSD program."""
     import jax
 
-    from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
                                                  NoiseSampling)
+    from fakepta_tpu.scenarios.registry import flagship_batch
 
     n_dev = len(jax.devices())
-    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    batch = flagship_batch()
     psd = _hd_psd(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
@@ -343,13 +370,17 @@ def config10():
     testable. Reports the compiled chunk program's memory reservation."""
     import jax
 
-    from fakepta_tpu.batch import PulsarBatch
+    import dataclasses
+
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+    from fakepta_tpu.scenarios import registry as scn_registry
 
     n_dev = len(jax.devices())
-    batch = PulsarBatch.synthetic(npsr=256, ntoa=780, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    # flagship spec scaled out to 256 psr — a derived variant, so the
+    # batch stays pinned to the registered scenario's construction path
+    scn256 = dataclasses.replace(scn_registry.get("flagship_100"), npsr=256)
+    batch = scn256.batch_parts()[0]
     psd = _hd_psd(batch)
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()))
@@ -374,14 +405,13 @@ def config11():
     the white-sampling overhead against config 5's fixed-sigma2 program."""
     import jax
 
-    from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import (EnsembleSimulator, GWBConfig,
                                                  WhiteSampling)
+    from fakepta_tpu.scenarios.registry import flagship_batch
 
     n_dev = len(jax.devices())
-    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    batch = flagship_batch()
     psd = _hd_psd(batch)
     sim = EnsembleSimulator(
         batch, gwb=GWBConfig(psd=psd, orf="hd"), mesh=make_mesh(jax.devices()),
@@ -404,7 +434,10 @@ def config12():
     run is timed clean and under a seeded FaultPlan injecting ONE transient
     dispatch fault per run (retried with zero backoff, so the figure is the
     pure re-dispatch cost, not sleep time); the recovered stream is
-    asserted bit-identical to the clean run before the number ships."""
+    asserted bit-identical to the clean run before the number ships.
+    Under ``--scenario`` the ensemble is the registered scenario's own
+    simulator (full noise menu, its GWB ORF) instead of the ad-hoc array —
+    the same recovery contract, re-proven per scenario."""
     import jax
 
     from fakepta_tpu import faults
@@ -412,12 +445,17 @@ def config12():
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
 
-    batch = PulsarBatch.synthetic(npsr=20, ntoa=260, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=10, n_dm=10, seed=0)
-    sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=_hd_psd(batch, 10),
-                                                 orf="hd"),
-                            mesh=make_mesh(jax.devices()))
-    nreal, chunk = _scaled(2048, 256)
+    scn = _scenario()
+    if scn is not None:
+        sim = scn.build(mesh=make_mesh(jax.devices()))
+        nreal, chunk = _scaled(512, 64)
+    else:
+        batch = PulsarBatch.synthetic(npsr=20, ntoa=260, tspan_years=15.0,
+                                      toaerr=1e-7, n_red=10, n_dm=10, seed=0)
+        sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=_hd_psd(batch, 10),
+                                                     orf="hd"),
+                                mesh=make_mesh(jax.devices()))
+        nreal, chunk = _scaled(2048, 256)
     policy = faults.RecoveryPolicy(backoff_s=0.0)
 
     def clean():
@@ -533,7 +571,9 @@ def config15():
     row ships; ``fleet_lost_requests``/``fleet_timeouts`` must be 0. The
     headline ``value`` is ``fleet_p99_ms`` UNDER the chaos — the latency
     a client actually sees while the fleet loses, wedges and grows
-    replicas."""
+    replicas. Under ``--scenario`` the fleet serves the registered
+    scenario's spec (``Scenario.serve_spec()``) instead of the ad-hoc
+    array — same lifecycle contract, re-proven per scenario."""
     import os
     import tempfile
 
@@ -542,7 +582,12 @@ def config15():
     from fakepta_tpu.serve import ArraySpec, run_elastic_loadgen
     from fakepta_tpu.serve.loadgen import measure_telemetry_overhead
 
-    if jax.devices()[0].platform != "cpu":
+    scn = _scenario()
+    if scn is not None:
+        spec = scn.serve_spec()
+        n_requests, transport = (96, "process") \
+            if jax.devices()[0].platform != "cpu" else (48, "inproc")
+    elif jax.devices()[0].platform != "cpu":
         spec = ArraySpec(npsr=40, ntoa=260, n_red=10, n_dm=10,
                          gwb_ncomp=10)
         n_requests, transport = 96, "process"
@@ -638,17 +683,34 @@ def config16():
             "value": row["gw_hit_rate"], "unit": "fraction", **row}
 
 
+def config17():
+    """Scenario golden smoke (fakepta_tpu.scenarios, docs/SCENARIOS.md):
+    the golden-run harness as a first-class suite config. Runs the
+    ``--scenario`` selection (default ``ng15``) at smoke sizes and ships
+    its full bench-schema row — the same row ``python -m
+    fakepta_tpu.scenarios run`` emits, carrying ``scenario`` alongside
+    ``platform`` so ``obs gate`` bands it on its own trajectory. The
+    harness refuses the row itself on an append≡restage oracle divergence
+    or a nonzero ``stream_recompiles``."""
+    from fakepta_tpu.scenarios import golden
+
+    name = _SCENARIO or "ng15"
+    row = golden.golden_run(name, nreal=32, chunk=16, sample_steps=48,
+                            sample_warmup=24, serve_requests=16,
+                            max_append_blocks=8)
+    return {"config": 17, **row}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
 
-    from fakepta_tpu.batch import PulsarBatch
     from fakepta_tpu.parallel.mesh import make_mesh
     from fakepta_tpu.parallel.montecarlo import EnsembleSimulator, GWBConfig
+    from fakepta_tpu.scenarios.registry import flagship_batch
 
     n_dev = len(jax.devices())
-    batch = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
-                                  toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    batch = flagship_batch()
     psd = _hd_psd(batch)
     sim = EnsembleSimulator(batch, gwb=GWBConfig(psd=psd, orf="hd"),
                             mesh=make_mesh(jax.devices()))
@@ -767,10 +829,10 @@ def config5():
     # request throughput, latency SLOs, coalescing stats and the speedup
     # over serial per-request run() dispatch (bench.py docstring schema;
     # responses bit-verified against solo runs inside the generator)
+    from fakepta_tpu.scenarios import registry as scn_registry
     from fakepta_tpu.serve import ArraySpec, ServeConfig, run_loadgen
     if jax.devices()[0].platform != "cpu":
-        serve_spec = ArraySpec(npsr=100, ntoa=780, n_red=30, n_dm=100,
-                               gwb_ncomp=30)
+        serve_spec = scn_registry.get("flagship_100").serve_spec()
         serve_requests, serve_sizes = 128, (8, 16, 32, 64)
         serve_buckets = (64, 128, 256, 512)
     else:
@@ -839,15 +901,24 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
                     default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
-                             14, 15, 16])
+                             14, 15, 16, 17])
     ap.add_argument("--platform", default=None)
+    ap.add_argument("--scenario", default=None,
+                    help="registered scenario name (fakepta_tpu.scenarios):"
+                         " the chaos lanes (12, 15) rebuild their arrays "
+                         "from it and the golden smoke (17) runs it; rows "
+                         "carry a `scenario` column obs gate bands by")
     ap.add_argument("--update-baseline", action="store_true")
     ap.add_argument("--nreal-scale", type=float, default=1.0,
                     help="scale every ensemble config's realization count "
                          "(CPU stand-in runs use 0.1); rows are tagged")
     args = ap.parse_args()
-    global _NREAL_SCALE
+    global _NREAL_SCALE, _SCENARIO
     _NREAL_SCALE = args.nreal_scale
+    if args.scenario:
+        from fakepta_tpu.scenarios import registry as scn_registry
+        scn_registry.get(args.scenario)  # fail fast on a typo'd name
+        _SCENARIO = args.scenario
     if args.platform:
         import jax
         jax.config.update("jax_platforms", args.platform)
@@ -867,7 +938,7 @@ def main():
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16}
+           15: config15, 16: config16, 17: config17}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
     # platform identity single-sourced through the tuner's fingerprint
@@ -879,6 +950,10 @@ def main():
     for c in args.configs:
         row = fns[c]()
         row["platform"] = platform
+        if _SCENARIO and c in (12, 15, 17):
+            # scenario-parameterized lanes: the row's obs identity includes
+            # the scenario name (gate bands same-scenario same-platform)
+            row.setdefault("scenario", _SCENARIO)
         if fallback:
             row["fallback"] = "accelerator backend unavailable; CPU stand-in"
         if _NREAL_SCALE != 1.0 and c in ensemble_configs:

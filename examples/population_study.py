@@ -10,6 +10,16 @@ ensemble) for every realization inside the compiled device program.
     python examples/population_study.py                    # defaults
     python examples/population_study.py --platform cpu     # no TPU needed
     python examples/population_study.py --cgw              # add a sampled CW
+    python examples/population_study.py --scenario ng15    # registry-driven
+
+``--scenario NAME`` sources the array AND the priors from a registered
+``fakepta_tpu.scenarios`` entry (docs/SCENARIOS.md) instead of the ad-hoc
+flags: the batch comes from ``Scenario.batch_parts()`` (telescope-cadence
+TOAs for the survey scenarios, reduced to unit-test scale on CPU), the
+red prior from its ``red_draws`` menu when declared, the GWB amplitude
+prior brackets its injected ``gwb_log10_A``, and a CW source is sampled
+when the scenario declares a CGW population. The printed row then carries
+``scenario`` + ``spec_hash`` provenance.
 
 Prints one JSON line: the empirically-calibrated (null-ensemble) detection
 statistics under full prior marginalization. The optimal statistic runs on
@@ -50,6 +60,11 @@ def main():
                     help="red-noise prior family; 'turnover' additionally "
                          "marginalizes the bend frequency lf0 ~ U(-8.8, -8)")
     ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--scenario", default=None,
+                    help="registered fakepta_tpu.scenarios entry: build the "
+                         "array and priors from it (reduced to unit-test "
+                         "scale on CPU); overrides --npsr/--ntoa and the "
+                         "prior flags it declares")
     ap.add_argument("--platform", default=None)
     ap.add_argument("--legacy-host-os", action="store_true",
                     help="A/B path: fetch the full (R, P, P) correlation "
@@ -75,10 +90,28 @@ def main():
                                                  EnsembleSimulator, GWBConfig,
                                                  NoiseSampling, WhiteSampling)
 
-    batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
-                                  tspan_years=15.0, toaerr=1e-7,
-                                  n_red=30, n_dm=30, seed=0)
-    f = np.arange(1, 31) / float(batch.tspan_common)
+    scn = scn_toas_abs = None
+    if args.scenario:
+        from fakepta_tpu.scenarios import registry as scn_registry
+        scn = scn_registry.get(args.scenario)
+        if jax.devices()[0].platform == "cpu":
+            scn = scn.reduced()
+        batch, scn_toas_abs, _, _ = scn.batch_parts()
+        args.npsr, args.ntoa = batch.t_own.shape
+        # prior menu from the spec: amplitude prior brackets the injected
+        # background; the red prior is the scenario's declared draw ranges
+        args.gwb_log10_A = (scn.gwb_log10_A - 0.2, scn.gwb_log10_A + 0.2)
+        if scn.red_draws is not None:
+            args.red_log10_A, args.red_gamma = scn.red_draws
+        if scn.cgw_population:
+            args.cgw = True
+        ncomp = scn.gwb_ncomp
+    else:
+        batch = PulsarBatch.synthetic(npsr=args.npsr, ntoa=args.ntoa,
+                                      tspan_years=15.0, toaerr=1e-7,
+                                      n_red=30, n_dm=30, seed=0)
+        ncomp = 30
+    f = np.arange(1, ncomp + 1) / float(batch.tspan_common)
     # the GWBConfig PSD sets the frequency grid; its values are replaced by
     # the per-realization amplitude draws
     psd = np.asarray(spectrum_lib.powerlaw(
@@ -102,9 +135,13 @@ def main():
                                                 log10_tnequad=(-8.0, -5.0)),
                      toaerr2=np.asarray(batch.sigma2))
     if args.cgw:
-        toas_abs = np.tile(
-            53000.0 * 86400.0 + np.linspace(0.0, 15 * const.yr, args.ntoa),
-            (args.npsr, 1))
+        if scn_toas_abs is not None:
+            toas_abs = np.asarray(scn_toas_abs)  # the scenario's epochs
+        else:
+            toas_abs = np.tile(
+                53000.0 * 86400.0 + np.linspace(0.0, 15 * const.yr,
+                                                args.ntoa),
+                (args.npsr, 1))
         extra.update(cgw_sample=CGWSampling(tref=float(toas_abs[0].mean())),
                      toas_abs=toas_abs)
 
@@ -141,6 +178,8 @@ def main():
     thresh = float(np.percentile(null_os, 95.0))
     print(json.dumps({
         "npsr": args.npsr, "nreal": args.nreal,
+        **({"scenario": scn.name, "spec_hash": scn.spec_hash()}
+           if scn is not None else {}),
         "gwb_log10_A_prior": list(args.gwb_log10_A),
         # the record a consumer would rebuild the prior from: the actual
         # sampled parameter ranges, not just the CLI echoes

@@ -239,6 +239,22 @@ DISPATCH_KNOB_MODULES = (
     "fakepta_tpu/tune/defaults.py",
 )
 
+# the only modules where flagship-scale ArraySpec / PulsarBatch.synthetic
+# literals may live (the unregistered-scenario rule, docs/SCENARIOS.md):
+# the scenario registry is the single source of named array-scale
+# configurations, and tune/defaults.py's probe shapes are dispatch-tuning
+# inputs, not dataset definitions. Everything else — INCLUDING bench.py
+# and benchmarks/, where shadow flagships historically accreted —
+# resolves scenarios by name through fakepta_tpu.scenarios.registry.
+SCENARIO_SPEC_MODULES = (
+    "fakepta_tpu/scenarios/registry.py",
+    "fakepta_tpu/tune/defaults.py",
+)
+
+# the npsr floor separating "a unit-test fixture" from "a dataset claim":
+# at or above this population size an ad-hoc literal is a shadow scenario
+SCENARIO_NPSR_FLOOR = 64
+
 # ---------------------------------------------------------------------------
 # whole-program concurrency policy (analysis/concurrency.py)
 # ---------------------------------------------------------------------------

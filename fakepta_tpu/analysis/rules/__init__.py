@@ -10,12 +10,13 @@ in ``PROJECT_RULES``.
 
 from . import (caches, collectives, donation, dtype, excepts, hostsync,
                joins, knobs, meshaxis, metric_names, precision, queues, rng,
-               socketio, timing, tracer)
+               scenarios, socketio, timing, tracer)
 
 ALL_RULES = tuple((mod.RULE_ID, mod.check)
                   for mod in (rng, hostsync, tracer, dtype, meshaxis,
                               donation, precision, timing, queues, caches,
-                              excepts, knobs, socketio, joins, metric_names))
+                              excepts, knobs, socketio, joins, metric_names,
+                              scenarios))
 
 RULE_IDS = tuple(rid for rid, _ in ALL_RULES)
 

@@ -73,7 +73,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     ga = sub.add_parser(
         "gate", help="band a new bench row against the BENCH_r*.json "
-                     "history (MAD noise bands over same-platform rows)")
+                     "history (MAD noise bands over same-platform, "
+                     "same-scenario rows)")
     ga.add_argument("row", help="the new row: a bench.py JSON line file, a "
                                 "driver-wrapped BENCH record, or a "
                                 "RunReport .jsonl (its summary is gated)")
@@ -306,16 +307,21 @@ def _cmd_gate(args) -> int:
     history = gate_mod.load_history(
         hist_paths, warn=lambda m: print(f"warning: {m}", file=sys.stderr))
     platform = new_row.get("platform")
-    n_same = len([r for r in history if r.get("platform") == platform])
+    scenario = new_row.get("scenario")
+    n_same = len([r for r in history if r.get("platform") == platform
+                  and r.get("scenario") == scenario])
     if n_same == 0:
-        # an empty same-platform history cannot band anything: say so
-        # plainly and exit 0 — the first accelerator round after CPU
-        # stand-in rows (or a fresh clone with no BENCH_r*.json at all)
-        # is the start of a trajectory, not a regression
-        print(f"no comparable history: 0 same-platform "
-              f"(platform={platform!r}) rows among {len(history)} loaded "
-              f"history row(s); nothing to gate — this row starts the "
-              f"{platform!r} trajectory")
+        # an empty same-platform (and, for golden rows, same-scenario)
+        # history cannot band anything: say so plainly and exit 0 — the
+        # first accelerator round after CPU stand-in rows (or the first
+        # golden run of a new scenario) is the start of a trajectory,
+        # not a regression
+        what = (f"platform={platform!r}"
+                + (f", scenario={scenario!r}" if scenario else ""))
+        kind = "same-platform" + (", same-scenario" if scenario else "")
+        print(f"no comparable history: 0 {kind} ({what}) rows among "
+              f"{len(history)} loaded history row(s); nothing to gate — "
+              f"this row starts that trajectory")
         return 0
     results = gate_mod.gate_row(new_row, history, k=args.k,
                                 rel_floor=args.rel_floor,

@@ -8,9 +8,12 @@ round (r02), so any naive diff against "the previous row" either
 cries wolf or is silenced entirely. The gate replaces that with a
 statistical check:
 
-- history rows are grouped by ``platform`` and only **same-platform** rows
-  band a new row — a CPU stand-in round can never gate an accelerator
-  round (or vice versa);
+- history rows are grouped by ``platform`` — and, for scenario golden
+  rows, by ``scenario`` — so only **same-platform, same-scenario** rows
+  band a new row: a CPU stand-in round can never gate an accelerator
+  round, and an ``ng15`` golden row can never band an ``ipta_dr3`` one
+  (main-trajectory bench rows carry no ``scenario`` key and keep banding
+  against each other exactly as before);
 - each metric's noise band is ``k * max(MAD, rel_floor * |median|)`` around
   the per-platform median (MAD — median absolute deviation — is robust to
   the occasional outlier round; the relative floor keeps a zero-MAD
@@ -50,7 +53,7 @@ DEFAULT_HISTORY_GLOB = "BENCH_r*.json"
 
 # bench-row bookkeeping fields that are not metrics at all
 _NON_METRIC_KEYS = {"metric", "unit", "platform", "fallback", "nreal_scale",
-                    "n", "cmd", "rc", "tail"}
+                    "n", "cmd", "rc", "tail", "scenario"}
 
 
 def parse_row(text: str) -> Optional[dict]:
@@ -156,10 +159,19 @@ def _numeric(v) -> Optional[float]:
 def gate_row(new_row: dict, history: Sequence[dict], k: float = 3.0,
              rel_floor: float = 0.05,
              min_history: int = 2) -> List[GateResult]:
-    """Band every gateable metric of ``new_row`` against same-platform
-    history; see the module docstring for the banding rule."""
+    """Band every gateable metric of ``new_row`` against same-platform,
+    same-scenario history; see the module docstring for the banding rule.
+
+    ``scenario`` is part of the grouping identity exactly like
+    ``platform``: a row without one (every main-trajectory bench row)
+    only sees history rows without one, and a golden-run row only sees
+    its own scenario's trajectory — reduced ``ska_10k`` figures can never
+    band ``flagship_100`` figures even on the same machine.
+    """
     platform = new_row.get("platform")
-    same = [r for r in history if r.get("platform") == platform]
+    scenario = new_row.get("scenario")
+    same = [r for r in history if r.get("platform") == platform
+            and r.get("scenario") == scenario]
     results: List[GateResult] = []
     for key in sorted(new_row):
         if key in _NON_METRIC_KEYS:

@@ -166,6 +166,11 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # append_latency_ms / stream_recompiles, lower-better
                   # defaults)
                   "stream_appends", "stream_toas", "stream_rebuckets",
+                  # scenario golden stream lane: expected first-sighting
+                  # bucket-rung compiles — a deterministic function of
+                  # the cadence's block-size mix, not a health signal
+                  # (the zero-expected canary stays stream_recompiles)
+                  "stream_compiles",
                   # telemetry-plane shape facts (docs/OBSERVABILITY.md):
                   # scrape volume rides the heartbeat cadence and trace
                   # flow counts describe the traffic, not its health (the
@@ -194,7 +199,12 @@ EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
 # strings/flags that label a row rather than measure it — `compare` skips
 # non-numerics anyway; this table exists so the direction contract below
 # is total
-ROW_IDENTITY = {"metric", "unit", "platform", "fallback"}
+ROW_IDENTITY = {"metric", "unit", "platform", "fallback",
+                # scenario golden rows (fakepta_tpu.scenarios): the
+                # registered scenario name is grouping identity exactly
+                # like platform — `obs gate` bands a golden row only
+                # against same-scenario, same-platform history
+                "scenario"}
 
 # exact names where smaller is better. Functionally this is the DEFAULT
 # direction — metric_higher_is_better() returns False for any name not in
@@ -230,7 +240,15 @@ LOWER_IS_BETTER = {"compile_s", "retraces", "cost_bytes_per_chunk",
                    # scrapes, fired alert rules, and the scrape-on vs
                    # scrape-off qps cost are all degradations
                    "fleet_scrape_errors", "fleet_alerts",
-                   "telemetry_overhead_frac"}
+                   "telemetry_overhead_frac",
+                   # scenario golden-run lane (fakepta_tpu.scenarios,
+                   # docs/SCENARIOS.md): the scenario's ensemble HBM
+                   # watermark and the cadence-driven append tail are
+                   # degradations when they grow (the higher-better
+                   # golden metrics — scn_ess_per_s_per_chip,
+                   # scn_real_per_s_per_chip — ride the
+                   # _per_s_per_chip suffix rule)
+                   "scn_peak_hbm_bytes", "scn_append_p99_ms"}
 
 
 def metric_higher_is_better(k: str) -> bool:

@@ -1,0 +1,19 @@
+"""IPTA-scale scenario registry + golden-run suite (docs/SCENARIOS.md).
+
+- :mod:`.registry` — declarative, hashable :class:`Scenario` specs with
+  named entries (``flagship_100``, ``ng15``, ``ipta_dr3``, ``ska_10k``),
+  each materializing through the ordinary ``EnsembleSimulator`` /
+  ``ArraySpec`` path.
+- :mod:`.cadence` — telescope-cadence arrival processes (duty cycles,
+  maintenance gaps, uneven multi-backend sampling) generating realistic
+  TOA epochs and timed ``AppendRequest`` schedules.
+- :mod:`.golden` — the golden-run harness: every scenario emits a full
+  bench-schema row (``scenario`` + ``scn_*`` keys, bench.py docstring)
+  banded by ``obs gate``, plus the psr-sharded memory-scaling lane.
+
+CLI: ``python -m fakepta_tpu.scenarios list|describe|run``.
+"""
+
+from .registry import SCENARIOS, Scenario, get, names, register
+
+__all__ = ["SCENARIOS", "Scenario", "get", "names", "register"]

@@ -1,0 +1,331 @@
+"""Golden-run harness: one bench-schema row per registered scenario.
+
+``golden_run(name)`` exercises a scenario through the repo's production
+lanes — ensemble simulation (steady real/s/chip, ``peak_hbm_bytes``,
+recovery counters), the batched-MCMC sampler (ESS/s/chip), the serving
+scheduler (SLO latencies), and the telescope-cadence streaming tail
+(append latencies, append≡restage oracle, zero-recompile contract) — and
+emits ONE flat JSON row in the bench.py schema: the standard metric keys
+every lane already declares directions for, plus the scenario headline
+keys (``scenario``, ``scn_real_per_s_per_chip``, ``scn_ess_per_s_per_chip``,
+``scn_peak_hbm_bytes``, ``scn_append_p99_ms`` — bench.py docstring).
+``obs summarize|compare|gate`` consume the row without special-casing;
+the gate bands it only against same-scenario, same-platform history
+(:mod:`fakepta_tpu.obs.gate`).
+
+``memory_lane()`` is the scaling check: sweep n_psr at fixed chunk under
+``psr`` sharding and assert the memwatch watermark tracks the analytic
+``chunk_bytes_model`` within :data:`MEM_BOUND_FACTOR` up to the
+``ska_10k`` point (the donated-buffer depth bound is asserted in-run by
+the engine's ``PackedLedger`` — a violated ring raises, it never
+reports). docs/SCENARIOS.md states the full contract.
+
+Sizes: the CPU stand-in runs each scenario's :meth:`Scenario.reduced`
+rendition (rows disambiguate by ``platform``, as everywhere); an
+accelerator runs the full spec. All knobs are parameters so the tier-1
+smoke tests can run the whole harness in seconds.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+from . import cadence as cadence_mod
+from . import registry
+
+#: Declared memory-lane bound: per-device peak-HBM watermark must stay
+#: within this factor of the engine's analytic per-device
+#: ``model_bytes_per_chunk`` at every sweep point. The slack covers what
+#: the chunk model deliberately excludes — the resident batch arrays,
+#: basis/phi staging, executable workspace — which are O(npsr * ntoa),
+#: not O(chunk), so the factor SHRINKS toward 1 as the sweep grows: the
+#: watermark tracking the model through the ``ska_10k`` endpoint is
+#: exactly the claim under test.
+MEM_BOUND_FACTOR = 3.0
+
+#: Oracle tolerance for the cadence stream lane: the f64 append
+#: accumulation vs a full restage of the same store (summation-order
+#: differences only; mirrors tests/test_stream.py's 1e-8).
+ORACLE_RTOL = 1e-7
+
+
+def _platform() -> str:
+    from ..tune import fingerprint
+    return fingerprint().platform
+
+
+def _percentile(vals: Sequence[float], q: float) -> float:
+    # fakepta: allow[dtype-policy] host latency stats, never on device
+    return float(np.percentile(np.asarray(vals, dtype=np.float64), q)) \
+        if len(vals) else 0.0
+
+
+def cadence_stream_lane(scn, *, mesh=None, history_frac: float = 0.85,
+                        max_blocks: Optional[int] = 12,
+                        nbin: int = 8, seed: int = 0) -> dict:
+    """Drive a stream with the scenario's telescope-cadence append tail.
+
+    Bulk history (everything before ``history_frac``) stages first; the
+    cadence tail then replays as uneven observing-window blocks — silent
+    windows, varying widths, multi-backend epochs. Contract checked here:
+
+    - **append ≡ restage**: the accumulated device moments match a full
+      recompute from the raw store (:data:`ORACLE_RTOL`);
+    - **zero recompiles**: new bucket rungs compile once (``compiles``),
+      but no kernel key is ever re-traced (``recompiles == 0``) — the
+      ladder covers the cadence's block-size mix.
+
+    Returns the bench-row fragment (``append_latency_ms``,
+    ``scn_append_p99_ms``, ``stream_*`` shape facts, ``oracle_ok``).
+    """
+    import jax.numpy as jnp
+
+    from ..stream.state import StreamState, default_stream_model
+    from ..utils.compat import enable_x64
+
+    # fakepta: allow[dtype-policy] host stage: StreamState raw-store grids
+    with enable_x64():
+        # fakepta: allow[dtype-policy] f64 template for the stream store
+        template, _, _, _ = scn.batch_parts(dtype=jnp.float64)
+    ecorr_dt = (scn.ecorr_dt_days * cadence_mod.DAY_S
+                if scn.ecorr else None)
+    stream = StreamState(template, default_stream_model(nbin=nbin),
+                         ecorr_dt=ecorr_dt, mesh=mesh)
+
+    rng = np.random.default_rng((seed, 0xA99))
+    hist = cadence_mod.history_block(scn, history_frac)
+    stream.append(hist.toas, rng.normal(0.0, scn.toaerr, hist.toas.shape),
+                  freqs=hist.freqs, counts=hist.counts)
+
+    blocks = cadence_mod.append_schedule(scn, history_frac,
+                                         max_blocks=max_blocks)
+    latencies = []
+    for blk in blocks:
+        res = rng.normal(0.0, scn.toaerr, blk.toas.shape)
+        stats = stream.append(blk.toas, res, freqs=blk.freqs,
+                              counts=blk.counts)
+        latencies.append(stats["latency_ms"])
+
+    got = [np.asarray(x) for x in stream.moments()]
+    want = [np.asarray(x) for x in stream.restage_moments()]
+    oracle_ok = True
+    for g, w in zip(got, want):
+        scale = np.max(np.abs(w)) or 1.0
+        if not np.allclose(g, w, rtol=ORACLE_RTOL,
+                           atol=ORACLE_RTOL * scale):
+            oracle_ok = False
+    return {
+        "append_latency_ms": round(_percentile(latencies, 50), 3),
+        "scn_append_p99_ms": round(_percentile(latencies, 99), 3),
+        "stream_appends": int(stream.appends),
+        "stream_toas": int(np.sum(stream._n)),
+        "stream_rebuckets": int(stream.rebuckets),
+        "stream_recompiles": int(stream.recompiles),
+        "stream_compiles": int(stream.compiles),
+        "oracle_ok": bool(oracle_ok),
+    }
+
+
+def golden_run(name: str, *, mesh=None, reduced: Optional[bool] = None,
+               nreal: int = 64, chunk: int = 32,
+               sample_steps: int = 96, sample_warmup: int = 48,
+               sample_chains: int = 8, serve_requests: int = 32,
+               max_append_blocks: Optional[int] = 12,
+               skip: Sequence[str] = (), seed: int = 1,
+               report_path=None) -> dict:
+    """Run one scenario through every lane; return the bench-schema row.
+
+    ``skip`` drops lanes by name (``"sample"``, ``"serve"``,
+    ``"stream"``) — the ensemble lane always runs (it IS the scenario).
+    ``reduced=None`` auto-reduces on the CPU stand-in. ``report_path``
+    additionally saves the ensemble lane's RunReport .jsonl — the
+    artifact ``obs summarize``/``compare``/``trace`` consume.
+    """
+    import jax
+
+    scn_full = registry.get(name)
+    platform = _platform()
+    if reduced is None:
+        reduced = platform == "cpu"
+    scn = scn_full.reduced() if reduced else scn_full
+
+    from ..parallel.mesh import make_mesh
+    if mesh is None:
+        mesh = make_mesh(jax.devices())
+    n_devices = int(np.prod(list(mesh.shape.values())))
+
+    # --- ensemble lane (always): the scenario materialized through the
+    # ordinary EnsembleSimulator path — spec-hash identity and the
+    # memwatch/ledger/fault machinery all engage exactly as in bench.py
+    sim = scn.build(mesh=mesh)
+    warm = sim.run(chunk, seed=99, chunk=chunk)
+    out = sim.run(nreal, seed=seed, chunk=chunk)
+    if out["curves"].shape[0] != nreal or \
+            not np.all(np.isfinite(out["curves"])):
+        raise RuntimeError(f"scenario {name}: wrong-shaped or non-finite "
+                           f"ensemble output")
+    rep = out["report"]
+    rep_sum = rep.summary()
+    steady = round(rep.steady_real_per_s_per_chip(), 2)
+    row = {
+        "metric": f"scenario golden run ({name})",
+        "value": steady,
+        "unit": "realizations/s/chip",
+        "platform": platform,
+        "scenario": name,
+        "spec_hash": scn_full.spec_hash(),
+        "compile_s": round(warm["report"].compile_s, 3),
+        "steady_real_per_s_per_chip": steady,
+        "scn_real_per_s_per_chip": steady,
+        "retraces": rep.retraces,
+        "pipeline_depth": rep_sum.get("pipeline_depth", 0),
+        "pipeline_stall_s": rep_sum.get("pipeline_stall_s", 0.0),
+        "ckpt_wait_s": rep_sum.get("ckpt_wait_s", 0.0),
+    }
+    if rep_sum.get("model_bytes_per_chunk"):
+        row["model_bytes_per_chunk"] = rep_sum["model_bytes_per_chunk"]
+    if rep_sum.get("peak_hbm_bytes"):
+        row["peak_hbm_bytes"] = rep_sum["peak_hbm_bytes"]
+        row["scn_peak_hbm_bytes"] = rep_sum["peak_hbm_bytes"]
+    for key, counter in (("faults_retries", "faults.retries"),
+                         ("faults_degradations", "faults.degradations"),
+                         ("faults_rollbacks", "faults.rollbacks")):
+        row[key] = int(rep.counters.get(counter, 0))
+    if report_path is not None:
+        rep.meta.setdefault("scenario", name)
+        rep.meta.setdefault("platform", platform)
+        rep.save(report_path)
+
+    # --- sampler lane: the CURN free-spectrum posterior on the
+    # scenario's array (bench.py's sampling-lane recipe, scenario batch)
+    if "sample" not in skip:
+        from ..infer import ComponentSpec, FreeParam, LikelihoodSpec
+        from ..sample import SampleSpec, SamplingRun
+        batch = sim.batch
+        s_model = LikelihoodSpec(components=(
+            ComponentSpec(target="red", spectrum="batch"),
+            ComponentSpec(target="dm", spectrum="batch"),
+            ComponentSpec(target="curn", nbin=min(6, scn.gwb_ncomp or 6),
+                          spectrum="free_spectrum", free=(
+                              FreeParam("log10_rho", (-9.0, -5.0),
+                                        per_bin=True),)),
+        ))
+        s_spec = SampleSpec(model=s_model, n_chains=sample_chains,
+                            n_temps=2, step_size=0.35, n_leapfrog=10,
+                            thin=2, warmup=sample_warmup)
+        s_out = SamplingRun(batch, s_spec, mesh=mesh, data_seed=7).run(
+            sample_steps, seed=7, segment=min(sample_steps, 64))
+        for key in ("ess_per_s_per_chip", "rhat_max", "accept_rate"):
+            if key in s_out["summary"]:
+                row[key] = s_out["summary"][key]
+        row["scn_ess_per_s_per_chip"] = row.get("ess_per_s_per_chip", 0.0)
+
+    # --- serving lane: the scenario's nearest ArraySpec family through
+    # the warm pool + coalescing scheduler (SLO latencies, bit-verified)
+    if "serve" not in skip:
+        from ..serve import ServeConfig, run_loadgen
+        serve_spec = scn.serve_spec()
+        buckets = tuple(b for b in (max(1, n_devices), 16, 128)
+                        if b % n_devices == 0) or (n_devices,)
+        serve_row = run_loadgen(
+            spec=serve_spec, mesh=mesh, n_requests=serve_requests,
+            sizes=(1, 2, 4), kind="sim", baseline=False, verify=1,
+            seed=5, config=ServeConfig(buckets=buckets))
+        for key in ("serve_qps_per_chip", "serve_p50_ms", "serve_p99_ms",
+                    "coalesce_factor", "pad_waste_frac", "serve_retraces",
+                    "serve_steady_compiles"):
+            if key in serve_row:
+                row[key] = serve_row[key]
+
+    # --- streaming lane: the scenario's own cadence tail as append
+    # traffic (oracle + zero-recompile contract enforced here)
+    if "stream" not in skip:
+        stream_row = cadence_stream_lane(scn, mesh=None,
+                                         max_blocks=max_append_blocks)
+        if not stream_row.pop("oracle_ok"):
+            raise RuntimeError(f"scenario {name}: append/restage oracle "
+                               f"diverged beyond rtol={ORACLE_RTOL}")
+        if stream_row["stream_recompiles"]:
+            raise RuntimeError(
+                f"scenario {name}: {stream_row['stream_recompiles']} "
+                f"unexpected stream recompile(s) under the cadence tail "
+                f"(the bucket ladder stopped covering the traffic)")
+        row.update(stream_row)
+
+    return row
+
+
+def memory_lane(name: str = "ska_10k", *, chunk: int = 32,
+                sweep: Optional[Sequence[int]] = None,
+                psr_shards: Optional[int] = None,
+                ntoa_cap: Optional[int] = None,
+                bound_factor: float = MEM_BOUND_FACTOR,
+                seed: int = 5) -> dict:
+    """Peak-HBM watermark vs n_psr at fixed chunk under ``psr`` sharding.
+
+    Each sweep point rebuilds the scenario at that population size (same
+    cadence, same noise menu), runs one chunk through the ordinary
+    engine, and compares the memwatch watermark (``peak_hbm_bytes`` —
+    allocator stats on an accelerator, the static-reservation + packed-
+    ledger model on the CPU stand-in) against the engine's analytic
+    per-device ``model_bytes_per_chunk``. The contract
+    (docs/SCENARIOS.md): ``ratio = peak / model <= bound_factor`` at
+    EVERY point through the scenario's endpoint — memory scales with the
+    model, not with hidden O(npsr^2) residents. The engine's
+    ``PackedLedger`` separately asserts the donated-buffer depth bound
+    in-run (a violated ring raises).
+    """
+    import jax
+
+    from ..parallel.mesh import make_mesh
+
+    scn_full = registry.get(name)
+    platform = _platform()
+    base = (scn_full.reduced(max_psr=registry.REDUCED_MAX_PSR_MEM)
+            if platform == "cpu" else scn_full)
+    if ntoa_cap is not None and base.cadence != "uniform":
+        import math
+        base = dataclasses.replace(
+            base, cadence_thin=max(base.cadence_thin, math.ceil(
+                base.ntoa / ntoa_cap)))
+    n_dev = len(jax.devices())
+    if psr_shards is None:
+        psr_shards = max(d for d in (8, 4, 2, 1) if n_dev % d == 0)
+    if sweep is None:
+        sweep = sorted({n for n in (psr_shards, 2 * psr_shards,
+                                    4 * psr_shards, base.npsr)
+                        if n <= base.npsr and n % psr_shards == 0})
+    mesh = make_mesh(jax.devices(), psr_shards=psr_shards)
+    points = []
+    for n in sweep:
+        scn_n = dataclasses.replace(base, npsr=int(n))
+        sim = scn_n.build(mesh=mesh)
+        out = sim.run(chunk, seed=seed, chunk=chunk)
+        rep_sum = out["report"].summary()
+        peak = float(rep_sum.get("peak_hbm_bytes") or 0.0)
+        model = float(rep_sum.get("model_bytes_per_chunk") or 0.0)
+        ratio = peak / model if model else float("inf")
+        points.append({
+            "npsr": int(n), "chunk": int(chunk),
+            "peak_hbm_bytes": peak, "model_bytes_per_chunk": model,
+            "ratio": round(ratio, 3),
+            "ok": bool(model and ratio <= bound_factor),
+        })
+    return {
+        "scenario": name, "platform": platform,
+        "psr_shards": int(psr_shards), "chunk": int(chunk),
+        "bound_factor": float(bound_factor),
+        "points": points,
+        "ok": bool(points) and all(p["ok"] for p in points),
+    }
+
+
+def save_row(row: dict, path) -> None:
+    """One bench-schema JSON line — the exact artifact ``python -m
+    fakepta_tpu.obs gate`` loads."""
+    with open(path, "w") as fh:
+        fh.write(json.dumps(row) + "\n")

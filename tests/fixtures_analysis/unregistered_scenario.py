@@ -1,0 +1,16 @@
+"""Seeded true positives + near-misses for unregistered-scenario."""
+import dataclasses
+
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.serve.spec import ArraySpec
+
+
+def shadow_flagships(registry, npsr):
+    a = ArraySpec(npsr=100, ntoa=780)              # VIOLATION: shadow spec
+    b = PulsarBatch.synthetic(npsr=256, ntoa=780)  # VIOLATION: shadow batch
+    c = ArraySpec(npsr=16, ntoa=128)               # clean: unit-test scale
+    d = PulsarBatch.synthetic(npsr=8, ntoa=96)     # clean: reduced stand-in
+    e = ArraySpec(npsr=npsr)                       # clean: plumbed size
+    f = dataclasses.replace(registry.get("flagship_100"),
+                            npsr=256)              # clean: derived variant
+    return a, b, c, d, e, f

@@ -8,6 +8,7 @@ import subprocess
 import sys
 from pathlib import Path
 
+import numpy as np
 import pytest
 
 REPO = Path(__file__).resolve().parents[1]
@@ -159,6 +160,40 @@ def test_population_study_example_runs(tmp_path):
     assert base.returncode == 0, base.stderr[-2000:]
     row_base = json.loads(base.stdout.strip().splitlines()[-1])
     assert row["null_sigma_empirical"] > 1.1 * row_base["null_sigma_empirical"]
+
+
+def test_population_study_scenario_mode(tmp_path):
+    """``--scenario``: the array and priors come from the registered
+    fakepta_tpu.scenarios entry (reduced on CPU), and the row carries the
+    scenario + spec-hash provenance of what actually ran."""
+    proc = subprocess.run(
+        [sys.executable, str(EXAMPLES / "population_study.py"),
+         "--platform", "cpu", "--scenario", "ng15",
+         "--nreal", "100", "--chunk", "50"],
+        capture_output=True, text=True, timeout=560, cwd=str(tmp_path),
+        env=_repo_env())
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    row = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert row["scenario"] == "ng15"
+
+    from fakepta_tpu.scenarios import registry
+    reduced = registry.get("ng15").reduced()
+    # provenance is the REDUCED spec that ran, not the full survey's
+    assert row["spec_hash"] == reduced.spec_hash()
+    assert row["npsr"] == reduced.npsr
+    # the amplitude prior brackets the scenario's injected background
+    lo, hi = row["gwb_log10_A_prior"]
+    assert lo < reduced.gwb_log10_A < hi
+    # null calibration produced a usable empirical distribution
+    assert row["null_sigma_empirical"] > 0
+    assert np.isfinite(row["injected_amp2_mean"])
+    # unknown scenario names fail fast instead of running ad-hoc defaults
+    bad = subprocess.run(
+        [sys.executable, str(EXAMPLES / "population_study.py"),
+         "--platform", "cpu", "--scenario", "nope"],
+        capture_output=True, text=True, timeout=120, cwd=str(tmp_path),
+        env=_repo_env())
+    assert bad.returncode != 0
 
 
 def test_free_spectrum_posterior_example_runs(tmp_path):

@@ -54,6 +54,10 @@ def test_pipelined_equals_serial_plain_lane(batch):
     assert b["report"].meta["pipeline_depth"] == 0
 
 
+@pytest.mark.slow   # ~15 s: tier-1 budget reclaim (ISSUE 19) — the
+# depth-equivalence contract stays tier-1 on the plain and OS lanes
+# (test_pipelined_equals_serial_plain_lane/_os_lane); the keep_corr
+# variant re-runs in tier-2
 def test_pipelined_equals_serial_keep_corr(batch, tmp_path):
     sim = _sim(batch)
     a = sim.run(16, seed=2, chunk=8, keep_corr=True)
@@ -218,6 +222,10 @@ def test_donated_scratch_is_recycled_and_never_reread(batch):
 
 # ------------------------------------------------- overlap acceptance + obs
 
+@pytest.mark.slow   # ~17 s: tier-1 budget reclaim (ISSUE 19) — checkpoint
+# correctness stays tier-1 via test_checkpoint_resume_after_mid_pipeline_kill
+# and the obs fields via test_obs_compare_direction_for_pipeline_metrics;
+# this timing-based overlap acceptance re-runs in tier-2
 def test_checkpointed_pipeline_overlaps_io(batch, tmp_path):
     """The acceptance criterion: with a deliberately slowed checkpoint sink
     the checkpointed pipelined run's steady per-chunk wall stays within 15%

@@ -101,6 +101,11 @@ def test_multi_planet_sampling():
     np.testing.assert_allclose(vb.mean(), vj.mean() + vs.mean(), rtol=0.15)
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 19) — sampled-
+# roemer physics stays tier-1 via test_sampled_roemer_adds_ephemeris_scatter
+# + test_sampled_roemer_variance_matches_linear_response, and fused-kernel
+# parity via test_megakernel's interpret-mode oracles; this cross-path A/B
+# re-runs in tier-2
 def test_sampled_roemer_fused_path_matches_xla():
     """The fused Pallas step has its own roe-addition branch; it must agree
     with the XLA path (f32 kernel precision for a tight bound)."""

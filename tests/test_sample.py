@@ -370,6 +370,11 @@ def ref_run():
         40, seed=3, segment=20, pipeline_depth=0)
 
 
+@pytest.mark.slow   # ~39 s (incl. the ref_run module fixture, now built
+# only in tier-2): tier-1 budget reclaim (ISSUE 19) — sampler determinism
+# across segment boundaries on a mesh stays tier-1 via test_faults::
+# test_sample_segment_transient_retry_bit_identical; the full 2x2x2/depth-2
+# sweep re-runs in tier-2
 def test_mesh_and_pipeline_depth_bit_identity(ref_run):
     """The acceptance contract: thinned streams and diagnostics are
     bit-identical on 1x1x1/depth-0 vs 2x2x2/depth-2."""

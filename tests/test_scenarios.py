@@ -1,0 +1,238 @@
+"""fakepta_tpu.scenarios: registry identity, cadence determinism, the
+golden-run harness contract, memory-lane tracking, same-scenario gate
+banding, and the unregistered-scenario audit (docs/SCENARIOS.md)."""
+
+import dataclasses
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+REPO = Path(__file__).resolve().parents[1]
+
+from fakepta_tpu.scenarios import cadence, registry  # noqa: E402
+
+
+# ---------------------------------------------------------------- registry
+
+def test_named_scenarios_and_hash_pins():
+    """The four survey entries exist and their spec hashes are pinned:
+    a hash move means the scenario DEFINITION changed, which invalidates
+    every golden row recorded for it — bump deliberately, with the pin."""
+    assert {"flagship_100", "ng15", "ipta_dr3", "ska_10k"} <= \
+        set(registry.names())
+    pins = {"flagship_100": "c9c43d6e161a", "ng15": "47cb5c97ab41",
+            "ipta_dr3": "920f5bd9a242", "ska_10k": "a8487575c00b"}
+    for name, pin in pins.items():
+        scn = registry.get(name)
+        assert scn.spec_hash() == pin, (
+            f"{name} spec hash moved ({scn.spec_hash()} != {pin}): its "
+            f"golden trajectory is invalidated — if intended, update the "
+            f"pin here AND docs/SCENARIOS.md")
+        assert scn.spec_hash() == scn.spec_hash()  # pure function of spec
+
+
+def test_flagship_is_bit_identical_to_the_historical_literal():
+    """flagship_100 IS the bench.py/suite.py flagship: the registry path
+    must reproduce the historical ad-hoc literal bit-for-bit, or every
+    migrated call site silently changed its benchmark."""
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.serve import ArraySpec
+
+    old = PulsarBatch.synthetic(npsr=100, ntoa=780, tspan_years=15.0,
+                                toaerr=1e-7, n_red=30, n_dm=100, seed=0)
+    new = registry.flagship_batch()
+    for field in ("toas", "residuals", "sigma2", "pos", "freqs",
+                  "basis_red", "basis_dm", "mask"):
+        a = getattr(old, field, None)
+        if a is not None:
+            assert np.array_equal(np.asarray(a),
+                                  np.asarray(getattr(new, field))), field
+    assert registry.get("flagship_100").serve_spec() == \
+        ArraySpec(npsr=100, ntoa=780, n_red=30, n_dm=100, gwb_ncomp=30)
+
+
+def test_register_rejects_name_collisions_but_is_idempotent():
+    scn = registry.get("ng15")
+    registry.register(scn)  # same name, same spec: a no-op
+    clash = dataclasses.replace(scn, npsr=scn.npsr + 8)
+    with pytest.raises(ValueError, match="ng15"):
+        registry.register(clash)
+    with pytest.raises(KeyError):
+        registry.get("not_a_scenario")
+
+
+def test_reduced_is_deterministic_and_bounded():
+    for name in registry.names():
+        scn = registry.get(name)
+        red = scn.reduced()
+        assert red.spec_hash() == scn.reduced().spec_hash()
+        assert red.npsr <= registry.REDUCED_MAX_PSR
+        assert red.npsr % 8 == 0
+        assert max(red.n_red, red.n_dm) <= 16
+        assert red.name == scn.name  # rows still band on the family name
+
+
+# ----------------------------------------------------------------- cadence
+
+def test_cadence_draw_is_deterministic_and_realistic():
+    scn = registry.get("ng15").reduced()
+    a = cadence.draw_cadence(scn.cadence, scn.tspan_years, scn.npsr, seed=3)
+    b = cadence.draw_cadence(scn.cadence, scn.tspan_years, scn.npsr, seed=3)
+    span = scn.tspan_years * 365.25 * cadence.DAY_S
+    for pa, pb in zip(a, b):
+        assert np.array_equal(pa.t, pb.t)
+        assert pa.t.size >= 8
+        assert 0.0 <= pa.t[0] and pa.t[-1] <= span
+        assert np.all(np.diff(pa.t) > 0)
+    c = cadence.draw_cadence(scn.cadence, scn.tspan_years, scn.npsr, seed=4)
+    assert not np.array_equal(a[0].t, c[0].t)
+
+
+def test_build_batch_masks_and_backends_are_consistent():
+    scn = registry.get("ipta_dr3").reduced()
+    batch, toas_abs, backend_id, n_backends = scn.batch_parts()
+    mask = np.asarray(batch.mask, dtype=bool)
+    assert mask.shape == toas_abs.shape == backend_id.shape
+    assert mask.any(axis=1).all()  # no empty pulsars
+    assert n_backends >= 1
+    assert backend_id[mask].min() >= 0
+    assert backend_id[mask].max() < n_backends
+    # absolute epochs on the observed entries are MJD-seconds, increasing
+    rows = np.where(mask.sum(axis=1) > 1)[0]
+    for i in rows[:4]:
+        t = toas_abs[i][mask[i]]
+        assert np.all(np.diff(t) > 0)
+        assert t[0] >= cadence.MJD0_S
+
+
+def test_append_schedule_covers_the_cadence_tail():
+    scn = registry.get("ng15").reduced()
+    blocks = cadence.append_schedule(scn, history_frac=0.8, max_blocks=6)
+    assert 1 <= len(blocks) <= 6
+    starts = [b.t_start_s for b in blocks]
+    assert starts == sorted(starts)
+    for b in blocks:
+        counts = np.asarray(b.counts)
+        assert counts.max() == b.toas.shape[1]  # width is the max count
+        assert counts.sum() > 0
+
+
+# ------------------------------------------------------------- golden runs
+
+def test_golden_run_smoke_emits_the_bench_row_schema():
+    """The harness end-to-end at smoke sizes: ensemble + cadence-stream
+    lanes produce one bench-schema row (the sample/serve lanes have their
+    own tier-1 suites and are skipped here for budget). The stream lane
+    enforces the append≡restage oracle and the zero-recompile contract
+    internally — a violation raises instead of shipping the row."""
+    row = golden_row()
+    for key in ("metric", "value", "unit", "platform", "scenario",
+                "spec_hash", "steady_real_per_s_per_chip",
+                "scn_real_per_s_per_chip", "peak_hbm_bytes",
+                "scn_peak_hbm_bytes", "append_latency_ms",
+                "scn_append_p99_ms", "stream_appends"):
+        assert key in row, key
+    assert row["scenario"] == "ng15"
+    assert row["stream_recompiles"] == 0
+    assert row["stream_appends"] >= 2  # history + at least one window
+    assert row["value"] > 0 and np.isfinite(row["value"])
+
+
+def golden_row(_cache=[]):  # noqa: B006 - module-lifetime memo
+    if not _cache:
+        from fakepta_tpu.scenarios import golden
+        _cache.append(golden.golden_run(
+            "ng15", nreal=8, chunk=8, skip=("sample", "serve"),
+            max_append_blocks=2))
+    return dict(_cache[0])
+
+
+def test_gate_consumes_golden_rows_and_bands_same_scenario_only():
+    """Mirror of the cpu-vs-tpu banding test for the scenario axis: a
+    golden row only bands against its own scenario's history. A reduced
+    ska_10k trajectory on the same machine must never gate an ng15 row,
+    and main-trajectory rows (no scenario key) must be unaffected."""
+    from fakepta_tpu.obs.gate import gate_row
+
+    base = dict(golden_row(), value=100.0,
+                steady_real_per_s_per_chip=100.0)
+    history = []
+    for jitter in (0.98, 1.0, 1.02):
+        history.append({**base, "value": 100.0 * jitter,
+                        "steady_real_per_s_per_chip": 100.0 * jitter})
+    # same-platform rows from ANOTHER scenario, wildly better: must not band
+    history.append({**base, "scenario": "ska_10k", "value": 10_000.0,
+                    "steady_real_per_s_per_chip": 10_000.0})
+    # main-trajectory history (no scenario key at all)
+    history.append({k: v for k, v in base.items() if k != "scenario"})
+
+    regressed = dict(base, value=50.0, steady_real_per_s_per_chip=50.0)
+    flagged = {r.metric for r in gate_row(regressed, history)
+               if r.verdict == "regression"}
+    assert "value" in flagged and "steady_real_per_s_per_chip" in flagged
+
+    # the ska_10k outlier alone (1 row < min_history) cannot band anything
+    ska_head = dict(base, scenario="ska_10k", value=5_000.0)
+    assert not [r for r in gate_row(ska_head, history)
+                if r.verdict == "regression"]
+
+    # a main-trajectory row sees ONLY the scenario-less history row
+    plain_head = {k: v for k, v in regressed.items() if k != "scenario"}
+    assert not [r for r in gate_row(plain_head, history)
+                if r.verdict == "regression"]
+
+
+def test_memory_lane_watermark_tracks_chunk_model():
+    """The memory-scaling contract at smoke scale: sweeping npsr under psr
+    sharding at fixed chunk, the memwatch watermark stays within the
+    declared bound of the analytic chunk model (the full sweep up to the
+    reduced ska_10k cap runs in the golden suite, docs/SCENARIOS.md)."""
+    from fakepta_tpu.scenarios import golden
+
+    out = golden.memory_lane("ska_10k", chunk=8, sweep=(8, 16))
+    assert out["ok"], out
+    assert [p["npsr"] for p in out["points"]] == [8, 16]
+    for p in out["points"]:
+        assert p["ok"]
+        assert 0 < p["ratio"] <= golden.MEM_BOUND_FACTOR
+        assert p["peak_hbm_bytes"] > 0 and p["model_bytes_per_chunk"] > 0
+
+
+# ------------------------------------------------------------------- audit
+
+def test_no_unregistered_flagship_literals_outside_the_registry():
+    """bench.py and benchmarks/ are OUTSIDE the tier-1 self-check CLI's
+    scan set, so audit them here: every flagship-scale array literal must
+    come from the registry (the fixture pair in fixtures_analysis/ proves
+    the rule fires; this proves the repo is clean)."""
+    from fakepta_tpu.analysis import check_source
+
+    targets = [REPO / "bench.py", *sorted((REPO / "benchmarks").glob("*.py"))]
+    assert len(targets) >= 3
+    hits = []
+    for path in targets:
+        rel = str(path.relative_to(REPO))
+        hits += [f"{rel}:{f.line}" for f in check_source(rel,
+                                                         path.read_text())
+                 if f.rule == "unregistered-scenario"]
+    assert not hits, f"ad-hoc flagship-scale literals: {hits}"
+
+
+def test_cli_list_and_describe(tmp_path):
+    out = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.scenarios", "list"],
+        capture_output=True, text=True, timeout=240, cwd=str(REPO))
+    assert out.returncode == 0, out.stderr[-2000:]
+    for name in ("flagship_100", "ng15", "ipta_dr3", "ska_10k"):
+        assert name in out.stdout
+    desc = subprocess.run(
+        [sys.executable, "-m", "fakepta_tpu.scenarios", "describe", "ng15"],
+        capture_output=True, text=True, timeout=240, cwd=str(REPO))
+    assert desc.returncode == 0, desc.stderr[-2000:]
+    body = json.loads(desc.stdout)
+    assert body["spec"]["npsr"] == 68
+    assert body["spec_hash"] == registry.get("ng15").spec_hash()

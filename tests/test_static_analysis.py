@@ -87,6 +87,8 @@ CASES = [
      {("metric-name-discipline", 10), ("metric-name-discipline", 11),
       ("metric-name-discipline", 12), ("metric-name-discipline", 13),
       ("metric-name-discipline", 14), ("metric-name-discipline", 15)}),
+    ("unregistered_scenario.py", LIB,
+     {("unregistered-scenario", 9), ("unregistered-scenario", 10)}),
     ("clean.py", LIB, set()),
     ("pragma_suppressed.py", LIB, set()),
     ("pragma_unjustified.py", LIB, {("pragma-justification", 4)}),

@@ -487,6 +487,12 @@ def telem_fleet(tmp_path_factory):
     compilation_cache.reset_cache()
 
 
+@pytest.mark.slow   # ~20 s: tier-1 budget reclaim (ISSUE 19) — the scrape
+# transport stays tier-1 via test_scrape_rides_heartbeat_with_zero_new_
+# connections, the fleet fixture via test_stats_protocol_reply_is_enriched,
+# and rollup/exposition units via test_rollup_event_log_round_trip +
+# test_promfmt_renders_declared_names_only; this end-to-end weave re-runs
+# in tier-2
 def test_fleet_scrape_feeds_rollup_and_exposition(telem_fleet):
     flt = telem_fleet["fleet"]
     flt.serve(SimRequest(spec=SPEC0, n=4, seed=1), timeout=600)
