@@ -228,6 +228,23 @@ RunReport each ``sim.run()`` attaches):
   the p99 append latency under the scenario's telescope-cadence
   ``AppendRequest`` schedule (zero-recompile contract enforced, same as
   the main stream lane).
+- ``fs_lane_count`` / ``fs_speedup_x`` / ``fs_ess_per_s_per_chip`` /
+  ``fs_wall_s_total`` / ``fs_wall_s_critical`` / ``fs_oracle_max_err`` /
+  ``fs_recompiles`` / ``fs_refresh_ms`` / ``fs_full_refresh_ms`` /
+  ``fs_refresh_speedup_x`` / ``fs_lanes_touched`` / ``fs_bins_touched``:
+  the factorized free-spectrum lane (``fakepta_tpu.sample.factorized``,
+  ``stream.FactorizedRefresher``, docs/SAMPLING.md; emitted by
+  ``benchmarks/suite.py`` config 18). ``fs_lane_count`` /
+  ``fs_lanes_touched`` / ``fs_bins_touched`` are decomposition/scenario
+  shape facts (exempt); ``fs_speedup_x`` (factorized-vs-joint ESS/s
+  multiple), ``fs_refresh_speedup_x`` (incremental-vs-full refresh
+  multiple) and ``fs_ess_per_s_per_chip`` (critical-path per-chip ESS
+  rate, ``_per_s_per_chip`` suffix) are higher-better — the lane's whole
+  point; ``fs_oracle_max_err`` (the f64 additivity defect — config 18
+  REFUSES to record a row when it exceeds the exactness gate),
+  ``fs_recompiles`` (steady-state lane retraces, zero-compile contract),
+  ``fs_refresh_ms`` / ``fs_full_refresh_ms`` and ``fs_wall_s_total`` /
+  ``fs_wall_s_critical`` are lower-better costs.
 
 A new row is gated against this history with ``python -m fakepta_tpu.obs
 gate row.json`` — MAD noise bands over same-``platform`` (and, for

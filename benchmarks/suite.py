@@ -7,7 +7,9 @@ lane, 13: the multi-replica serve fleet A/B with mid-load replica kill,
 14: the streaming-ingestion A/B — single-epoch incremental append vs full
 restage, docs/STREAMING.md, 15: the elastic chaos lane, 16: the multi-tenant
 gateway lane, 17: the scenario golden smoke — the ``fakepta_tpu.scenarios``
-golden-run harness as a first-class config).
+golden-run harness as a first-class config, 18: the factorized
+free-spectrum A/B — per-bin lanes vs the joint sampler plus the
+O(bins-touched) streaming refresh, f64-oracle-gated, docs/SAMPLING.md).
 
 ``--scenario NAME`` points the chaos lanes (12, 15) and the golden smoke
 (17) at a registered scenario from ``fakepta_tpu.scenarios`` instead of
@@ -701,6 +703,162 @@ def config17():
     return {"config": 17, **row}
 
 
+def config18():
+    """Factorized free-spectrum lane (fakepta_tpu.sample.factorized,
+    docs/SAMPLING.md "Factorized free-spectrum"): the factorized-vs-joint
+    sampling A/B plus the O(bins-touched) streaming refresh A/B.
+
+    Part 1: a regular-grid (discrete-orthogonality) free-spectrum array is
+    sampled jointly and as per-bin lanes over the SAME staged data. The
+    f64 dense oracle must certify lnL additivity first and the measured
+    factorized run must not recompile — the row is REFUSED otherwise,
+    exactness and steady-state compile hygiene are not tradable for the
+    speedup. The headline ``fs_speedup_x`` is ``fs_ess_per_s_per_chip``
+    (critical-path lane wall — lanes are independent fleet sessions, one
+    per replica) over the joint run's ``ess_per_s_per_chip``.
+
+    Part 2: a :class:`~fakepta_tpu.stream.FactorizedRefresher` over a
+    per-bin stream. Both refresh cycles follow an equal-width appended
+    epoch (both pay the moment fold), but the incremental one carries a
+    single bin's sinusoid on the stream's even cadence, so only that
+    bin's lane re-samples: ``fs_refresh_speedup_x`` =
+    ``fs_full_refresh_ms`` / ``fs_refresh_ms``, refused on any steady
+    recompile. The accelerator lane runs flagship-shaped arrays; the CPU
+    stand-in a reduced one (``platform`` disambiguates, as everywhere).
+    """
+    import dataclasses as _dc
+
+    import jax
+
+    from fakepta_tpu import constants as const
+    from fakepta_tpu.batch import PulsarBatch
+    from fakepta_tpu.infer import ComponentSpec, FreeParam, LikelihoodSpec
+    from fakepta_tpu.sample import (FactorizedRun, SampleSpec, SamplingRun,
+                                    factorized_oracle)
+    from fakepta_tpu.stream import FactorizedRefresher, StreamState
+
+    cpu = jax.devices()[0].platform == "cpu"
+    if not cpu:
+        npsr, ntoa, nb, lane_bins = 32, 384, 48, 4
+        n_steps, warmup, segment = 192, 64, 32
+        s_npsr, s_ntoa, s_nb, s_steps = 16, 96, 16, 96
+    else:
+        npsr, ntoa, nb, lane_bins = 4, 64, 8, 1
+        n_steps, warmup, segment = 64, 16, 16
+        s_npsr, s_ntoa, s_nb, s_steps = 3, 48, 16, 64
+
+    def fs_model(nbin):
+        return LikelihoodSpec(components=(
+            ComponentSpec(target="red", spectrum="batch"),
+            ComponentSpec(target="dm", spectrum="batch"),
+            ComponentSpec(target="curn", nbin=nbin,
+                          spectrum="free_spectrum",
+                          free=(FreeParam("log10_rho", (-9.0, -5.0),
+                                          per_bin=True),)),))
+
+    # ---- part 1: factorized vs joint over identical staged data --------
+    b = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=10.0,
+                              toaerr=1e-7, n_red=nb, n_dm=nb, seed=1)
+    # exact discrete-orthogonality cadence t_k = k/T (no endpoint): the
+    # grid on which the per-bin split is exact, which the oracle
+    # certifies. Stored as HOST f64 (not the batch's device dtype) so the
+    # f64 staging/oracle path reads the exact grid — a f32 round-trip of
+    # the epochs alone costs ~1e-4 of additivity
+    t = np.tile(np.arange(ntoa, dtype=np.float64)[None] / ntoa, (npsr, 1))
+    b = _dc.replace(b, t_own=t, t_common=t)
+    model = fs_model(nb)
+
+    orc = factorized_oracle(b, model, lane_bins=lane_bins, data_seed=0,
+                            n_probe=4)
+    if orc["additivity_max_err"] > 1e-8 * max(orc["lnl_scale"], 1.0):
+        raise RuntimeError(
+            f"factorized lnL additivity defect "
+            f"{orc['additivity_max_err']:.3e} exceeds the f64 oracle "
+            f"tolerance — the per-bin split is NOT exact on this grid, "
+            f"refusing to record a speedup through it")
+
+    spec = SampleSpec(model=model, n_chains=4, warmup=warmup,
+                      step_size=0.3, n_leapfrog=4)
+    fr = FactorizedRun(b, spec, lane_bins=lane_bins, data_seed=0)
+    fr.run(segment, seed=1, segment=segment)           # warm (compile)
+    retr0 = fr.retraces
+    res_f = fr.run(n_steps, seed=2, segment=segment)   # measured, warm
+    if fr.retraces - retr0:
+        raise RuntimeError(
+            f"{fr.retraces - retr0} lane retraces in the measured "
+            f"factorized run — the steady state is recompiling, refusing "
+            f"to record a speedup through it")
+    joint = SamplingRun(b, spec, residuals=fr.residuals)
+    joint.run(segment, seed=1, segment=segment)        # warm (compile)
+    res_j = joint.run(n_steps, seed=2, segment=segment)
+    fs_ess = res_f["summary"]["fs_ess_per_s_per_chip"]
+    j_ess = res_j["summary"]["ess_per_s_per_chip"]
+    fs_speedup = fs_ess / max(j_ess, 1e-12)
+
+    # ---- part 2: O(bins-touched) refresh vs full, equal appends --------
+    tspan_s = 10.0 * const.yr
+    template = PulsarBatch.synthetic(npsr=s_npsr, ntoa=s_ntoa,
+                                     tspan_years=10.0, n_red=4, n_dm=4,
+                                     seed=3)
+    s_model = fs_model(s_nb)
+    stream = StreamState(template, s_model)
+    rng = np.random.default_rng(0)
+    # every block is 40 wide: one shared (64-rung) bucket executable, and
+    # 40 even-cadence samples resolve all s_nb harmonics alias-free
+    # (width > 2*s_nb), so the sinusoid epoch's projection stays in its
+    # own bin
+    wide = 40
+    t0 = np.sort(rng.uniform(0, 0.9 * tspan_s, (s_npsr, wide)), axis=1)
+    stream.append(t0, rng.normal(0, 1e-7, (s_npsr, wide)),
+                  sigma2=np.full((s_npsr, wide), 1e-14))
+    s_spec = SampleSpec(model=s_model, n_chains=2, warmup=16,
+                        n_leapfrog=3)
+    ref = FactorizedRefresher(stream, s_spec, lane_bins=1, rhat_gate=1e9)
+    ref.refresh(s_steps, seed=1, segment=segment)      # cold (compiles)
+
+    def epoch(width, r):
+        te = np.tile((np.arange(width) / width * tspan_s)[None],
+                     (s_npsr, 1))
+        return te, r(te), np.full((s_npsr, width), 1e-14)
+
+    # incremental: the appended epoch excites ONE bin (f = 2/T sinusoid
+    # on the even cadence), so one lane re-samples warm
+    te, re_, s2 = epoch(wide, lambda te: 1e-6 * np.sin(
+        2 * np.pi * (2.0 / tspan_s) * te))
+    stream.append(te, re_, sigma2=s2)
+    incr = ref.refresh(s_steps, seed=2, segment=segment)
+    # full baseline: an equal-width epoch (white), every lane re-sampled
+    # through the SAME code path — both cycles pay the moment fold
+    te, re_, s2 = epoch(wide, lambda te: rng.normal(0, 1e-7, te.shape))
+    stream.append(te, re_, sigma2=s2)
+    full = ref.refresh(s_steps, seed=3, segment=segment, force_all=True)
+    if incr["fs_recompiles"] or full["fs_recompiles"]:
+        raise RuntimeError(
+            "refresh lanes recompiled in the steady state — the "
+            "O(bins-touched) claim is void, refusing to record it")
+    refresh_speedup = full["fs_refresh_ms"] / max(incr["fs_refresh_ms"],
+                                                  1e-9)
+
+    return {"config": 18,
+            "metric": "factorized free-spectrum lanes vs joint sampler "
+                      "(per-chip ESS/s, f64-oracle-gated) + O(bins-"
+                      "touched) streaming refresh",
+            "value": round(fs_speedup, 2), "unit": "x",
+            "fs_speedup_x": round(fs_speedup, 2),
+            "fs_oracle_max_err": orc["additivity_max_err"],
+            "fs_lane_count": res_f["summary"]["fs_lane_count"],
+            "fs_ess_per_s_per_chip": fs_ess,
+            "ess_per_s_per_chip": j_ess,
+            "fs_wall_s_total": res_f["summary"]["fs_wall_s_total"],
+            "fs_wall_s_critical": res_f["summary"]["fs_wall_s_critical"],
+            "fs_recompiles": 0,
+            "fs_lanes_touched": incr["fs_lanes_touched"],
+            "fs_bins_touched": incr["fs_bins_touched"],
+            "fs_refresh_ms": incr["fs_refresh_ms"],
+            "fs_full_refresh_ms": full["fs_refresh_ms"],
+            "fs_refresh_speedup_x": round(refresh_speedup, 2)}
+
+
 def config5():
     """10k-realization MC of 100-psr HD GWB — the north-star (bench.py metric)."""
     import jax
@@ -901,7 +1059,7 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--configs", type=int, nargs="*",
                     default=[1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13,
-                             14, 15, 16, 17])
+                             14, 15, 16, 17, 18])
     ap.add_argument("--platform", default=None)
     ap.add_argument("--scenario", default=None,
                     help="registered scenario name (fakepta_tpu.scenarios):"
@@ -938,7 +1096,7 @@ def main():
     fns = {1: config1, 2: config2, 3: config3, 4: config4, 5: config5,
            6: config6, 7: config7, 8: config8, 9: config9, 10: config10,
            11: config11, 12: config12, 13: config13, 14: config14,
-           15: config15, 16: config16, 17: config17}
+           15: config15, 16: config16, 17: config17, 18: config18}
     rows = []
     ensemble_configs = {5, 6, 7, 8, 9, 10, 11, 12}  # the ones using _scaled
     # platform identity single-sourced through the tuner's fingerprint
@@ -962,10 +1120,16 @@ def main():
         rows.append(row)
 
     if args.update_baseline and rows:
+        # rows are keyed by (platform, scenario): platform names the
+        # section (same grouping `obs gate` bands by) and every row
+        # carries its scenario — "-" for scenario-free configs — so a
+        # scenario-parameterized round (configs 12/15/17 under
+        # --scenario) never collides with the default round's entry in
+        # the same table
         lines = [f"\n## Measured ({date.today().isoformat()}, "
                  f"{rows[0]['platform']}, {len(jax.devices())} device(s))\n\n",
-                 "| # | metric | value | unit | notes |\n",
-                 "|---|---|---|---|---|\n"]
+                 "| # | scenario | metric | value | unit | notes |\n",
+                 "|---|---|---|---|---|---|\n"]
         for r in rows:
             notes = []
             if "vs_baseline" in r:
@@ -975,7 +1139,8 @@ def main():
             if "achieved_tflops_per_chip" in r:
                 notes.append(f"{r['achieved_tflops_per_chip']} TF/s/chip, "
                              f"~{r['mfu_vs_bf16_peak_pct']}% of bf16 peak")
-            lines.append(f"| {r['config']} | {r['metric']} | {r['value']} "
+            lines.append(f"| {r['config']} | {r.get('scenario', '-')} "
+                         f"| {r['metric']} | {r['value']} "
                          f"| {r['unit']} | {', '.join(notes)} |\n")
         with open(REPO / "BASELINE.md", "a") as fh:
             fh.writelines(lines)

@@ -85,6 +85,11 @@ DTYPE_POLICY = {
     "fakepta_tpu/sample/run.py": "host-f64",
     "fakepta_tpu/sample/model.py": "host-f64",
     "fakepta_tpu/sample/cli.py": "host-f64",
+    # the factorized free-spectrum driver: plan derivation, host-side
+    # moment restriction (numpy, f64-preserving by contract), the dense
+    # f64 additivity oracle, and lane recombination are all host staging
+    # around ordinary SamplingRun lanes (the device pieces are unchanged).
+    "fakepta_tpu/sample/factorized.py": "host-f64",
     # the serve protocol codec: JSON request lines stage their TOA blocks
     # and theta grids to host f64 arrays (the same staging role the other
     # subsystem CLIs play); the device work happens in the pool/stream
@@ -208,9 +213,11 @@ METRIC_NAMES = (
     "jax.backend_compile_s", "jax.lowering_s", "jax.trace_s",
     "obs.chunks", "obs.peak_hbm_bytes", "obs.retraces", "obs.traces",
     "pipeline.d2h_async", "pipeline.h2d_prefetch",
-    "sample.segments_done",
+    "sample.lane_runs", "sample.segments_done",
     "serve.append_latency_s", "serve.stream_requests",
     "stream.appends", "stream.compiles", "stream.detections",
+    "stream.fs_bins_touched", "stream.fs_lanes_refreshed",
+    "stream.fs_refreshes",
     "stream.promotions", "stream.rebuckets", "stream.recompiles",
     "stream.refresh_gate_holds", "stream.refresh_gate_opens",
     "stream.refresh_skips", "stream.refreshes", "stream.replays",

@@ -343,12 +343,16 @@ def padded_pdist(psrs: Sequence) -> np.ndarray:
     return out
 
 
-def fourier_basis_norm(t_norm, nbin: int, scale=None):
+def fourier_basis_norm(t_norm, nbin: int, scale=None, bin_offset: int = 0):
     """(…, T, 2, N) cos/sin basis from normalized time: phase = 2 pi n t_norm.
 
-    float32-safe by construction (phase argument <= 2 pi nbin).
+    float32-safe by construction (phase argument <= 2 pi (bin_offset+nbin)).
+    ``bin_offset`` starts the harmonic ladder at ``n = bin_offset + 1`` —
+    the factorized free-spectrum lanes (docs/SAMPLING.md) restrict a model
+    to a bin block by offsetting its basis columns, so a lane's columns are
+    bitwise the corresponding columns of the parent model's basis.
     """
-    n = jnp.arange(1, nbin + 1, dtype=t_norm.dtype)
+    n = jnp.arange(bin_offset + 1, bin_offset + nbin + 1, dtype=t_norm.dtype)
     phase = 2.0 * jnp.pi * t_norm[..., :, None] * n
     basis = jnp.stack([jnp.cos(phase), jnp.sin(phase)], axis=-2)
     if scale is not None:

@@ -91,7 +91,12 @@ class ComponentSpec:
     resolve against its signature), or :data:`BATCH_SPECTRUM` to pin the
     component at the batch's stored PSD (``red_psd``/``dm_psd``/
     ``chrom_psd``/``sys_psd``). ``nbin`` defaults to the batch's bin count
-    for the target (CURN: the red bin count).
+    for the target (CURN: the red bin count). ``bin_offset`` restricts the
+    component to the bin block ``[bin_offset, bin_offset + nbin)`` of the
+    standard grid — its basis columns and PSD values are bitwise the
+    corresponding slice of the unrestricted component's, which is what
+    makes the factorized free-spectrum lanes exact where the basis blocks
+    are orthogonal (docs/SAMPLING.md "Factorized free-spectrum").
     """
 
     target: str
@@ -99,6 +104,7 @@ class ComponentSpec:
     free: Tuple[FreeParam, ...] = ()
     fixed: tuple = ()             # ((name, value), ...); dicts are normalized
     nbin: Optional[int] = None
+    bin_offset: int = 0
 
     def __post_init__(self):
         if isinstance(self.fixed, dict):
@@ -107,6 +113,12 @@ class ComponentSpec:
         else:
             object.__setattr__(self, "fixed", tuple(self.fixed))
         object.__setattr__(self, "free", tuple(self.free))
+        if int(self.bin_offset) < 0:
+            raise ValueError(f"bin_offset must be >= 0, got "
+                             f"{self.bin_offset}")
+        if self.bin_offset and self.nbin is None:
+            raise ValueError("a bin_offset component needs an explicit "
+                             "nbin (the block width)")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -269,6 +281,10 @@ class CompiledLikelihood:
                                  f"{comp.target!r}; known: {TARGETS}")
             nbatch = _batch_bins(batch, comp.target)
             nbin = int(comp.nbin) if comp.nbin is not None else nbatch
+            bin_offset = int(comp.bin_offset)
+            if bin_offset and comp.target == "sys":
+                raise ValueError("bin_offset is not supported on 'sys' "
+                                 "components (per-band column maps)")
             bands = 1
             if comp.target == "sys":
                 if not bool(np.any(np.asarray(batch.sys_mask))):
@@ -287,10 +303,11 @@ class CompiledLikelihood:
                     raise ValueError("the batch stores no common-process "
                                      "PSD; give the 'curn' component a "
                                      "parametric spectrum")
-                if nbin > nbatch:
+                if bin_offset + nbin > nbatch:
                     raise ValueError(
-                        f"component {ci} ({comp.target}) asks for {nbin} "
-                        f"bins but the batch stores {nbatch}")
+                        f"component {ci} ({comp.target}) asks for bins "
+                        f"[{bin_offset}, {bin_offset + nbin}) but the "
+                        f"batch stores {nbatch}")
             else:
                 if comp.spectrum not in spectrum_lib.SPECTRA:
                     raise ValueError(
@@ -320,8 +337,11 @@ class CompiledLikelihood:
                     names.extend(f"{comp.target}_{fp.name}[{p}]"
                                  for p in range(self.npsr))
                 elif fp.per_bin:
+                    # absolute bin labels: a bin_offset lane's parameter
+                    # names match the parent model's slots it factors out
                     names.extend(f"{comp.target}_{fp.name}[{b}]"
-                                 for b in range(nbin))
+                                 for b in range(bin_offset,
+                                                bin_offset + nbin))
                 else:
                     names.append(f"{comp.target}_{fp.name}")
                 bounds.extend([list(fp.bounds)] * length)
@@ -329,7 +349,7 @@ class CompiledLikelihood:
             comps.append({
                 "target": comp.target, "spectrum": comp.spectrum,
                 "nbin": nbin, "bands": bands, "free": tuple(free_entries),
-                "fixed": dict(comp.fixed),
+                "fixed": dict(comp.fixed), "bin_offset": bin_offset,
             })
         self._comps = comps
         self.D = d
@@ -405,17 +425,19 @@ class CompiledLikelihood:
         p_local, t_local = batch.t_own.shape
         blocks = []
         for c in self._comps:
-            n = c["nbin"]
+            n, off = c["nbin"], c["bin_offset"]
             if c["target"] == "curn":
-                b = fourier_basis_norm(batch.t_common, n)
+                b = fourier_basis_norm(batch.t_common, n, bin_offset=off)
             elif c["target"] == "dm":
                 b = fourier_basis_norm(batch.t_own, n,
-                                       scale=(1400.0 / batch.freqs) ** 2)
+                                       scale=(1400.0 / batch.freqs) ** 2,
+                                       bin_offset=off)
             elif c["target"] == "chrom":
                 b = fourier_basis_norm(batch.t_own, n,
-                                       scale=(1400.0 / batch.freqs) ** 4)
+                                       scale=(1400.0 / batch.freqs) ** 4,
+                                       bin_offset=off)
             else:                        # 'red' and 'sys' share the own grid
-                b = fourier_basis_norm(batch.t_own, n)
+                b = fourier_basis_norm(batch.t_own, n, bin_offset=off)
             if c["target"] == "sys":
                 for band in range(c["bands"]):
                     masked = b * batch.sys_mask[:, band][:, :, None, None]
@@ -436,13 +458,20 @@ class CompiledLikelihood:
         theta = jnp.asarray(theta, dtype)
         cols = []
         for c in self._comps:
-            n = c["nbin"]
+            n, off = c["nbin"], c["bin_offset"]
+            # Offset components evaluate their spectrum on the FULL grid
+            # (1..off+n)*df and slice the tail: registered spectra are
+            # elementwise in f, so this is exact, keeps f[0] == df (the
+            # Tspan-inference / grid-validation contract of
+            # ``free_spectrum``), and makes a lane's phi columns bitwise
+            # equal to the parent model's [off, off+n) slice.
+            ntot = off + n
             if c["target"] == "curn":
                 df = 1.0 / batch.tspan_common
-                f = jnp.arange(1, n + 1, dtype=dtype) * df
+                f = jnp.arange(1, ntot + 1, dtype=dtype) * df
             else:
                 df = batch.df_own[:, None]
-                f = jnp.arange(1, n + 1, dtype=dtype) * df       # (P, N)
+                f = jnp.arange(1, ntot + 1, dtype=dtype) * df     # (P, N)
             if c["spectrum"] == BATCH_SPECTRUM:
                 stored = {"red": batch.red_psd, "dm": batch.dm_psd,
                           "chrom": batch.chrom_psd}
@@ -451,7 +480,7 @@ class CompiledLikelihood:
                         pd = batch.sys_psd[:, band, :n] * df
                         cols.append(jnp.concatenate([pd, pd], axis=-1))
                     continue
-                pd = stored[c["target"]][:, :n] * df
+                pd = stored[c["target"]][:, off:off + n] * df
                 cols.append(jnp.concatenate([pd, pd], axis=-1))
                 continue
             kwargs = dict(c["fixed"])
@@ -463,11 +492,20 @@ class CompiledLikelihood:
                 elif per_bin:
                     # one slot per frequency bin (free spectrum): the (n,)
                     # vector broadcasts against f ((n,) for curn, (P, n)
-                    # per pulsar) inside the registered spectrum
-                    kwargs[pname] = lax.dynamic_slice(theta, (start,), (n,))
+                    # per pulsar) inside the registered spectrum. Offset
+                    # components front-pad the skipped bins with zeros so
+                    # the full-grid evaluate stays shape-consistent; the
+                    # padded entries are sliced away below and no gradient
+                    # flows through them.
+                    v = lax.dynamic_slice(theta, (start,), (n,))
+                    if off:
+                        v = jnp.concatenate([jnp.zeros((off,), dtype), v])
+                    kwargs[pname] = v
                 else:
                     kwargs[pname] = theta[start]
             psd = spectrum_lib.evaluate(c["spectrum"], f, **kwargs)
+            if off:
+                psd = psd[..., off:]
             pd = jnp.broadcast_to(psd * df, (p_local, n))
             block = jnp.concatenate([pd, pd], axis=-1)
             for _ in range(c["bands"]):

@@ -67,6 +67,10 @@ METRIC_NAMES = frozenset({
     "stream.detections", "stream.promotions", "stream.refreshes",
     "stream.refresh_skips", "stream.recompiles", "stream.compiles",
     "stream.rebuckets", "stream.appends", "stream.replays",
+    # factorized free-spectrum lanes (sample/factorized.py,
+    # stream/refresh.py FactorizedRefresher)
+    "sample.lane_runs", "stream.fs_refreshes", "stream.fs_lanes_refreshed",
+    "stream.fs_bins_touched",
     # retrace guard (parallel/montecarlo.py, sample/run.py)
     "obs.traces", "obs.retraces",
     # engine chunk accounting + async-pipeline overlap counters

@@ -77,7 +77,16 @@ HIGHER_IS_BETTER = {"real_per_s", "steady_real_per_s_per_chip",
                     # append_latency_ms keeps the lower-is-better default,
                     # and stream_recompiles keeps it too (any growth past
                     # the zero history is the bucket ladder regressing)
-                    "append_speedup_x"}
+                    "append_speedup_x",
+                    # the factorized free-spectrum lane (sample/
+                    # factorized.py, stream/refresh.py FactorizedRefresher,
+                    # docs/SAMPLING.md): the factorized-vs-joint ESS/s
+                    # multiple and the incremental-vs-full refresh multiple
+                    # are the lane's whole point (fs_ess_per_s_per_chip
+                    # rides the _per_s_per_chip suffix; fs_refresh_ms /
+                    # fs_oracle_max_err / fs_recompiles keep the lower-is-
+                    # better default)
+                    "fs_speedup_x", "fs_refresh_speedup_x"}
 
 # suffix rules cover the detect lane's per-ORF metric names
 # (os_<orf>_significance_sigma, os_<orf>_detection_rate), the infer lane's
@@ -190,7 +199,17 @@ EXEMPT_METRICS = {"nreal", "chunks", "pipeline_depth", "config",
                   # and gw_p99_ms_under_quota / gw_cutover_ms
                   # (lower-better below)
                   "gw_requests", "gw_tenants", "gw_verified",
-                  "gw_throttles", "gw_coalesced"}
+                  "gw_throttles", "gw_coalesced",
+                  # factorized free-spectrum shape facts: how many lanes
+                  # the plan produced and how many bins/lanes an append
+                  # actually touched are decomposition/scenario
+                  # description — the scripted append MAKES them nonzero
+                  # (the regression-bearing factorized metrics are
+                  # fs_speedup_x / fs_refresh_speedup_x /
+                  # fs_ess_per_s_per_chip — higher-better — and
+                  # fs_refresh_ms / fs_full_refresh_ms / fs_oracle_max_err
+                  # / fs_recompiles / fs_wall_s_critical, lower-better)
+                  "fs_lane_count", "fs_lanes_touched", "fs_bins_touched"}
 EXEMPT_SUFFIXES = ("_amp2_mean", "_sigma_empirical", "_sigma_analytic",
                    "_null_q95", "_p_value_median", "_lnl_max_mean",
                    "_grid_k")
@@ -248,7 +267,15 @@ LOWER_IS_BETTER = {"compile_s", "retraces", "cost_bytes_per_chunk",
                    # golden metrics — scn_ess_per_s_per_chip,
                    # scn_real_per_s_per_chip — ride the
                    # _per_s_per_chip suffix rule)
-                   "scn_peak_hbm_bytes", "scn_append_p99_ms"}
+                   "scn_peak_hbm_bytes", "scn_append_p99_ms",
+                   # factorized free-spectrum lane (docs/SAMPLING.md):
+                   # the f64 additivity defect is the exactness canary
+                   # (config 18 refuses rows past its gate), steady lane
+                   # recompiles must stay at zero, and the refresh
+                   # latencies/wall times are costs
+                   "fs_oracle_max_err", "fs_recompiles", "fs_refresh_ms",
+                   "fs_full_refresh_ms", "fs_wall_s_total",
+                   "fs_wall_s_critical"}
 
 
 def metric_higher_is_better(k: str) -> bool:

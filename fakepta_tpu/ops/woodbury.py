@@ -311,3 +311,55 @@ def woodbury_lnlike(r, tmat, phi, sigma2, mask=None, epoch_idx=None,
     M, lndetN, n_valid, corr = finish_fixed(fparts)
     d0, dT = finish_res(rparts, corr)
     return lnlike_from_moments(d0, dT, M, lndetN, n_valid, phi)
+
+
+def restrict_moments(moments, cols):
+    """Restrict per-pulsar moments ``(M, lndetN, n_valid, d0, dT)`` to a
+    column subset.
+
+    ``cols`` is a 1-D integer index array into the GP-coefficient axis (the
+    trailing ``2M`` axis). The restriction is exact fancy indexing of the
+    staged moments — ``M`` and ``dT`` entries are per-(column-pair) sums
+    over TOAs, so the restricted tuple is BITWISE equal to re-staging the
+    moments against a model built from only those basis columns (the
+    factorized sampler's lane contract; data-side scalars ``lndetN`` /
+    ``n_valid`` / ``d0`` are column-independent and pass through
+    unchanged). Leading axes (pulsar, realization) are preserved.
+    """
+    cols = jnp.asarray(cols, dtype=jnp.int32)
+    M, lndetN, n_valid, d0, dT = moments
+    M_r = jnp.take(jnp.take(M, cols, axis=-1), cols, axis=-2)
+    dT_r = jnp.take(dT, cols, axis=-1)
+    return (M_r, lndetN, n_valid, d0, dT_r)
+
+
+def block_coupling(M, blocks):
+    """Max normalized cross-block coupling of a stacked ``M`` moment.
+
+    ``M`` has shape ``(..., 2M, 2M)`` (leading pulsar axes reduced with a
+    max); ``blocks`` is a sequence of 1-D column index arrays partitioning
+    (a subset of) the coefficient axis. Returns the scalar
+
+        max over pairs (j in block_a, k in block_b, a != b) of
+            |M_jk| / sqrt(M_jj * M_kk)
+
+    — the factorized sampler's exactness diagnostic: the per-block
+    conditional product equals the joint likelihood up to a
+    theta-independent constant exactly when this is 0 (regular-grid
+    discrete orthogonality), and the oracle reports it alongside the lnL
+    additivity defect when the factorization is approximate.
+    """
+    M = jnp.asarray(M)
+    diag = jnp.diagonal(M, axis1=-2, axis2=-1)
+    norm = jnp.sqrt(jnp.abs(diag[..., :, None] * diag[..., None, :]))
+    floor = _phi_floor(norm.dtype)
+    ratio = jnp.abs(M) / jnp.maximum(norm, floor)
+    worst = jnp.zeros((), M.dtype)
+    for a in range(len(blocks)):
+        for b in range(len(blocks)):
+            if a == b:
+                continue
+            sub = jnp.take(jnp.take(ratio, jnp.asarray(blocks[a]), axis=-2),
+                           jnp.asarray(blocks[b]), axis=-1)
+            worst = jnp.maximum(worst, jnp.max(sub))
+    return worst

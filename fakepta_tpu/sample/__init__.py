@@ -29,10 +29,29 @@ Layers (docs/SAMPLING.md):
   buffers, checkpoints, timeline, flight recorder, ``warm_start()`` AOT),
   emitting a ``fakepta_tpu.sample/1`` artifact ``python -m fakepta_tpu.obs
   compare``/``gate`` consume; CLI: ``python -m fakepta_tpu.sample run``.
+- :mod:`factorized` — the per-frequency factorized free-spectrum driver
+  (ROADMAP item 4): :func:`factor_plan` splits a ``per_bin`` free-spectrum
+  model into bin-block lanes; the pinned components fold into the noise
+  once at staging (:func:`marginalize_for_lanes`, the ``Ntilde`` metric),
+  so each lane is an ordinary :class:`SamplingRun` over ONLY its own
+  quadrature columns. :class:`FactorizedRun` drives them locally,
+  :func:`run_factorized_sessions` routes them fleet-wide through PR 12's
+  sampling sessions, and :func:`factorized_oracle` is the f64 dense proof
+  that factorized == joint where the grid is exactly factorizable (and
+  quantifies the defect where it isn't).
 """
 
+from .factorized import (FactorizedRun, FactorizedSpec, factor_plan,
+                         factorized_oracle, lane_seed, lane_spans,
+                         marginalize_for_lanes, marginalize_nuisance_np,
+                         marginalized_window_moments, recombine_draws,
+                         run_factorized_sessions)
 from .model import SAMPLE_SCHEMA, SampleSpec, as_spec, diagnostics
 from .run import SampleCheckpoint, SamplingRun
 
-__all__ = ["SAMPLE_SCHEMA", "SampleCheckpoint", "SampleSpec", "SamplingRun",
-           "as_spec", "diagnostics"]
+__all__ = ["FactorizedRun", "FactorizedSpec", "SAMPLE_SCHEMA",
+           "SampleCheckpoint", "SampleSpec", "SamplingRun", "as_spec",
+           "diagnostics", "factor_plan", "factorized_oracle", "lane_seed",
+           "lane_spans", "marginalize_for_lanes", "marginalize_nuisance_np",
+           "marginalized_window_moments", "recombine_draws",
+           "run_factorized_sessions"]

@@ -93,6 +93,94 @@ def _host_ctx():
     return stack
 
 
+def f64_batch_views(batch) -> SimpleNamespace:
+    """f64 views of the batch fields ``basis``/``phi`` read, so the
+    staging math runs at full precision whatever the batch dtype."""
+    f64 = lambda x: jnp.asarray(np.asarray(x, dtype=np.float64))  # noqa: E731
+    return SimpleNamespace(
+        t_own=f64(batch.t_own), t_common=f64(batch.t_common),
+        freqs=f64(batch.freqs), df_own=f64(batch.df_own),
+        tspan_common=f64(batch.tspan_common), red_psd=f64(batch.red_psd),
+        dm_psd=f64(batch.dm_psd), chrom_psd=f64(batch.chrom_psd),
+        sys_psd=f64(batch.sys_psd),
+        sys_mask=jnp.asarray(np.asarray(batch.sys_mask)),
+        mask=jnp.asarray(np.asarray(batch.mask)),
+        sigma2=f64(batch.sigma2),
+        epoch_idx=jnp.asarray(np.asarray(batch.epoch_idx)),
+        ecorr_amp=f64(batch.ecorr_amp))
+
+
+def synthesize_residuals(compiled, batch, truth, data_seed,
+                         nsb64=None) -> np.ndarray:
+    """Self-consistent synthetic residuals drawn FROM the model at the
+    truth point: white (+ ECORR epoch offsets) plus the model's GP
+    components with prior variance ``phi(truth)`` — the generative process
+    the likelihood marginalizes, so the posterior is exactly calibrated
+    (the R-hat/recovery acceptance configuration).
+
+    Module-level so a fleet replica can synthesize the PARENT model's data
+    vector for a factorized bin-lane session (every lane must sample the
+    same data; :mod:`fakepta_tpu.sample.factorized` and
+    ``serve/fleet.py``'s ``data_nbin`` routing depend on the draw being a
+    pure function of ``(model, batch, truth, data_seed)``).
+    """
+    rng = rng_utils.KeyStream(data_seed, "sample_data").host_rng()
+    ecorr_on = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+    with _host_ctx():
+        if nsb64 is None:
+            nsb64 = f64_batch_views(batch)
+        basis = np.asarray(compiled.basis(nsb64))
+        phi = np.asarray(compiled.phi(
+            jnp.asarray(np.asarray(truth, dtype=np.float64)), nsb64))
+    coef = rng.standard_normal(phi.shape) * np.sqrt(phi)
+    res = np.einsum("ptm,pm->pt", basis, coef)
+    sigma2 = np.asarray(batch.sigma2, dtype=np.float64)
+    res += rng.standard_normal(sigma2.shape) * np.sqrt(sigma2)
+    if ecorr_on:
+        amp = np.asarray(batch.ecorr_amp, dtype=np.float64)
+        idx = np.asarray(batch.epoch_idx)
+        eps = rng.standard_normal(amp.shape)
+        res += amp * np.take_along_axis(eps, idx, axis=1)
+    return res * np.asarray(batch.mask)
+
+
+def stage_moments(compiled, batch, residuals, nsb64=None):
+    """Per-pulsar Woodbury moments of ONE data vector, host f64.
+
+    Computed unsharded in one fixed order so the staged moments are
+    identical on every mesh — the chain loop then only ever consumes
+    bit-identical inputs (mesh invariance starts here). Module-level so
+    the factorized driver can stage the PARENT model's moments once and
+    hand every bin-lane a `woodbury.restrict_moments` slice (bitwise
+    equal to the lane staging its own, but O(lanes) cheaper).
+    """
+    ecorr_on = bool(np.any(np.asarray(batch.ecorr_amp) > 0.0))
+    num_ep = batch.max_toa if ecorr_on else 0
+    with _host_ctx():
+        nsb = nsb64 if nsb64 is not None else f64_batch_views(batch)
+        tmat = compiled.basis(nsb)
+
+        def fparts(t, s2, m, e, a):
+            return woodbury.fixed_parts(t, s2, m, e, a,
+                                        num_epochs=num_ep)
+
+        def rparts(r, t, s2, m, e, a):
+            return woodbury.res_parts(r, t, s2, m, e, a,
+                                      num_epochs=num_ep)
+
+        fixed = jax.vmap(fparts)(tmat, nsb.sigma2, nsb.mask,
+                                 nsb.epoch_idx, nsb.ecorr_amp)
+        resp = jax.vmap(rparts)(
+            jnp.asarray(np.asarray(residuals, dtype=np.float64)), tmat,
+            nsb.sigma2, nsb.mask, nsb.epoch_idx, nsb.ecorr_amp)
+        m, lndet, nv, corr = jax.vmap(woodbury.finish_fixed)(fixed)
+        if corr is None:
+            d0, dt = jax.vmap(lambda rp: woodbury.finish_res(rp))(resp)
+        else:
+            d0, dt = jax.vmap(woodbury.finish_res)(resp, corr)
+        return tuple(np.asarray(x) for x in (m, lndet, nv, d0, dt))
+
+
 class SampleCheckpoint:
     """Append-only segment checkpoint for a sampling run.
 
@@ -212,7 +300,8 @@ class SamplingRun:
     """
 
     def __init__(self, batch, spec, residuals=None, truth=None, mesh=None,
-                 data_seed=0, compile_cache_dir=None, warm_from=None):
+                 data_seed=0, compile_cache_dir=None, warm_from=None,
+                 moments=None):
         from ..parallel.mesh import make_mesh
 
         pipeline_mod.configure_compile_cache(compile_cache_dir)
@@ -245,15 +334,36 @@ class SamplingRun:
 
         # --- one-off host-f64 staging: data -> Woodbury moments -> Laplace
         with _host_ctx():
-            self._nsb64 = self._f64_batch_views()
-        if residuals is None:
-            residuals = self._synthesize_data(data_seed)
-        residuals = np.asarray(residuals, dtype=np.float64)
-        if residuals.shape != np.asarray(batch.t_own).shape:
-            raise ValueError(f"residuals shape {residuals.shape} != batch "
-                             f"{np.asarray(batch.t_own).shape}")
-        self.residuals = residuals
-        self._mom64 = self._host_moments(residuals)
+            self._nsb64 = f64_batch_views(batch)
+        if moments is not None:
+            # injected-moments mode (the factorized bin-lane / streaming
+            # path): the caller already holds exact per-pulsar moments —
+            # e.g. a `woodbury.restrict_moments` slice of a parent stage
+            # or a StreamState's incrementally-appended moments — so the
+            # O(P T ncols^2) restage is skipped entirely. ``residuals``
+            # may ride along for bookkeeping but is never re-staged.
+            self._mom64 = tuple(np.asarray(m, dtype=np.float64)
+                                for m in moments)
+            if len(self._mom64) != 5:
+                raise ValueError("moments must be the 5-tuple "
+                                 "(M, lndetN, n_valid, d0, dT)")
+            ncols = self.compiled.ncols
+            if self._mom64[0].shape[-2:] != (ncols, ncols):
+                raise ValueError(
+                    f"moments M has trailing shape "
+                    f"{self._mom64[0].shape[-2:]}; this model stages "
+                    f"({ncols}, {ncols})")
+            self.residuals = (None if residuals is None
+                              else np.asarray(residuals, dtype=np.float64))
+        else:
+            if residuals is None:
+                residuals = self._synthesize_data(data_seed)
+            residuals = np.asarray(residuals, dtype=np.float64)
+            if residuals.shape != np.asarray(batch.t_own).shape:
+                raise ValueError(f"residuals shape {residuals.shape} != "
+                                 f"batch {np.asarray(batch.t_own).shape}")
+            self.residuals = residuals
+            self._mom64 = self._host_moments(residuals)
         # warm_from: a previous run's laplace_state() — the damped-Newton
         # fit starts at the prior mode instead of zero (the streaming
         # posterior-refresh path: data grew by one epoch, so the new mode
@@ -267,10 +377,7 @@ class SamplingRun:
                     f"has D={self.compiled.D}")
         self._fit_laplace(v0=v0)
 
-        psr_sh = NamedSharding(self.mesh, P(PSR_AXIS))
-        self._mom_dev = tuple(
-            jax.device_put(np.asarray(m, dtype=self._dtype), psr_sh)
-            for m in self._mom64)
+        self._stage_device()
         self._prog_cache: dict = {}  # fakepta: allow[unbounded-cache] one compiled program per (segment shape, precision) — the run plan enumerates both
         self._trace_counts: dict = {}
         self.retraces = 0
@@ -281,75 +388,13 @@ class SamplingRun:
     # ------------------------------------------------------------------
     # host-f64 staging (one-off; the sanctioned host-float64 layer)
     # ------------------------------------------------------------------
-    def _f64_batch_views(self) -> SimpleNamespace:
-        """f64 views of the batch fields ``basis``/``phi`` read, so the
-        staging math runs at full precision whatever the batch dtype."""
-        b = self.batch
-        f64 = lambda x: jnp.asarray(np.asarray(x, dtype=np.float64))  # noqa: E731
-        return SimpleNamespace(
-            t_own=f64(b.t_own), t_common=f64(b.t_common),
-            freqs=f64(b.freqs), df_own=f64(b.df_own),
-            tspan_common=f64(b.tspan_common), red_psd=f64(b.red_psd),
-            dm_psd=f64(b.dm_psd), chrom_psd=f64(b.chrom_psd),
-            sys_psd=f64(b.sys_psd),
-            sys_mask=jnp.asarray(np.asarray(b.sys_mask)),
-            mask=jnp.asarray(np.asarray(b.mask)),
-            sigma2=f64(b.sigma2),
-            epoch_idx=jnp.asarray(np.asarray(b.epoch_idx)),
-            ecorr_amp=f64(b.ecorr_amp))
-
     def _synthesize_data(self, data_seed) -> np.ndarray:
-        """Self-consistent synthetic residuals drawn FROM the model at the
-        truth point: white (+ ECORR epoch offsets) plus the model's GP
-        components with prior variance ``phi(truth)`` — the generative
-        process the likelihood marginalizes, so the posterior is exactly
-        calibrated (the R-hat/recovery acceptance configuration)."""
-        rng = rng_utils.KeyStream(data_seed, "sample_data").host_rng()
-        with _host_ctx():
-            basis = np.asarray(self.compiled.basis(self._nsb64))
-            phi = np.asarray(self.compiled.phi(
-                jnp.asarray(self.truth), self._nsb64))
-        coef = rng.standard_normal(phi.shape) * np.sqrt(phi)
-        res = np.einsum("ptm,pm->pt", basis, coef)
-        sigma2 = np.asarray(self.batch.sigma2, dtype=np.float64)
-        res += rng.standard_normal(sigma2.shape) * np.sqrt(sigma2)
-        if self._ecorr_on:
-            amp = np.asarray(self.batch.ecorr_amp, dtype=np.float64)
-            idx = np.asarray(self.batch.epoch_idx)
-            eps = rng.standard_normal(amp.shape)
-            res += amp * np.take_along_axis(eps, idx, axis=1)
-        return res * np.asarray(self.batch.mask)
+        return synthesize_residuals(self.compiled, self.batch, self.truth,
+                                    data_seed, nsb64=self._nsb64)
 
     def _host_moments(self, residuals):
-        """Per-pulsar Woodbury moments of the ONE data vector, host f64.
-
-        Computed unsharded in one fixed order so the staged moments are
-        identical on every mesh — the chain loop then only ever consumes
-        bit-identical inputs (mesh invariance starts here)."""
-        num_ep = self.batch.max_toa if self._ecorr_on else 0
-        with _host_ctx():
-            nsb = self._nsb64
-            tmat = self.compiled.basis(nsb)
-
-            def fparts(t, s2, m, e, a):
-                return woodbury.fixed_parts(t, s2, m, e, a,
-                                            num_epochs=num_ep)
-
-            def rparts(r, t, s2, m, e, a):
-                return woodbury.res_parts(r, t, s2, m, e, a,
-                                          num_epochs=num_ep)
-
-            fixed = jax.vmap(fparts)(tmat, nsb.sigma2, nsb.mask,
-                                     nsb.epoch_idx, nsb.ecorr_amp)
-            resp = jax.vmap(rparts)(jnp.asarray(residuals), tmat,
-                                    nsb.sigma2, nsb.mask, nsb.epoch_idx,
-                                    nsb.ecorr_amp)
-            m, lndet, nv, corr = jax.vmap(woodbury.finish_fixed)(fixed)
-            if corr is None:
-                d0, dt = jax.vmap(lambda rp: woodbury.finish_res(rp))(resp)
-            else:
-                d0, dt = jax.vmap(woodbury.finish_res)(resp, corr)
-            return tuple(np.asarray(x) for x in (m, lndet, nv, d0, dt))
+        return stage_moments(self.compiled, self.batch, residuals,
+                             nsb64=self._nsb64)
 
     def _lnpost64(self, v):
         """f64 unconstrained log posterior (the warm-start objective)."""
@@ -440,6 +485,56 @@ class SamplingRun:
         return {"mode_v": np.array(self.mode_v),
                 "chol_cov": np.array(self.chol_cov)}
 
+    def _stage_device(self) -> None:
+        """Device-put the staged moments (psr-sharded) and the Laplace
+        preconditioner (replicated). Both enter the jitted segment/refresh
+        programs as ARGUMENTS, never as trace-time constants — that is
+        what lets :meth:`restage` swap the data under the SAME compiled
+        executables (0 steady recompiles across streaming refreshes; the
+        moment shapes depend only on the model's column count, not on the
+        TOA count, so a grown stream re-stages without retracing)."""
+        psr_sh = NamedSharding(self.mesh, P(PSR_AXIS))
+        rep_sh = NamedSharding(self.mesh, P())
+        self._mom_dev = tuple(
+            jax.device_put(np.asarray(m, dtype=self._dtype), psr_sh)
+            for m in self._mom64)
+        self._mode_dev = {
+            "mode_v": jax.device_put(
+                np.asarray(self.mode_v, dtype=self._dtype), rep_sh),
+            "chol_cov_t": jax.device_put(
+                np.asarray(self.chol_cov.T, dtype=self._dtype), rep_sh),
+            "chol_cov": jax.device_put(
+                np.asarray(self.chol_cov, dtype=self._dtype), rep_sh)}
+
+    def restage(self, residuals=None, moments=None) -> None:
+        """Swap the data under the compiled chain programs.
+
+        Exactly one of ``residuals`` (a (P, T) vector, re-staged to
+        moments host-f64) or ``moments`` (an already-exact 5-tuple — the
+        streaming/factorized path, where :class:`~fakepta_tpu.stream.
+        StreamState` or :func:`~fakepta_tpu.ops.woodbury.restrict_moments`
+        already holds them) must be given. The Laplace fit re-runs warm
+        from the previous mode; the program cache is KEPT — moments and
+        preconditioner are jit arguments, so the next segment dispatch
+        reuses the existing executables with zero recompiles.
+        """
+        if (residuals is None) == (moments is None):
+            raise ValueError("restage() takes exactly one of residuals= "
+                             "or moments=")
+        if moments is not None:
+            self._mom64 = tuple(np.asarray(m, dtype=np.float64)
+                                for m in moments)
+        else:
+            residuals = np.asarray(residuals, dtype=np.float64)
+            if residuals.shape != np.asarray(self.batch.t_own).shape:
+                raise ValueError(
+                    f"residuals shape {residuals.shape} != batch "
+                    f"{np.asarray(self.batch.t_own).shape}")
+            self.residuals = residuals
+            self._mom64 = self._host_moments(residuals)
+        self._fit_laplace(v0=self.mode_v)
+        self._stage_device()
+
     # ------------------------------------------------------------------
     # the chain program (one jitted segment; zero host syncs inside)
     # ------------------------------------------------------------------
@@ -475,16 +570,21 @@ class SamplingRun:
         betas = mcmc.geometric_betas(t_count, spec.max_temp, dtype)
         eps = jnp.asarray(spec.step_size, dtype) / jnp.sqrt(betas)
         bounds = jnp.asarray(compiled.bounds, dtype)
-        mode_v = jnp.asarray(self.mode_v, dtype)
-        chol_cov_t = jnp.asarray(self.chol_cov.T, dtype)    # z @ C^T
-        chol_cov = jnp.asarray(self.chol_cov, dtype)        # g_v @ C
         t_idx = jnp.arange(t_count)
         state_specs = self._state_specs()
         mom_specs = tuple(P(PSR_AXIS) for _ in range(5))
+        # the Laplace preconditioner rides in as a replicated ARGUMENT
+        # (never a trace-time constant): restage() swaps data + refit
+        # under the same executables with zero recompiles
+        mode_specs = {k2: P() for k2 in ("mode_v", "chol_cov_t",
+                                         "chol_cov")}
         batch_specs = _batch_specs(self._has_toa)
 
-        def vg_factory(moments, batch):
+        def vg_factory(moments, mode, batch):
             m_l, lndet_l, nv_l, d0_l, dt_l = moments
+            mode_v = mode["mode_v"]
+            chol_cov_t = mode["chol_cov_t"]                 # z @ C^T
+            chol_cov = mode["chol_cov"]                     # g_v @ C
             p_local = m_l.shape[0]
             off = lax.axis_index(PSR_AXIS) * p_local
 
@@ -527,8 +627,8 @@ class SamplingRun:
 
             return vg
 
-        def sharded(state, moments, batch, base_key, seg_start):
-            vg = vg_factory(moments, batch)
+        def sharded(state, moments, mode, batch, base_key, seg_start):
+            vg = vg_factory(moments, mode, batch)
             kl = state["z"].shape[0]
             cg = lax.axis_index(REAL_AXIS) * kl + jnp.arange(kl)
 
@@ -577,7 +677,7 @@ class SamplingRun:
                 steps = seg_start + j * thin + jnp.arange(thin)
                 (z, parts, inc), _ = lax.scan(mcmc_step, (z, parts, inc),
                                               steps)
-                v = mode_v + z[:, 0, :] @ chol_cov_t
+                v = mode["mode_v"] + z[:, 0, :] @ mode["chol_cov_t"]
                 theta = box_from_unconstrained(v, bounds)      # (kl, D)
                 post = steps[-1] >= warmup
                 wi = post.astype(jnp.int32)
@@ -623,7 +723,8 @@ class SamplingRun:
         snap_specs = {k: state_specs[k] for k in _SNAP_KEYS}
         shmapped = shard_map(
             sharded, mesh=mesh,
-            in_specs=(state_specs, mom_specs, batch_specs, P(), P()),
+            in_specs=(state_specs, mom_specs, mode_specs, batch_specs,
+                      P(), P()),
             out_specs=(state_specs, P(None, REAL_AXIS), snap_specs),
             # the gathered likelihood rows are summed to values that are
             # replicated over 'psr'/'toa' by construction (fixed-order
@@ -643,30 +744,30 @@ class SamplingRun:
         # corruption and crashes on multi-device meshes). The carry is
         # KB-scale, so keeping both generations live costs nothing.
         @partial(jax.jit, donate_argnums=(3,), keep_unused=True)
-        def seg(base_key, seg_start, state, scratch):
+        def seg(base_key, seg_start, state, scratch, mom, mode):
             # trace-time only: the retrace guard
             self._note_trace(("sample_seg", seg_steps, warmup,
                               scratch is not None))
-            return shmapped(state, self._mom_dev, self.batch, base_key,
+            return shmapped(state, mom, mode, self.batch, base_key,
                             seg_start)
 
-        def refresh_sharded(z, moments, batch):
-            vg = vg_factory(moments, batch)
+        def refresh_sharded(z, moments, mode, batch):
+            vg = vg_factory(moments, mode, batch)
             lnl, glnl, lnpri, glnpri = vg(z)
             return dict(lnl=lnl, glnl=glnl, lnpri=lnpri, glnpri=glnpri)
 
         refresh_sh = shard_map(
             refresh_sharded, mesh=mesh,
-            in_specs=(P(REAL_AXIS), mom_specs, batch_specs),
+            in_specs=(P(REAL_AXIS), mom_specs, mode_specs, batch_specs),
             out_specs={k: P(REAL_AXIS) for k in ("lnl", "glnl", "lnpri",
                                                  "glnpri")},
             check_vma=False,
         )
 
         @jax.jit
-        def refresh(z):
+        def refresh(z, mom, mode):
             self._note_trace(("sample_refresh",))
-            return refresh_sh(z, self._mom_dev, self.batch)
+            return refresh_sh(z, mom, mode, self.batch)
 
         self._prog_cache[key] = (seg, refresh)
         return seg, refresh
@@ -710,7 +811,8 @@ class SamplingRun:
         state = {k2: jax.device_put(v, shardings[k2])
                  for k2, v in host.items()}
         if any(k2 not in state for k2 in _PART_KEYS):
-            state.update(refresh(state["z"]))
+            state.update(refresh(state["z"], self._mom_dev,
+                                 self._mode_dev))
         return state
 
     # ------------------------------------------------------------------
@@ -759,8 +861,14 @@ class SamplingRun:
         scratch = jax.ShapeDtypeStruct(
             (segment // spec.thin, k, d), dt,
             sharding=NamedSharding(self.mesh, P(None, REAL_AXIS)))
+        psr_sh = NamedSharding(self.mesh, P(PSR_AXIS))
+        rep_sh = NamedSharding(self.mesh, P())
+        mom = tuple(jax.ShapeDtypeStruct(m.shape, dt, sharding=psr_sh)
+                    for m in self._mom_dev)
+        mode = {k2: jax.ShapeDtypeStruct(v.shape, dt, sharding=rep_sh)
+                for k2, v in self._mode_dev.items()}
         seg_fn.lower(rng_utils.as_key(0), jnp.int32(0), state,
-                     scratch).compile()
+                     scratch, mom, mode).compile()
         return obs.now() - t0
 
     def _drain_segment(self, thinned, snapshot, rec, out, slot, ckpt,
@@ -1011,7 +1119,8 @@ class SamplingRun:
                         scratch = jax.device_put(
                             np.zeros((n_out, k, d), dt), scratch_sharding)
                     state2, thinned, snapshot = seg_fn(
-                        base, jnp.int32(seg_idx * segment), state, scratch)
+                        base, jnp.int32(seg_idx * segment), state, scratch,
+                        self._mom_dev, self._mode_dev)
                     if act == "poison":
                         # NaN the thinned buffer: the drain's finite guard
                         # must abort loudly, never checkpoint it

@@ -302,23 +302,20 @@ def _serve_sample(pool, d: dict, req_id, emit_line, default_spec,
     streams a line per drained segment, then the summary line. Runs
     synchronously on the connection's handler thread — one connection is
     one session (docs/SERVING.md "Fleet")."""
-    from .fleet import SampleSessionSpec
+    from .fleet import SampleSessionSpec, build_session_run
 
     spec = d.get("spec")
     spec = ArraySpec(**spec) if isinstance(spec, dict) else default_spec
     knob_names = ("nbin", "n_chains", "n_temps", "warmup", "thin",
-                  "step_size", "n_leapfrog", "data_seed")
+                  "step_size", "n_leapfrog", "data_seed", "bin_offset",
+                  "data_nbin")
     knobs = {k: v for k, v in (d.get("session") or {}).items()
              if k in knob_names}
     sess = SampleSessionSpec(spec=spec, n_steps=int(d.get("steps", 32)),
                              seed=int(d.get("seed", 0)),
                              segment=d.get("segment"), **knobs)
-    from ..sample import SamplingRun
-
-    batch, _gwb = sess.spec.parts()
-    run = SamplingRun(batch, sess.sample_spec(), mesh=pool.mesh,
-                      data_seed=sess.data_seed,
-                      compile_cache_dir=pool._pool.cache_dir)
+    run = build_session_run(sess, pool.mesh,
+                            compile_cache_dir=pool._pool.cache_dir)
 
     def on_segment(idx, arr):
         msg = {"id": req_id, "ok": True, "seg": int(idx),

@@ -164,12 +164,8 @@ class LocalReplica:
         """Build the session's :class:`~fakepta_tpu.sample.SamplingRun` on
         THIS replica's mesh (the affinity contract: the staged moments and
         warm start live with the replica that owns the session)."""
-        from ..sample import SamplingRun
-
-        batch, _gwb = sess.spec.parts()
-        return SamplingRun(batch, sess.sample_spec(), mesh=self.pool.mesh,
-                           data_seed=sess.data_seed,
-                           compile_cache_dir=self._compile_cache_dir)
+        return build_session_run(sess, self.pool.mesh,
+                                 compile_cache_dir=self._compile_cache_dir)
 
     def ping(self, deadline_s: float = 1.0) -> bool:
         """Health probe (serve/health.py): alive means the pool's
@@ -1240,19 +1236,31 @@ class SampleSessionSpec:
     step_size: float = 0.3
     n_leapfrog: int = 4
     data_seed: int = 0
+    #: factorized bin-lane routing (sample/factorized.py): this session
+    #: samples only free-spectrum bins [bin_offset, bin_offset + nbin) ...
+    bin_offset: int = 0
+    #: ... of a PARENT model with this many bins — the replica then
+    #: synthesizes the session's residuals from the parent model, so every
+    #: lane of one factorized run (and a solo/local run of the same lane)
+    #: samples the IDENTICAL data vector. None = ordinary joint session.
+    data_nbin: Optional[int] = None
 
-    def sample_spec(self):
+    def _model(self, nbin: int, bin_offset: int = 0):
         from ..infer import ComponentSpec, FreeParam, LikelihoodSpec
-        from ..sample import SampleSpec
 
-        model = LikelihoodSpec(components=(
+        return LikelihoodSpec(components=(
             ComponentSpec(target="red", spectrum="batch"),
             ComponentSpec(target="dm", spectrum="batch"),
-            ComponentSpec(target="curn", nbin=self.nbin,
+            ComponentSpec(target="curn", nbin=nbin, bin_offset=bin_offset,
                           spectrum="free_spectrum",
                           free=(FreeParam("log10_rho", (-9.0, -5.0),
                                           per_bin=True),)),
         ))
+
+    def sample_spec(self):
+        from ..sample import SampleSpec
+
+        model = self._model(self.nbin, self.bin_offset)
         return SampleSpec(model=model, n_chains=self.n_chains,
                           n_temps=self.n_temps, warmup=self.warmup,
                           thin=self.thin, step_size=self.step_size,
@@ -1263,6 +1271,53 @@ class SampleSessionSpec:
         d["spec"] = self.spec.spec_dict()
         d["kind"] = "SampleSession"
         return flightrec.spec_hash(d)
+
+
+def build_session_run(sess: "SampleSessionSpec", mesh,
+                      compile_cache_dir=None):
+    """Construct a session's :class:`~fakepta_tpu.sample.SamplingRun` —
+    the ONE construction path shared by :meth:`LocalReplica.sampling_run`
+    and the socket protocol's ``sample`` kind (serve/cli.py), so a lane
+    routed anywhere in the fleet builds the same run a solo caller would.
+
+    For a factorized bin-lane session (``data_nbin`` set) the replica
+    reproduces a local :class:`~fakepta_tpu.sample.FactorizedRun` lane
+    exactly: residuals are synthesized from the PARENT model at
+    ``data_seed`` (a pure function of ``(parent model, batch,
+    data_seed)``), the parent moments are staged and the pinned
+    components marginalized
+    (:func:`~fakepta_tpu.sample.factorized.marginalized_window_moments`),
+    and the run is built over the lane-only model with those moments
+    injected — so a lane's draws are bit-identical whichever replica
+    hosts it and bit-identical to the coalesced local run.
+    """
+    from ..infer import model as infer_model
+    from ..sample import SamplingRun
+    from ..sample.factorized import marginalized_window_moments
+    from ..sample.run import stage_moments, synthesize_residuals
+
+    batch, _gwb = sess.spec.parts()
+    if sess.data_nbin is not None:
+        parent = infer_model.build(sess._model(int(sess.data_nbin)), batch)
+        truth = parent.theta_from_unit(np.full(parent.D, 0.5))
+        residuals = synthesize_residuals(parent, batch, truth,
+                                         sess.data_seed)
+        mom = stage_moments(parent, batch, residuals)
+        lo = int(sess.bin_offset)
+        lane_mom = marginalized_window_moments(parent, batch, mom, lo,
+                                               lo + int(sess.nbin))
+        free_comp = next(c for c in parent.spec.components if c.free)
+        lane_comp = dataclasses.replace(free_comp, nbin=int(sess.nbin),
+                                        bin_offset=lo)
+        lane_spec = dataclasses.replace(
+            sess.sample_spec(),
+            model=type(parent.spec)(components=(lane_comp,)))
+        return SamplingRun(batch, lane_spec, mesh=mesh, moments=lane_mom,
+                           data_seed=sess.data_seed,
+                           compile_cache_dir=compile_cache_dir)
+    return SamplingRun(batch, sess.sample_spec(), mesh=mesh,
+                       data_seed=sess.data_seed,
+                       compile_cache_dir=compile_cache_dir)
 
 
 class SamplingSession:

@@ -28,15 +28,22 @@ Layers:
   through an R-hat gate. :class:`RefreshPolicy` +
   :meth:`~PosteriorRefresher.maybe_refresh` schedule the cycles (refresh
   on accumulated appends or rolling-|SNR| movement, never per-append).
+- :class:`FactorizedRefresher` (:mod:`refresh`) — the per-frequency
+  incremental variant for per-bin free-spectrum streams (ROADMAP item 4):
+  bin-block lanes built once, each refresh slices the stream's current
+  moments per lane and re-samples ONLY the lanes whose ``dT`` projection
+  moved — O(bins-touched) per appended block, zero steady-state
+  recompiles, same R-hat promotion gate.
 - the served surface — ``AppendRequest``/``StreamRequest``
   (:mod:`fakepta_tpu.serve.spec`), executed by the pool's
   :class:`~fakepta_tpu.serve.streams.StreamManager` and routed by the
   fleet with stream affinity to the owning replica.
 """
 
-from .refresh import PosteriorRefresher, RefreshPolicy
+from .refresh import FactorizedRefresher, PosteriorRefresher, RefreshPolicy
 from .state import (STREAM_SCHEMA, StreamCheckpoint, StreamState,
                     default_stream_model)
 
-__all__ = ["STREAM_SCHEMA", "PosteriorRefresher", "RefreshPolicy",
-           "StreamCheckpoint", "StreamState", "default_stream_model"]
+__all__ = ["STREAM_SCHEMA", "FactorizedRefresher", "PosteriorRefresher",
+           "RefreshPolicy", "StreamCheckpoint", "StreamState",
+           "default_stream_model"]

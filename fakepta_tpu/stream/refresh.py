@@ -33,6 +33,20 @@ refresh, or earlier when the stream's rolling ``|SNR|`` moved by at least
 out the epoch counter). :meth:`PosteriorRefresher.maybe_refresh` applies
 the policy: not-due calls are counted (``stream.refresh_skips``) and
 flight-recorded, never sampled.
+
+Per-frequency incremental refresh (ROADMAP item 4):
+:class:`FactorizedRefresher` is the factorized counterpart for per-bin
+free-spectrum streams. Its bin-block lanes
+(:func:`~fakepta_tpu.sample.factor_plan`) are built ONCE against the
+stream's frozen grids; each refresh slices the stream's CURRENT
+accumulated Woodbury moments per lane
+(``restrict_moments`` — O(ncols^2), never an O(history) restage) and
+re-samples ONLY the lanes whose data projection actually moved: an
+appended block perturbs ``dT`` only in the bins it touches, so the
+refresh cost is O(bins-touched), not O(nbin). Untouched lanes keep their
+previous draws — their conditional posterior did not change. Promotion
+stays R-hat gated (over the lanes that ran), and steady-state refreshes
+retrace nothing: lane programs take moments as ARGUMENTS.
 """
 
 from __future__ import annotations
@@ -43,7 +57,11 @@ from typing import Optional
 import numpy as np
 
 from .. import obs
+from ..infer import model as infer_model
 from ..sample import SampleSpec, SamplingRun, as_spec
+from ..sample.factorized import (_restrict_np, factor_plan, lane_seed,
+                                 marginalize_nuisance_np, nuisance_phi_np,
+                                 recombine_draws)
 from ..tune import defaults as knobs
 from .state import STREAM_SCHEMA
 
@@ -198,4 +216,197 @@ class PosteriorRefresher:
         info = self.refresh(n_steps, seed=seed, **run_kwargs)
         info["trigger"] = "appends" if due_appends else "snr"
         info["skipped"] = False
+        return info
+
+
+class FactorizedRefresher:
+    """O(bins-touched) incremental posterior refresh for per-bin
+    free-spectrum streams (module docstring; docs/SAMPLING.md).
+
+    Requires the stream's model to be exactly factorizable by
+    :func:`~fakepta_tpu.sample.factor_plan` (one ``per_bin`` free
+    component; batch-pinned nuisances ride along). Lanes and their jitted
+    programs are built on the FIRST refresh and reused forever — later
+    refreshes only inject freshly restricted moments
+    (:meth:`~fakepta_tpu.sample.SamplingRun.restage`), so the steady
+    state compiles nothing.
+
+    ``touch_tol`` is the relative ``dT`` movement (Frobenius, over the
+    lane's own quadrature columns) above which a lane's conditional
+    posterior is considered moved; defaults to ``tune/defaults.py
+    FS_TOUCH_TOL``. ``refresh(force_all=True)`` is the A/B baseline: every
+    lane re-sampled, same code path (suite config 18 measures the ratio).
+    """
+
+    def __init__(self, stream, spec=None, *, lane_bins=None,
+                 rhat_gate: float = 1.05, touch_tol=None, mesh=None,
+                 compile_cache_dir=None):
+        self.stream = stream
+        self.spec = (SampleSpec(model=stream.model) if spec is None
+                     else as_spec(spec))
+        if self.spec.model != stream.model:
+            raise ValueError("FactorizedRefresher spec.model must be the "
+                             "stream's model (same basis, same moments)")
+        self.rhat_gate = float(rhat_gate)
+        self.touch_tol = float(knobs.FS_TOUCH_TOL if touch_tol is None
+                               else touch_tol)
+        self.lane_bins = lane_bins
+        self.mesh = mesh
+        self.compile_cache_dir = compile_cache_dir
+        self.posterior: Optional[dict] = None
+        self.refreshes = 0
+        self.promotions = 0
+        self._compiled = None
+        self._plan = None
+        self._lanes = None
+        self._dt_mark: Optional[np.ndarray] = None
+        self._lane_results: dict = {}
+        self._lane_warm: dict = {}
+        self._lane_z: dict = {}
+
+    def _moments_np(self):
+        return tuple(np.asarray(x, dtype=np.float64)
+                     for x in self.stream.moments())
+
+    def _build(self, mom):
+        """First-refresh lane construction: the ONLY trace point.
+
+        The build-time batch AND the pinned nuisance ``phi`` are cached so
+        the marginalization operator stays FIXED across refreshes — only
+        the data moments move with appends, which keeps touch detection
+        stable and the per-refresh fold a single host solve.
+        """
+        self._batch = self.stream.batch_view()
+        self._compiled = infer_model.build(self.spec.model, self._batch)
+        self._plan = factor_plan(self._compiled, self.lane_bins)
+        self._keep = sorted({c for lp in self._plan
+                             for c in lp.free_cols})
+        self._nuis = self._plan[0].nuisance_cols
+        self._phi_nuis = nuisance_phi_np(self._compiled, self._batch,
+                                         self._nuis)
+        marg = self._marg(mom)
+        self._lanes = []
+        for lp in self._plan:
+            lane_spec = dataclasses.replace(self.spec, model=lp.model)
+            self._lanes.append(SamplingRun(
+                self._batch, lane_spec, mesh=self.mesh,
+                moments=_restrict_np(marg, lp.marg_cols),
+                compile_cache_dir=self.compile_cache_dir))
+        return marg
+
+    def _marg(self, mom):
+        """Fold the pinned nuisances into the moments (Ntilde metric),
+        with the build-time cached nuisance ``phi`` — the pinned prior is
+        theta-independent, so the fold stays one pure host solve."""
+        return marginalize_nuisance_np(mom, self._keep, self._nuis,
+                                       self._phi_nuis)
+
+    def _touched(self, dt_new) -> list:
+        """Lane indices whose data projection moved since the last refresh
+        — the appended block perturbs the PARENT ``dT`` only in bins it
+        excites, so excitation is read off the raw projections (the
+        marginalized ``dT~`` folds nuisance projections into every column
+        via ``M_kn A^-1 dT_n`` and would flood-fill the touch set on
+        irregular grids; the R-hat gate catches any misprediction)."""
+        out = []
+        for lp in self._plan:
+            cols = list(lp.free_cols)
+            base = float(np.linalg.norm(self._dt_mark[:, cols]))
+            delta = float(np.linalg.norm(dt_new[:, cols]
+                                         - self._dt_mark[:, cols]))
+            if delta > self.touch_tol * (base + 1e-300):
+                out.append(lp.index)
+        return out
+
+    @property
+    def lane_count(self) -> int:
+        return 0 if self._plan is None else len(self._plan)
+
+    def refresh(self, n_steps: int = 200, seed: int = 0, *,
+                force_all: bool = False, **run_kwargs) -> dict:
+        """One incremental cycle: slice current moments, re-sample the
+        touched lanes warm, recombine, R-hat-gated promotion.
+
+        The first call (and any ``force_all=True`` call) refreshes every
+        lane — that IS the full-refresh baseline, same code path. Returns
+        the cycle stats (``fs_*`` keys); the promoted recombined posterior
+        is ``self.posterior``.
+        """
+        t0 = obs.now()
+        cold = self._lanes is None
+        mom = self._moments_np()
+        marg = self._build(mom) if cold else self._marg(mom)
+        dt_new = np.asarray(mom[4])
+        if cold or force_all or self._dt_mark is None:
+            touched = [lp.index for lp in self._plan]
+        else:
+            touched = self._touched(dt_new)
+        bins = sum(self._plan[i].hi - self._plan[i].lo for i in touched)
+        retr0 = sum(lane.retraces for lane in self._lanes)
+        rhat_ran = []
+        for i in touched:
+            lp, lane = self._plan[i], self._lanes[i]
+            warm = self._lane_warm.get(i)
+            if not cold:
+                lane.restage(moments=_restrict_np(marg, lp.marg_cols))
+            init_z = None
+            z_prev = self._lane_z.get(i)
+            if z_prev is not None and warm is not None:
+                init_z = PosteriorRefresher._remap_z(
+                    z_prev, warm, lane.laplace_state())
+            res = lane.run(int(n_steps), seed=lane_seed(seed, i),
+                           init_z=init_z, **run_kwargs)
+            self._lane_results[i] = res
+            self._lane_warm[i] = lane.laplace_state()
+            self._lane_z[i] = lane.last_z
+            rhat_ran.append(float(res["summary"].get("rhat_max",
+                                                     float("nan"))))
+            obs.count("stream.fs_lanes_refreshed")
+        recompiles = sum(lane.retraces for lane in self._lanes) - retr0
+        rhat_max = max(rhat_ran) if rhat_ran else float("nan")
+        cycle = self.refreshes
+        self.refreshes += 1
+        promoted = bool(rhat_ran) and bool(np.isfinite(rhat_max)
+                                           and rhat_max <= self.rhat_gate)
+        if promoted:
+            results = [self._lane_results[lp.index] for lp in self._plan]
+            theta = recombine_draws([lp.theta_idx for lp in self._plan],
+                                    results, self._compiled.D)
+            mode_theta = np.zeros(self._compiled.D)
+            for lp, lane in zip(self._plan, self._lanes):
+                mode_theta[list(lp.theta_idx)] = lane.mode_theta
+            self.posterior = {
+                "schema": STREAM_SCHEMA,
+                "theta": theta,
+                "param_names": list(self._compiled.param_names),
+                "bounds": np.asarray(self._compiled.bounds),
+                "mode_theta": mode_theta,
+                "summary": {
+                    "rhat_max": round(max(
+                        r["summary"]["rhat_max"] for r in results), 5),
+                    "ess_min": round(min(
+                        r["summary"]["ess_min"] for r in results), 2),
+                    "fs_lane_count": len(self._plan),
+                },
+            }
+            self.promotions += 1
+            obs.count("stream.promotions")
+        elif rhat_ran:
+            obs.flightrec.note("stream_fs_refresh_reject", refresh=cycle,
+                               rhat_max=rhat_max, gate=self.rhat_gate)
+        self._dt_mark = dt_new.copy()
+        obs.count("stream.fs_refreshes")
+        obs.count("stream.fs_bins_touched", bins)
+        obs.telemetry.publish("stream.fs_bins_touched", int(bins))
+        info = {
+            "schema": STREAM_SCHEMA, "refresh": cycle,
+            "fs_lane_count": len(self._plan),
+            "fs_lanes_touched": len(touched),
+            "fs_bins_touched": int(bins),
+            "fs_recompiles": int(recompiles),
+            "rhat_max": rhat_max, "promoted": promoted,
+            "warm_started": not cold and not force_all,
+            "n_steps": int(n_steps),
+            "fs_refresh_ms": round((obs.now() - t0) * 1e3, 3),
+        }
         return info

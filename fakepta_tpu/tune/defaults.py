@@ -117,6 +117,21 @@ REFRESH_EVERY_APPENDS = 4
 #: without a ``watch`` statistic fall back to the epoch-count trigger)
 REFRESH_MIN_SNR_GAIN = 0.5
 
+#: factorized free-spectrum sampling (sample/factorized.py): bins per
+#: lane. 1 = fully per-frequency (most lanes, smallest chains); wider
+#: blocks amortize per-lane fixed cost when lane count outruns the fleet.
+#: The factorization itself is exact for any block width on a regular
+#: grid, so this is purely a throughput knob (docs/SAMPLING.md).
+FS_LANE_BINS = 4
+
+#: per-frequency incremental refresh (stream/refresh.py
+#: FactorizedRefresher): a lane counts as TOUCHED by an append when its
+#: data-moment block moved by more than this relative amount
+#: (``||dT_new - dT_old||_F / ||dT_old||_F`` over the lane's columns).
+#: Untouched lanes keep their posterior — staleness is bounded by this
+#: tolerance — so refresh cost is O(bins-touched), not O(bins)
+FS_TOUCH_TOL = 1e-3
+
 # --- telemetry-plane knobs (fakepta_tpu.obs.telemetry) ---------------------
 
 #: bounded snapshot ring per replica publisher (and per replica inside the

@@ -140,6 +140,11 @@ def test_os_mesh_invariance(batch):
                     err_msg=f"{orf}/{k}/{shard_kw}")
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 20) — the
+# heavy statistical calibration; OS correctness stays tier-1 via
+# test_os_lane_matches_host_optimal_statistic_every_orf and the null
+# calibration itself via test_montecarlo.py::
+# test_optimal_statistic_calibration
 def test_os_null_calibration_deterministic(batch):
     """The paired noise-only stream: deterministic per seed, independent of
     the signal stream, and its statistics calibrate the p-values."""

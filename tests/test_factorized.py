@@ -1,0 +1,314 @@
+"""Per-frequency factorized free-spectrum sampling (ISSUE 20).
+
+Lean by construction: ONE module-scoped :class:`FactorizedRun` over the
+fleet session's ArraySpec batch serves the bit-identity lanes (solo /
+coalesced / fleet-routed), the recombination-layout assertions, and the
+diagnostics aggregates; the exactness oracles are pure host f64 (no chain
+compiles); the streaming refresher owns one tiny stream whose appends stay
+inside the first capacity rungs so the steady state compiles nothing.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from fakepta_tpu import constants as const
+from fakepta_tpu.batch import PulsarBatch
+from fakepta_tpu.infer import ComponentSpec, FreeParam, LikelihoodSpec
+from fakepta_tpu.infer import build as infer_build
+from fakepta_tpu.ops import woodbury
+from fakepta_tpu.sample import SampleSpec, SamplingRun
+from fakepta_tpu.sample.factorized import (FactorizedRun, FactorizedSpec,
+                                           _restrict_np, factor_plan,
+                                           factorized_oracle, lane_seed,
+                                           lane_spans,
+                                           marginalize_nuisance_np,
+                                           marginalized_window_moments,
+                                           recombine_draws)
+from fakepta_tpu.serve import ArraySpec, SampleSessionSpec
+from fakepta_tpu.serve.fleet import build_session_run
+from fakepta_tpu.stream import FactorizedRefresher, StreamState
+
+NB = 4                 # parent free-spectrum bins
+LANE_BINS = 2          # -> lanes (0,2) and (2,4)
+N_STEPS = 8
+SEED = 5
+ASPEC = ArraySpec(npsr=3, ntoa=32, n_red=3, n_dm=3, gwb_ncomp=3,
+                  data_seed=77)
+
+
+def _free_spectrum_model(nbin, n_probe_comps=True):
+    comps = [ComponentSpec(target="red", spectrum="batch"),
+             ComponentSpec(target="dm", spectrum="batch")] if \
+        n_probe_comps else []
+    comps.append(ComponentSpec(
+        target="curn", nbin=nbin, spectrum="free_spectrum",
+        free=(FreeParam("log10_rho", (-9.0, -5.0), per_bin=True),)))
+    return LikelihoodSpec(components=tuple(comps))
+
+
+def _regular_batch(npsr=3, ntoa=48, nbin=NB, seed=1):
+    """Exact discrete-orthogonality grid: t_k = k/T, no endpoint."""
+    b = PulsarBatch.synthetic(npsr=npsr, ntoa=ntoa, tspan_years=10.0,
+                              toaerr=1e-7, n_red=nbin, n_dm=nbin,
+                              seed=seed, dtype=jnp.float64)
+    t = np.tile(np.arange(ntoa, dtype=np.float64)[None] / ntoa, (npsr, 1))
+    return dataclasses.replace(b, t_own=jnp.asarray(t),
+                               t_common=jnp.asarray(t))
+
+
+# ---------------------------------------------------------------------------
+# the plan (pure host)
+# ---------------------------------------------------------------------------
+
+def test_lane_spans_widths_and_errors():
+    assert lane_spans(8, 3) == ((0, 3), (3, 6), (6, 8))
+    assert lane_spans(4, 1) == ((0, 1), (1, 2), (2, 3), (3, 4))
+    assert lane_spans(6, (2, 1, 3)) == ((0, 2), (2, 3), (3, 6))
+    with pytest.raises(ValueError, match="lane_bins must be >= 1"):
+        lane_spans(4, 0)
+    with pytest.raises(ValueError, match="sum to"):
+        lane_spans(6, (2, 2))
+
+
+def test_factor_plan_contract_and_validation():
+    batch, _ = ASPEC.parts()
+    compiled = infer_build(_free_spectrum_model(NB), batch)
+    plan = factor_plan(compiled, LANE_BINS)
+    assert [(lp.lo, lp.hi) for lp in plan] == [(0, 2), (2, 4)]
+    # lane models carry ONLY the restricted free component — nuisances
+    # are marginalized into the injected moments, not re-modeled
+    for lp in plan:
+        assert len(lp.model.components) == 1
+        comp = lp.model.components[0]
+        assert comp.bin_offset == lp.lo and comp.nbin == lp.hi - lp.lo
+        # cos/sin strips at absolute bin positions, in both coordinate
+        # systems (parent columns vs marginalized free-block positions)
+        assert lp.marg_cols == tuple(list(range(lp.lo, lp.hi))
+                                     + list(range(NB + lp.lo,
+                                                  NB + lp.hi)))
+        assert (np.asarray(lp.free_cols) - np.asarray(lp.marg_cols)
+                == lp.free_cols[0] - lp.marg_cols[0]).all()
+        assert set(lp.free_cols).isdisjoint(lp.nuisance_cols)
+    assert plan[0].theta_idx == (0, 1) and plan[1].theta_idx == (2, 3)
+    # every parent column is either some lane's or a shared nuisance
+    cols = set(plan[0].nuisance_cols)
+    for lp in plan:
+        cols |= set(lp.free_cols)
+    assert cols == set(range(compiled.ncols))
+
+    # scalar hyperparameters couple all bins: refused
+    powerlaw = LikelihoodSpec(components=(
+        ComponentSpec(target="curn", nbin=NB, free=(
+            FreeParam("log10_A", (-16.0, -13.0)),
+            FreeParam("gamma", (2.0, 6.0)))),))
+    with pytest.raises(ValueError, match="per_bin"):
+        factor_plan(infer_build(powerlaw, batch))
+    # two free components: refused
+    two = LikelihoodSpec(components=(
+        ComponentSpec(target="red", nbin=NB, spectrum="free_spectrum",
+                      free=(FreeParam("log10_rho", (-9.0, -5.0),
+                                      per_bin=True),)),
+        ComponentSpec(target="curn", nbin=NB, spectrum="free_spectrum",
+                      free=(FreeParam("log10_rho", (-9.0, -5.0),
+                                      per_bin=True),)),))
+    with pytest.raises(ValueError, match="exactly one free component"):
+        factor_plan(infer_build(two, batch))
+
+
+# ---------------------------------------------------------------------------
+# the algebra (host f64, no chain compiles)
+# ---------------------------------------------------------------------------
+
+def test_marginalize_nuisance_is_exact_woodbury(rng):
+    """Folding pinned columns into Ntilde preserves the lnL EXACTLY (not
+    just up to a constant) at any phi over the kept columns — the Schur /
+    block-determinant identity the whole lane decomposition rests on."""
+    p, n_all = 3, 7
+    keep, nuis = [0, 2, 5], [1, 3, 4, 6]
+    f = rng.normal(size=(p, 12, n_all))
+    m = np.einsum("ptk,ptl->pkl", f, f)
+    dt = rng.normal(size=(p, n_all))
+    d0 = np.abs(rng.normal(size=p)) + 50.0
+    lndet = rng.normal(size=p)
+    nv = np.full(p, 12.0)
+    phi_n = 10.0 ** rng.uniform(-2, 1, size=(p, len(nuis)))
+    marg = marginalize_nuisance_np((m, lndet, nv, d0, dt), keep, nuis,
+                                   phi_n)
+    for trial in range(2):
+        phi_k = 10.0 ** rng.uniform(-2, 1, size=(p, len(keep)))
+        phi_full = np.zeros((p, n_all))
+        phi_full[:, keep], phi_full[:, nuis] = phi_k, phi_n
+        joint = jax.vmap(woodbury.lnlike_from_moments)(
+            jnp.asarray(d0), jnp.asarray(dt), jnp.asarray(m),
+            jnp.asarray(lndet), jnp.asarray(nv), jnp.asarray(phi_full))
+        lane = jax.vmap(woodbury.lnlike_from_moments)(
+            jnp.asarray(marg[3]), jnp.asarray(marg[4]),
+            jnp.asarray(marg[0]), jnp.asarray(marg[1]),
+            jnp.asarray(marg[2]), jnp.asarray(phi_k))
+        np.testing.assert_allclose(np.asarray(lane), np.asarray(joint),
+                                   rtol=1e-12, atol=1e-9)
+    # no nuisance columns -> a plain restriction
+    r0 = marginalize_nuisance_np((m, lndet, nv, d0, dt), keep, [], None)
+    r1 = _restrict_np((m, lndet, nv, d0, dt), keep)
+    for a, b in zip(r0, r1):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_oracle_exact_on_regular_grid_detects_irregular_defect():
+    """The f64 dense proof: on the discrete-orthogonality grid the lane
+    sum equals the joint lnL to roundoff and the marginalized cross-lane
+    coupling vanishes; on an irregular grid both report a real defect
+    instead of silently claiming exactness."""
+    model = _free_spectrum_model(NB)
+    orc = factorized_oracle(_regular_batch(), model, lane_bins=LANE_BINS,
+                            data_seed=3, n_probe=3)
+    assert orc["lane_count"] == 2
+    assert orc["additivity_max_err"] <= 1e-8 * max(orc["lnl_scale"], 1.0)
+    assert orc["coupling"] < 1e-10
+    irr = PulsarBatch.synthetic(npsr=3, ntoa=48, tspan_years=10.0,
+                                toaerr=1e-7, n_red=NB, n_dm=NB, seed=1,
+                                dtype=jnp.float64)
+    orc2 = factorized_oracle(irr, model, lane_bins=LANE_BINS,
+                             data_seed=3, n_probe=3)
+    assert orc2["additivity_max_err"] > 1e3 * orc["additivity_max_err"]
+    assert orc2["coupling"] > 1e3 * orc["coupling"]
+
+
+def test_recombine_draws_scatter_and_truncation(rng):
+    spans = [(0, 1), (2, 3)]
+    r0 = {"theta": rng.normal(size=(6, 2, 2))}
+    r1 = {"theta": rng.normal(size=(4, 2, 2))}   # shorter lane wins
+    theta = recombine_draws(spans, [r0, r1], 5)
+    assert theta.shape == (4, 2, 5)
+    np.testing.assert_array_equal(theta[:, :, [0, 1]], r0["theta"][:4])
+    np.testing.assert_array_equal(theta[:, :, [2, 3]], r1["theta"])
+    np.testing.assert_array_equal(theta[:, :, 4], 0.0)
+    with pytest.raises(ValueError, match="no lane results"):
+        recombine_draws([], [], 5)
+
+
+# ---------------------------------------------------------------------------
+# the driver: one coalesced run, three identities
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def coalesced():
+    """The module's one FactorizedRun (2 lanes over the ArraySpec batch),
+    built exactly as a fleet session's parent would be."""
+    sess = SampleSessionSpec(spec=ASPEC, n_steps=N_STEPS, seed=SEED,
+                             nbin=NB, n_chains=2, warmup=4, n_leapfrog=2,
+                             data_seed=7)
+    batch, _ = sess.spec.parts()
+    fr = FactorizedRun(batch, FactorizedSpec(sess.sample_spec(),
+                                             LANE_BINS),
+                       data_seed=sess.data_seed)
+    res = fr.run(N_STEPS, seed=SEED)
+    return {"sess": sess, "batch": batch, "fr": fr, "res": res}
+
+
+def test_factorized_result_layout_and_aggregates(coalesced):
+    fr, res = coalesced["fr"], coalesced["res"]
+    assert res["theta"].shape[2] == fr.parent.D == NB
+    assert fr.retraces == 0
+    s = res["summary"]
+    assert s["fs_lane_count"] == 2 and len(res["lanes"]) == 2
+    assert s["fs_wall_s_critical"] <= s["fs_wall_s_total"]
+    # exact lane aggregates, not re-derived joint statistics
+    assert s["rhat_max"] == round(max(
+        r["summary"]["rhat_max"] for r in res["lanes"]), 5)
+    assert s["ess_min"] == round(min(
+        r["summary"]["ess_min"] for r in res["lanes"]), 2)
+    # the per-chip fleet figure uses the critical-path lane wall time
+    assert s["fs_ess_per_s_per_chip"] >= s["ess_per_s_per_chip"]
+    for lp, lane in zip(fr.plan, fr.lanes):
+        np.testing.assert_array_equal(res["mode_theta"][list(lp.theta_idx)],
+                                      lane.mode_theta)
+
+
+def test_lane_draws_bit_identical_solo_and_fleet_routed(coalesced):
+    """The RNG/staging contract: lane 1's draws are bit-identical run
+    solo (a SamplingRun over the restricted marginalized moments),
+    coalesced in the FactorizedRun, and fleet-routed (build_session_run
+    from a bin_offset/data_nbin session spec — the construction path a
+    replica anywhere in the fleet uses)."""
+    sess, batch = coalesced["sess"], coalesced["batch"]
+    fr, res = coalesced["fr"], coalesced["res"]
+    lp = fr.plan[1]
+    lane_theta = res["lanes"][1]["theta"]
+    # recombined draws carry the lane verbatim in its parent slots
+    np.testing.assert_array_equal(
+        res["theta"][:, :, list(lp.theta_idx)],
+        lane_theta[:res["theta"].shape[0]])
+
+    solo = SamplingRun(
+        batch, dataclasses.replace(fr.spec, model=lp.model),
+        moments=_restrict_np(fr.marg_moments, lp.marg_cols))
+    out = solo.run(N_STEPS, seed=lane_seed(SEED, 1))
+    np.testing.assert_array_equal(out["theta"], lane_theta)
+
+    lane_sess = dataclasses.replace(sess, nbin=lp.hi - lp.lo,
+                                    bin_offset=lp.lo,
+                                    seed=lane_seed(SEED, 1), data_nbin=NB)
+    routed = build_session_run(lane_sess, mesh=None)
+    out2 = routed.run(lane_sess.n_steps, seed=lane_sess.seed)
+    np.testing.assert_array_equal(out2["theta"], lane_theta)
+    # and the fleet staging helper is the same marginalized restriction
+    mom = marginalized_window_moments(fr.parent, batch, fr.moments,
+                                      lp.lo, lp.hi)
+    for a, b in zip(mom, _restrict_np(fr.marg_moments, lp.marg_cols)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# streaming: O(bins-touched) refresh
+# ---------------------------------------------------------------------------
+
+def test_factorized_refresher_touched_bins_only(tmp_path):
+    """An evenly-spaced append carrying one bin's sinusoid refreshes ONE
+    lane (O(bins-touched)), compiles nothing in the steady state, warm-
+    starts, and the R-hat gate can veto promotion without discarding the
+    last promoted posterior."""
+    npsr, tspan_years, nb = 3, 3.0, 3
+    tspan_s = tspan_years * const.yr
+    template = PulsarBatch.synthetic(npsr=npsr, ntoa=32,
+                                     tspan_years=tspan_years, n_red=3,
+                                     n_dm=3, seed=3, dtype=jnp.float64)
+    model = _free_spectrum_model(nb)
+    stream = StreamState(template, model)
+    rng = np.random.default_rng(0)
+    # base block width 12 snaps to the 16 rung; the later 16-wide append
+    # reuses that bucket's executable — 0 steady recompiles by design
+    t0 = np.sort(rng.uniform(0, 0.9 * tspan_s, (npsr, 12)), axis=1)
+    stream.append(t0, rng.normal(0, 1e-7, (npsr, 12)),
+                  sigma2=np.full((npsr, 12), 1e-14))
+
+    spec = SampleSpec(model=model, n_chains=2, warmup=4, n_leapfrog=2)
+    ref = FactorizedRefresher(stream, spec, lane_bins=1, rhat_gate=1e9)
+    cold = ref.refresh(N_STEPS, seed=1)
+    assert cold["fs_lane_count"] == nb
+    assert cold["fs_lanes_touched"] == nb and not cold["warm_started"]
+    assert cold["promoted"] and ref.posterior is not None
+    assert ref.posterior["theta"].shape[2] == nb
+
+    # evenly spaced TOAs carrying a pure bin-1 (f = 2/T) sinusoid:
+    # discrete orthogonality confines the dT projection to that bin
+    m = 16
+    t1 = np.tile((np.arange(m) / m * tspan_s)[None], (npsr, 1))
+    r1 = 1e-6 * np.sin(2 * np.pi * (2.0 / tspan_s) * t1)
+    stream.append(t1, r1, sigma2=np.full((npsr, m), 1e-14))
+    incr = ref.refresh(N_STEPS, seed=2)
+    assert incr["fs_lanes_touched"] == 1 and incr["fs_bins_touched"] == 1
+    assert incr["fs_recompiles"] == 0 and incr["warm_started"]
+    assert incr["promoted"]
+
+    # the R-hat promotion gate: a vetoed cycle keeps the last posterior
+    kept = ref.posterior["theta"]
+    ref.rhat_gate = 0.0
+    vetoed = ref.refresh(N_STEPS, seed=3, force_all=True)
+    assert not vetoed["promoted"] and vetoed["fs_recompiles"] == 0
+    np.testing.assert_array_equal(ref.posterior["theta"], kept)
+    assert ref.promotions == 2 and ref.refreshes == 3

@@ -157,6 +157,9 @@ def lifecycle(tmp_path_factory):
     compilation_cache.reset_cache()
 
 
+@pytest.mark.slow   # ~12 s: tier-1 budget reclaim (ISSUE 20) — the
+# wedged/dead transport classification that drives the breaker stays
+# tier-1 via test_wedged_then_dead_transport_classification
 def test_hung_replica_breakered_with_zero_client_timeouts(lifecycle):
     """The tentpole's no-minutes-lost contract: wedge one replica's
     heartbeats (fleet.heartbeat hang matched to it), and its traffic
@@ -188,6 +191,10 @@ def test_hung_replica_breakered_with_zero_client_timeouts(lifecycle):
     assert flt.slo_summary()["fleet_breaker_closes"] >= 1
 
 
+@pytest.mark.slow   # ~15 s: tier-1 budget reclaim (ISSUE 20) — join/
+# retire actuation stays tier-1 via test_autoscaler_step_actuates_join_
+# then_retire and the register handshake via test_replica_register_
+# handshake_adopts_and_serves
 def test_join_prewarms_recent_shard_and_retire_drains(lifecycle):
     """Elastic membership: a joined replica absorbs its ring shard with
     warm loads from the fleet's recent working set (shared compile

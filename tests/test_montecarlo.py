@@ -198,6 +198,10 @@ def test_mesh_validation(small_batch):
                                                                 psr_shards=3))
 
 
+@pytest.mark.slow   # ~17 s: tier-1 budget reclaim (ISSUE 20) — chrom
+# activation stays tier-1 via test_noise_sampling.py::
+# test_normal_dist_and_chrom_activation and the chromatic-GWB lane via
+# test_ensemble_anisotropic_and_chromatic_gwb
 def test_chrom_band_carried_and_injected():
     """from_pulsars must carry chrom_gp PSDs (idx=4 scaling) into the ensemble;
     regression for the band being silently dropped."""

@@ -239,6 +239,10 @@ def test_backpressure_deadline_and_validation():
         pool.close()
 
 
+@pytest.mark.slow   # ~11 s: tier-1 budget reclaim (ISSUE 20) — the
+# shared _exec_plan cache-key selection stays tier-1 via
+# test_zero_recompiles_after_warmup and the compile-cache warm start
+# via test_pipeline.py::test_compile_cache_and_warm_start
 def test_warm_pool_and_manual_warm_start_share_cache_entry(tmp_path):
     """ISSUE 9 satellite: the spec-hash/executable-key selection is one
     shared helper (_exec_plan), so a serve bucket prewarm and a manual

@@ -540,6 +540,10 @@ def test_stats_protocol_reply_is_enriched(telem_fleet):
     assert replies[3]["metrics"].startswith("# HELP fakepta_")
 
 
+@pytest.mark.slow   # ~14 s: tier-1 budget reclaim (ISSUE 20) — the
+# flow-event span linking stays tier-1 via test_flow_events_link_spans_
+# sharing_trace_ids and failover bit-identity via test_fleet.py::
+# test_midflight_failover_is_bit_identical
 def test_traced_failover_exports_linked_chrome_flow(telem_fleet, tmp_path):
     """The tentpole acceptance on a 2-replica kill: a request that fails
     over mid-flight exports ONE validated Chrome trace in which the

@@ -50,6 +50,10 @@ def _epoch_psrs(npsr=8, n_epochs=24, per_epoch=4, toaerr=1e-7):
     return psrs
 
 
+@pytest.mark.slow   # ~12 s: tier-1 budget reclaim (ISSUE 20) — fixed-
+# stream parity stays tier-1 via test_noise_sampling.py::
+# test_params_dict_matches_legacy_powerlaw_stream and the sigma2
+# plumbing via test_ecorr_only_sampling_keeps_batch_sigma2
 def test_pinned_white_sampling_reproduces_fixed_run(batch):
     """efac pinned at 1 with EQUAD off rebuilds exactly the synthetic batch's
     sigma2 = toaerr^2, and the white draw stream (kw) is untouched by the
